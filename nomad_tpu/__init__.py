@@ -1,0 +1,9 @@
+"""tpu-nomad: a TPU-native cluster scheduling framework.
+
+Capability parity with HashiCorp Nomad v0.1.2, re-designed TPU-first: the
+host plane (RPC, raft, broker, agents) is Python/asyncio; the scheduler core
+(feasibility filtering + bin-pack ranking) is vectorized JAX over
+device-resident fleet tensors, sharded over a jax.sharding.Mesh.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,17 @@
+"""Test configuration.
+
+Force JAX onto a virtual 8-device CPU mesh so sharding/pjit paths are
+exercised without TPU hardware (the driver separately dry-runs the
+multi-chip path; bench runs on the real chip).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,221 @@
+"""Data model unit tests (parity targets: nomad/structs/*_test.go)."""
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.structs import (
+    ALLOC_DESIRED_STATUS_RUN,
+    ALLOC_DESIRED_STATUS_STOP,
+    Allocation,
+    Evaluation,
+    Job,
+    NetworkIndex,
+    NetworkResource,
+    Node,
+    Plan,
+    PlanResult,
+    Resources,
+    allocs_fit,
+    filter_terminal_allocs,
+    remove_allocs,
+    score_fit,
+)
+from nomad_tpu.structs.codec import JOB_REGISTER_REQUEST, decode, encode
+
+
+def test_resources_superset():
+    big = Resources(cpu=2000, memory_mb=2048, disk_mb=10000, iops=100)
+    small = Resources(cpu=2000, memory_mb=1024, disk_mb=5000, iops=50)
+    ok, dim = big.superset(small)
+    assert ok and dim == ""
+    ok, dim = small.superset(big)
+    assert not ok and dim == "memory exhausted"
+    ok, dim = Resources(cpu=1).superset(Resources(cpu=2))
+    assert not ok and dim == "cpu exhausted"
+
+
+def test_resources_add_merges_networks():
+    r = Resources(networks=[NetworkResource(device="eth0", mbits=100)])
+    r.add(Resources(cpu=100, networks=[NetworkResource(device="eth0", mbits=50)]))
+    assert r.cpu == 100
+    assert len(r.networks) == 1
+    assert r.networks[0].mbits == 150
+    r.add(Resources(networks=[NetworkResource(device="eth1", mbits=10)]))
+    assert len(r.networks) == 2
+
+
+def test_resources_copy_is_deep_for_networks():
+    r = Resources(networks=[NetworkResource(device="eth0", reserved_ports=[1])])
+    c = r.copy()
+    c.networks[0].reserved_ports.append(2)
+    assert r.networks[0].reserved_ports == [1]
+
+
+def test_map_dynamic_ports():
+    n = NetworkResource(reserved_ports=[80, 443, 30001, 30002],
+                        dynamic_ports=["http", "https"])
+    assert n.map_dynamic_ports() == {"http": 30001, "https": 30002}
+    assert n.list_static_ports() == [80, 443]
+
+
+def test_allocs_fit_and_score():
+    n = mock.node()
+    a = Allocation(
+        id="a1",
+        resources=Resources(cpu=2000, memory_mb=2048, disk_mb=10000, iops=50),
+    )
+    fit, dim, used = allocs_fit(n, [a])
+    assert fit, dim
+    # reserved (100, 256) + alloc
+    assert used.cpu == 2100 and used.memory_mb == 2304
+    score = score_fit(n, used)
+    assert 0.0 <= score <= 18.0
+
+    # Doubling the alloc exhausts memory (2*2048+256 < 8192 ok; cpu 4100 > 4000)
+    fit, dim, _ = allocs_fit(n, [a, a])
+    assert not fit and dim == "cpu exhausted"
+
+
+def test_score_fit_extremes():
+    n = mock.node()
+    n.reserved = None
+    empty = Resources()
+    assert score_fit(n, empty) == 0.0  # 20 - 20
+    full = Resources(cpu=4000, memory_mb=8192)
+    assert score_fit(n, full) == 18.0  # perfect fit
+
+
+def test_filter_terminal_and_remove():
+    a1 = Allocation(id="1", desired_status=ALLOC_DESIRED_STATUS_RUN)
+    a2 = Allocation(id="2", desired_status=ALLOC_DESIRED_STATUS_STOP)
+    assert filter_terminal_allocs([a1, a2]) == [a1]
+    assert remove_allocs([a1, a2], [a1]) == [a2]
+
+
+def test_network_index_lifecycle():
+    n = mock.node()
+    idx = NetworkIndex()
+    assert not idx.set_node(n)
+    assert idx.avail_bandwidth["eth0"] == 1000
+    assert 22 in idx.used_ports["192.168.0.100"]
+    assert not idx.overcommitted()
+
+    # Reserved port collision
+    collide = idx.add_reserved(NetworkResource(
+        device="eth0", ip="192.168.0.100", reserved_ports=[22]))
+    assert collide
+
+
+def test_assign_network_dynamic_ports():
+    n = mock.node()
+    idx = NetworkIndex()
+    idx.set_node(n)
+    ask = NetworkResource(mbits=100, dynamic_ports=["http", "https"])
+    offer, err = idx.assign_network(ask)
+    assert offer is not None, err
+    assert offer.device == "eth0"
+    assert len(offer.reserved_ports) == 2
+    ports = offer.map_dynamic_ports()
+    assert set(ports) == {"http", "https"}
+
+
+def test_assign_network_bandwidth_exceeded():
+    n = mock.node()
+    idx = NetworkIndex()
+    idx.set_node(n)
+    offer, err = idx.assign_network(NetworkResource(mbits=5000))
+    assert offer is None and err == "bandwidth exceeded"
+
+
+def test_job_validate():
+    j = mock.job()
+    assert j.validate() == []
+    j.priority = 300
+    j.task_groups = []
+    errs = j.validate()
+    assert any("priority" in e for e in errs)
+    assert any("task groups" in e for e in errs)
+
+
+def test_plan_append_pop():
+    plan = Plan()
+    a = mock.alloc()
+    plan.append_update(a, ALLOC_DESIRED_STATUS_STOP, "test")
+    assert len(plan.node_update[a.node_id]) == 1
+    assert plan.node_update[a.node_id][0].desired_status == \
+        ALLOC_DESIRED_STATUS_STOP
+    # original untouched (copy-on-append)
+    assert a.desired_status == ALLOC_DESIRED_STATUS_RUN
+    plan.pop_update(a)
+    assert a.node_id not in plan.node_update
+    assert plan.is_noop()
+
+
+def test_plan_result_full_commit():
+    plan = Plan()
+    a = mock.alloc()
+    plan.append_alloc(a)
+    res = PlanResult(node_allocation={a.node_id: [a]})
+    ok, expected, actual = res.full_commit(plan)
+    assert ok and expected == 1 and actual == 1
+    res2 = PlanResult()
+    ok, expected, actual = res2.full_commit(plan)
+    assert not ok and expected == 1 and actual == 0
+
+
+def test_eval_make_plan_and_rolling():
+    e = mock.eval()
+    j = mock.job()
+    j.all_at_once = True
+    p = e.make_plan(j)
+    assert p.eval_id == e.id and p.all_at_once
+    nxt = e.next_rolling_eval(30.0)
+    assert nxt.previous_eval == e.id and nxt.wait == 30.0
+
+
+def test_struct_dict_roundtrip():
+    j = mock.job()
+    d = j.to_dict()
+    j2 = Job.from_dict(d)
+    assert j2.to_dict() == d
+    assert j2.task_groups[0].tasks[0].resources.cpu == 500
+
+    a = mock.alloc()
+    a2 = Allocation.from_dict(a.to_dict())
+    assert a2.to_dict() == a.to_dict()
+    assert a2.job.id == a.job.id
+
+    n = mock.node()
+    assert Node.from_dict(n.to_dict()).to_dict() == n.to_dict()
+
+    e = mock.eval()
+    assert Evaluation.from_dict(e.to_dict()).to_dict() == e.to_dict()
+
+
+def test_codec_roundtrip():
+    j = mock.job()
+    buf = encode(JOB_REGISTER_REQUEST, {"job": j.to_dict()})
+    t, payload, ignorable = decode(buf)
+    assert t == JOB_REGISTER_REQUEST and not ignorable
+    assert Job.from_dict(payload["job"]).id == j.id
+
+
+def test_codec_ignore_unknown_flag_masked():
+    from nomad_tpu.structs.codec import IGNORE_UNKNOWN_TYPE_FLAG
+    buf = encode(JOB_REGISTER_REQUEST | IGNORE_UNKNOWN_TYPE_FLAG, {})
+    t, _, ignorable = decode(buf)
+    assert t == JOB_REGISTER_REQUEST and ignorable
+
+
+def test_alloc_terminal_is_desired_status_only():
+    from nomad_tpu.structs import ALLOC_CLIENT_STATUS_FAILED
+    a = Allocation(id="1", desired_status=ALLOC_DESIRED_STATUS_RUN,
+                   client_status=ALLOC_CLIENT_STATUS_FAILED)
+    assert not a.terminal_status()
+
+
+def test_as_vector_dims():
+    r = mock.alloc().resources
+    vec = r.as_vector()
+    assert vec[0] == 500 and vec[1] == 256
+    assert vec[4] == 100  # mbits
+    assert vec[5] == 2    # 1 reserved + 1 dynamic port
