@@ -1,0 +1,50 @@
+"""Scheduler layer: pure placement logic behind the State/Planner seams.
+
+Registry carries service/batch/system (sequential, parity-faithful) plus the
+TPU-native jax-binpack backend (registered lazily to keep JAX import optional
+for host-only use).
+"""
+from .interfaces import (  # noqa: F401
+    BUILTIN_SCHEDULERS,
+    Factory,
+    Planner,
+    Scheduler,
+    SetStatusError,
+    State,
+    new_scheduler,
+    register_scheduler,
+)
+from .context import EvalContext  # noqa: F401
+from .generic import (  # noqa: F401
+    GenericScheduler,
+    new_batch_scheduler,
+    new_service_scheduler,
+)
+from .system import SystemScheduler, new_system_scheduler  # noqa: F401
+from .harness import Harness, RejectPlan  # noqa: F401
+from .stack import GenericStack, SystemStack  # noqa: F401
+
+register_scheduler("service", new_service_scheduler)
+register_scheduler("batch", new_batch_scheduler)
+register_scheduler("system", new_system_scheduler)
+
+
+def _register_jax() -> None:
+    try:
+        from .jax_binpack import new_jax_binpack_scheduler
+    except ImportError:  # pragma: no cover - jax always present in CI
+        return
+    register_scheduler("jax-binpack", new_jax_binpack_scheduler)
+
+
+try:
+    import jax  # noqa: F401
+    _HAS_JAX = True
+except Exception:  # pragma: no cover
+    _HAS_JAX = False
+
+if _HAS_JAX:
+    try:
+        _register_jax()
+    except Exception:  # pragma: no cover - keep host plane importable
+        pass

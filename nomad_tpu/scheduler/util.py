@@ -1,0 +1,273 @@
+"""Scheduler utilities: diffing, materialization, update helpers.
+
+Capability parity with /root/reference/scheduler/util.go.
+"""
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from nomad_tpu.structs import (
+    ALLOC_DESIRED_STATUS_RUN,
+    ALLOC_DESIRED_STATUS_STOP,
+    ALLOC_CLIENT_STATUS_PENDING,
+    EVAL_STATUS_FAILED,
+    NODE_STATUS_READY,
+    Allocation,
+    Constraint,
+    Evaluation,
+    Job,
+    Node,
+    Resources,
+    TaskGroup,
+    should_drain_node,
+)
+
+from .interfaces import SetStatusError
+
+ALLOC_NOT_NEEDED = "alloc not needed due to job update"
+ALLOC_MIGRATING = "alloc is being migrated"
+ALLOC_UPDATING = "alloc is being updated due to job update"
+ALLOC_IN_PLACE = "alloc updating in-place"
+
+
+@dataclass
+class AllocTuple:
+    name: str = ""
+    task_group: Optional[TaskGroup] = None
+    alloc: Optional[Allocation] = None
+
+
+@dataclass
+class DiffResult:
+    place: list = field(default_factory=list)
+    update: list = field(default_factory=list)
+    migrate: list = field(default_factory=list)
+    stop: list = field(default_factory=list)
+    ignore: list = field(default_factory=list)
+
+    def append(self, other: "DiffResult") -> None:
+        self.place += other.place
+        self.update += other.update
+        self.migrate += other.migrate
+        self.stop += other.stop
+        self.ignore += other.ignore
+
+
+def materialize_task_groups(job: Optional[Job]) -> dict:
+    """Count-expand task groups to named instances job.tg[i]."""
+    out: dict = {}
+    if job is None:
+        return out
+    for tg in job.task_groups:
+        for i in range(tg.count):
+            out[f"{job.name}.{tg.name}[{i}]"] = tg
+    return out
+
+
+def diff_allocs(job: Optional[Job], tainted_nodes: dict, required: dict,
+                allocs: list) -> DiffResult:
+    """Set-difference target vs existing allocs into five outcome buckets."""
+    result = DiffResult()
+    existing = set()
+    for exist in allocs:
+        name = exist.name
+        existing.add(name)
+        tg = required.get(name)
+        if tg is None:
+            result.stop.append(AllocTuple(name, tg, exist))
+            continue
+        if tainted_nodes.get(exist.node_id):
+            result.migrate.append(AllocTuple(name, tg, exist))
+            continue
+        if job is not None and exist.job is not None and \
+                job.modify_index != exist.job.modify_index:
+            result.update.append(AllocTuple(name, tg, exist))
+            continue
+        result.ignore.append(AllocTuple(name, tg, exist))
+
+    for name, tg in required.items():
+        if name not in existing:
+            result.place.append(AllocTuple(name, tg))
+    return result
+
+
+def diff_system_allocs(job: Job, nodes: list, tainted_nodes: dict,
+                       allocs: list) -> DiffResult:
+    """Per-node diff for system jobs; place tuples carry the target node."""
+    node_allocs: dict = {}
+    for alloc in allocs:
+        node_allocs.setdefault(alloc.node_id, []).append(alloc)
+    for node in nodes:
+        node_allocs.setdefault(node.id, [])
+
+    required = materialize_task_groups(job)
+    result = DiffResult()
+    for node_id, nallocs in node_allocs.items():
+        diff = diff_allocs(job, tainted_nodes, required, nallocs)
+        for tup in diff.place:
+            tup.alloc = Allocation(node_id=node_id)
+        # Migrations don't apply to system jobs: a tainted node just stops.
+        diff.stop += diff.migrate
+        diff.migrate = []
+        result.append(diff)
+    return result
+
+
+def ready_nodes_in_dcs(state, datacenters: list) -> list:
+    dc_set = set(datacenters)
+    out = []
+    for node in state.nodes():
+        if node.status != NODE_STATUS_READY:
+            continue
+        if node.drain:
+            continue
+        if node.datacenter not in dc_set:
+            continue
+        out.append(node)
+    return out
+
+
+def retry_max(max_attempts: int, cb: Callable[[], bool]) -> None:
+    """Run cb until it returns True; raise SetStatusError past the limit."""
+    for _ in range(max_attempts):
+        if cb():
+            return
+    raise SetStatusError(
+        f"maximum attempts reached ({max_attempts})", EVAL_STATUS_FAILED)
+
+
+def tainted_nodes(state, allocs: list) -> dict:
+    """node_id -> must-migrate for every node carrying one of the allocs."""
+    out: dict = {}
+    for alloc in allocs:
+        if alloc.node_id in out:
+            continue
+        node = state.node_by_id(alloc.node_id)
+        if node is None:
+            out[alloc.node_id] = True
+            continue
+        out[alloc.node_id] = should_drain_node(node.status) or node.drain
+    return out
+
+
+def shuffle_nodes(nodes: list, rng=None) -> None:
+    (rng or random).shuffle(nodes)
+
+
+def tasks_updated(a: TaskGroup, b: TaskGroup) -> bool:
+    """Do two task groups differ in a way that forbids in-place update?"""
+    if len(a.tasks) != len(b.tasks):
+        return True
+    for at in a.tasks:
+        bt = b.lookup_task(at.name)
+        if bt is None:
+            return True
+        if at.driver != bt.driver:
+            return True
+        if at.config != bt.config:
+            return True
+        if len(at.resources.networks) != len(bt.resources.networks):
+            return True
+        for an, bn in zip(at.resources.networks, bt.resources.networks):
+            if len(an.dynamic_ports) != len(bn.dynamic_ports):
+                return True
+    return False
+
+
+def set_status(planner, ev: Evaluation, next_eval: Optional[Evaluation],
+               status: str, description: str = "") -> None:
+    new_eval = ev.copy()
+    new_eval.status = status
+    new_eval.status_description = description
+    if next_eval is not None:
+        new_eval.next_eval = next_eval.id
+    planner.update_eval(new_eval)
+
+
+def inplace_update(ctx, ev: Evaluation, job: Job, stack,
+                   updates: list) -> list:
+    """Try to update allocs in place: speculatively evict, re-select on the
+    same node, pop the eviction.  Returns the updates that still need a
+    destructive (evict + place) path."""
+    remaining = []
+    inplace = 0
+    for update in updates:
+        existing_tg = update.alloc.job.lookup_task_group(
+            update.task_group.name) if update.alloc.job else None
+        if existing_tg is None or tasks_updated(update.task_group, existing_tg):
+            remaining.append(update)
+            continue
+
+        node = ctx.state().node_by_id(update.alloc.node_id)
+        if node is None:
+            remaining.append(update)
+            continue
+
+        stack.set_nodes([node])
+        # Stage an eviction so current usage is discounted during selection.
+        ctx.plan().append_update(update.alloc, ALLOC_DESIRED_STATUS_STOP,
+                                ALLOC_IN_PLACE)
+        option, size = stack.select(update.task_group)
+        ctx.plan().pop_update(update.alloc)
+
+        if option is None:
+            remaining.append(update)
+            continue
+
+        # Network assignments are immutable across in-place updates.
+        for task_name, resources in option.task_resources.items():
+            existing_res = update.alloc.task_resources.get(task_name)
+            if existing_res is not None:
+                resources.networks = existing_res.networks
+
+        new_alloc = update.alloc.copy()
+        new_alloc.eval_id = ev.id
+        new_alloc.job = job
+        new_alloc.resources = size
+        new_alloc.task_resources = option.task_resources
+        new_alloc.metrics = ctx.metrics()
+        new_alloc.desired_status = ALLOC_DESIRED_STATUS_RUN
+        new_alloc.desired_description = ""
+        new_alloc.client_status = ALLOC_CLIENT_STATUS_PENDING
+        ctx.plan().append_alloc(new_alloc)
+        inplace += 1
+    return remaining
+
+
+def evict_and_place(ctx, diff: DiffResult, allocs: list, desc: str,
+                    limit: list) -> bool:
+    """Evict up to limit[0] allocs and queue replacements; True if limited.
+
+    limit is a single-element list to emulate the reference's by-pointer
+    rolling-update budget shared across migrate + update passes.
+    """
+    n = len(allocs)
+    for i in range(min(n, limit[0])):
+        a = allocs[i]
+        ctx.plan().append_update(a.alloc, ALLOC_DESIRED_STATUS_STOP, desc)
+        diff.place.append(a)
+    if n <= limit[0]:
+        limit[0] -= n
+        return False
+    limit[0] = 0
+    return True
+
+
+@dataclass
+class TGConstraintTuple:
+    constraints: list = field(default_factory=list)
+    drivers: set = field(default_factory=set)
+    size: Resources = field(default_factory=Resources)
+
+
+def task_group_constraints(tg: TaskGroup) -> TGConstraintTuple:
+    """Aggregate a task group's constraints, drivers and total resources."""
+    c = TGConstraintTuple()
+    c.constraints += tg.constraints
+    for task in tg.tasks:
+        c.drivers.add(task.driver)
+        c.constraints += task.constraints
+        c.size.add(task.resources)
+    return c

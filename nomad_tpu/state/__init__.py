@@ -1,0 +1,1 @@
+from .store import StateRestore, StateSnapshot, StateStore, StateWatch  # noqa: F401

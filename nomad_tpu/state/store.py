@@ -1,0 +1,457 @@
+"""MVCC in-memory state store with O(1) snapshots.
+
+Capability parity with /root/reference/nomad/state/state_store.go (go-memdb
+immutable-radix MVCC): tables ``index, nodes, jobs, evals, allocs``; per-table
+raft-index bookkeeping; secondary indexes (allocs by node/job/eval, evals by
+job); snapshot in O(1); change notification for blocking queries.
+
+Implementation is copy-on-write at table granularity instead of radix trees:
+a snapshot freezes the current table dicts; the first write to a table after a
+snapshot copies that table's dict (and the touched secondary-index buckets).
+The store never mutates an object in place — every upsert stores a copy and
+every reader must treat returned objects as immutable, exactly the contract
+the reference documents (state_store.go:17-19).
+
+The store is also the source feeding the device-resident fleet tensors: it
+exposes a monotonically increasing per-table index that the state->HBM bridge
+uses as its RefreshIndex-style fence (see nomad_tpu/models/fleet.py).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable, Optional
+
+from nomad_tpu.structs import (
+    Allocation,
+    Evaluation,
+    Job,
+    Node,
+    valid_node_status,
+)
+
+TABLES = ("nodes", "jobs", "evals", "allocs")
+
+
+class StateWatch:
+    """Notify-on-change groups keyed by arbitrary hashable keys.
+
+    Parity role: nomad/state/notify.go NotifyGroup — blocking queries
+    register an event on keys like ("allocs",) or ("alloc-node", node_id)
+    and are woken when a write touches the key.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._groups: dict = {}
+
+    def watch(self, key) -> threading.Event:
+        ev = threading.Event()
+        with self._lock:
+            self._groups.setdefault(key, set()).add(ev)
+        return ev
+
+    def stop_watch(self, key, ev: threading.Event) -> None:
+        with self._lock:
+            group = self._groups.get(key)
+            if group is not None:
+                group.discard(ev)
+                if not group:
+                    self._groups.pop(key, None)
+
+    def notify(self, *keys) -> None:
+        with self._lock:
+            for key in keys:
+                group = self._groups.pop(key, None)
+                if group:
+                    for ev in group:
+                        ev.set()
+
+    def notify_all(self) -> None:
+        """Wake every watcher — used when the whole world may have changed
+        (snapshot restore)."""
+        with self._lock:
+            groups, self._groups = self._groups, {}
+        for group in groups.values():
+            for ev in group:
+                ev.set()
+
+
+class _Tables:
+    """One immutable-once-shared generation of all table + index dicts."""
+
+    __slots__ = ("tables", "indexes", "allocs_by_node", "allocs_by_job",
+                 "allocs_by_eval", "evals_by_job")
+
+    def __init__(self) -> None:
+        self.tables = {name: {} for name in TABLES}
+        self.indexes = {name: 0 for name in TABLES}
+        self.allocs_by_node: dict = {}
+        self.allocs_by_job: dict = {}
+        self.allocs_by_eval: dict = {}
+        self.evals_by_job: dict = {}
+
+    def clone(self) -> "_Tables":
+        new = _Tables.__new__(_Tables)
+        new.tables = {k: v for k, v in self.tables.items()}
+        new.indexes = dict(self.indexes)
+        new.allocs_by_node = self.allocs_by_node
+        new.allocs_by_job = self.allocs_by_job
+        new.allocs_by_eval = self.allocs_by_eval
+        new.evals_by_job = self.evals_by_job
+        return new
+
+
+class _ReadMixin:
+    """Shared read API between the live store and snapshots."""
+
+    _t: _Tables
+
+    # -- nodes ------------------------------------------------------------
+    def node_by_id(self, node_id: str) -> Optional[Node]:
+        return self._t.tables["nodes"].get(node_id)
+
+    def nodes(self) -> Iterable[Node]:
+        return list(self._t.tables["nodes"].values())
+
+    # -- jobs -------------------------------------------------------------
+    def job_by_id(self, job_id: str) -> Optional[Job]:
+        return self._t.tables["jobs"].get(job_id)
+
+    def jobs(self) -> Iterable[Job]:
+        return list(self._t.tables["jobs"].values())
+
+    def jobs_by_scheduler(self, sched_type: str) -> list:
+        return [j for j in self._t.tables["jobs"].values()
+                if j.type == sched_type]
+
+    # -- evals ------------------------------------------------------------
+    def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
+        return self._t.tables["evals"].get(eval_id)
+
+    def evals(self) -> Iterable[Evaluation]:
+        return list(self._t.tables["evals"].values())
+
+    def evals_by_job(self, job_id: str) -> list:
+        table = self._t.tables["evals"]
+        return [table[i] for i in self._t.evals_by_job.get(job_id, ())]
+
+    # -- allocs -----------------------------------------------------------
+    def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
+        return self._t.tables["allocs"].get(alloc_id)
+
+    def allocs(self) -> Iterable[Allocation]:
+        return list(self._t.tables["allocs"].values())
+
+    def allocs_by_node(self, node_id: str) -> list:
+        table = self._t.tables["allocs"]
+        return [table[i] for i in self._t.allocs_by_node.get(node_id, ())]
+
+    def allocs_by_job(self, job_id: str) -> list:
+        table = self._t.tables["allocs"]
+        return [table[i] for i in self._t.allocs_by_job.get(job_id, ())]
+
+    def allocs_by_eval(self, eval_id: str) -> list:
+        table = self._t.tables["allocs"]
+        return [table[i] for i in self._t.allocs_by_eval.get(eval_id, ())]
+
+    # -- indexes ----------------------------------------------------------
+    def get_index(self, table: str) -> int:
+        return self._t.indexes.get(table, 0)
+
+    def latest_index(self) -> int:
+        return max(self._t.indexes.values(), default=0)
+
+
+class StateSnapshot(_ReadMixin):
+    """A frozen point-in-time view of the store (O(1) to create)."""
+
+    def __init__(self, tables: _Tables) -> None:
+        self._t = tables
+
+
+class StateStore(_ReadMixin):
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._t = _Tables()
+        self._gen_shared = False    # generation container shared w/ snapshot
+        self._shared: set = set()   # table names shared with a snapshot
+        self._idx_shared = set()    # secondary index names shared
+        self.watch = StateWatch()
+
+    # -- snapshot / restore ----------------------------------------------
+    def snapshot(self) -> StateSnapshot:
+        with self._lock:
+            self._gen_shared = True
+            self._shared = set(TABLES)
+            self._idx_shared = {"allocs_by_node", "allocs_by_job",
+                                "allocs_by_eval", "evals_by_job"}
+            return StateSnapshot(self._t)
+
+    def restore(self) -> "StateRestore":
+        """Bulk-load rig used by FSM snapshot restore: stage into a fresh
+        generation, swap atomically on commit."""
+        return StateRestore(self)
+
+    # -- write plumbing ---------------------------------------------------
+    def _writable_table(self, name: str) -> dict:
+        if self._gen_shared:
+            self._t = self._t.clone()
+            self._gen_shared = False
+        if name in self._shared:
+            self._t.tables[name] = dict(self._t.tables[name])
+            self._shared.discard(name)
+        return self._t.tables[name]
+
+    def _writable_index(self, name: str) -> dict:
+        if self._gen_shared:
+            self._t = self._t.clone()
+            self._gen_shared = False
+        if name in self._idx_shared:
+            setattr(self._t, name, dict(getattr(self._t, name)))
+            self._idx_shared.discard(name)
+        return getattr(self._t, name)
+
+    @staticmethod
+    def _index_add(idx: dict, key: str, item_id: str) -> None:
+        bucket = idx.get(key)
+        bucket = set() if bucket is None else set(bucket)
+        bucket.add(item_id)
+        idx[key] = bucket
+
+    @staticmethod
+    def _index_remove(idx: dict, key: str, item_id: str) -> None:
+        bucket = idx.get(key)
+        if bucket is None:
+            return
+        bucket = set(bucket)
+        bucket.discard(item_id)
+        if bucket:
+            idx[key] = bucket
+        else:
+            idx.pop(key, None)
+
+    def _bump(self, table: str, index: int) -> None:
+        self._t.indexes[table] = index
+
+    # -- nodes ------------------------------------------------------------
+    def upsert_node(self, index: int, node: Node) -> None:
+        with self._lock:
+            table = self._writable_table("nodes")
+            existing = table.get(node.id)
+            new = node.copy()
+            if existing is not None:
+                new.create_index = existing.create_index
+            else:
+                new.create_index = index
+            new.modify_index = index
+            table[new.id] = new
+            self._bump("nodes", index)
+        self.watch.notify(("nodes",), ("node", node.id))
+
+    def delete_node(self, index: int, node_id: str) -> None:
+        with self._lock:
+            table = self._writable_table("nodes")
+            if node_id not in table:
+                raise KeyError(f"node not found: {node_id}")
+            del table[node_id]
+            self._bump("nodes", index)
+        self.watch.notify(("nodes",), ("node", node_id))
+
+    def update_node_status(self, index: int, node_id: str,
+                           status: str) -> None:
+        if not valid_node_status(status):
+            raise ValueError(f"invalid node status {status!r}")
+        with self._lock:
+            table = self._writable_table("nodes")
+            existing = table.get(node_id)
+            if existing is None:
+                raise KeyError(f"node not found: {node_id}")
+            new = existing.copy()
+            new.status = status
+            new.modify_index = index
+            table[node_id] = new
+            self._bump("nodes", index)
+        self.watch.notify(("nodes",), ("node", node_id))
+
+    def update_node_drain(self, index: int, node_id: str,
+                          drain: bool) -> None:
+        with self._lock:
+            table = self._writable_table("nodes")
+            existing = table.get(node_id)
+            if existing is None:
+                raise KeyError(f"node not found: {node_id}")
+            new = existing.copy()
+            new.drain = drain
+            new.modify_index = index
+            table[node_id] = new
+            self._bump("nodes", index)
+        self.watch.notify(("nodes",), ("node", node_id))
+
+    # -- jobs -------------------------------------------------------------
+    def upsert_job(self, index: int, job: Job) -> None:
+        with self._lock:
+            table = self._writable_table("jobs")
+            existing = table.get(job.id)
+            new = job.copy()
+            if existing is not None:
+                new.create_index = existing.create_index
+            else:
+                new.create_index = index
+            new.modify_index = index
+            table[new.id] = new
+            self._bump("jobs", index)
+        self.watch.notify(("jobs",), ("job", job.id))
+
+    def delete_job(self, index: int, job_id: str) -> None:
+        with self._lock:
+            table = self._writable_table("jobs")
+            if job_id not in table:
+                raise KeyError(f"job not found: {job_id}")
+            del table[job_id]
+            self._bump("jobs", index)
+        self.watch.notify(("jobs",), ("job", job_id))
+
+    # -- evals ------------------------------------------------------------
+    def upsert_evals(self, index: int, evals: list) -> None:
+        with self._lock:
+            table = self._writable_table("evals")
+            by_job = self._writable_index("evals_by_job")
+            for ev in evals:
+                existing = table.get(ev.id)
+                new = ev.copy()
+                if existing is not None:
+                    new.create_index = existing.create_index
+                else:
+                    new.create_index = index
+                new.modify_index = index
+                table[new.id] = new
+                self._index_add(by_job, new.job_id, new.id)
+            self._bump("evals", index)
+        self.watch.notify(("evals",))
+
+    def delete_eval(self, index: int, eval_ids: list,
+                    alloc_ids: list) -> None:
+        """Reap evals + allocs in one txn (reference: Eval.Reap)."""
+        touched_nodes = []
+        with self._lock:
+            evals = self._writable_table("evals")
+            by_job = self._writable_index("evals_by_job")
+            for eid in eval_ids:
+                ev = evals.pop(eid, None)
+                if ev is not None:
+                    self._index_remove(by_job, ev.job_id, eid)
+            allocs = self._writable_table("allocs")
+            a_node = self._writable_index("allocs_by_node")
+            a_job = self._writable_index("allocs_by_job")
+            a_eval = self._writable_index("allocs_by_eval")
+            for aid in alloc_ids:
+                alloc = allocs.pop(aid, None)
+                if alloc is not None:
+                    self._index_remove(a_node, alloc.node_id, aid)
+                    self._index_remove(a_job, alloc.job_id, aid)
+                    self._index_remove(a_eval, alloc.eval_id, aid)
+                    touched_nodes.append(alloc.node_id)
+            self._bump("evals", index)
+            self._bump("allocs", index)
+        keys = [("evals",), ("allocs",)]
+        keys += [("alloc-node", n) for n in set(touched_nodes)]
+        self.watch.notify(*keys)
+
+    # -- allocs -----------------------------------------------------------
+    def upsert_allocs(self, index: int, allocs: list) -> None:
+        """Scheduler/plan-authoritative write: preserves client-owned fields
+        of any existing alloc (reference: state_store.go:601-637)."""
+        touched_nodes = []
+        with self._lock:
+            table = self._writable_table("allocs")
+            a_node = self._writable_index("allocs_by_node")
+            a_job = self._writable_index("allocs_by_job")
+            a_eval = self._writable_index("allocs_by_eval")
+            for alloc in allocs:
+                existing = table.get(alloc.id)
+                new = alloc.copy()
+                if existing is not None:
+                    new.create_index = existing.create_index
+                    new.client_status = existing.client_status
+                    new.client_description = existing.client_description
+                    new.task_states = existing.task_states
+                    self._index_remove(a_node, existing.node_id, alloc.id)
+                else:
+                    new.create_index = index
+                new.modify_index = index
+                table[new.id] = new
+                self._index_add(a_node, new.node_id, new.id)
+                self._index_add(a_job, new.job_id, new.id)
+                if new.eval_id:
+                    self._index_add(a_eval, new.eval_id, new.id)
+                touched_nodes.append(new.node_id)
+            self._bump("allocs", index)
+        keys = [("allocs",)] + [("alloc-node", n) for n in set(touched_nodes)]
+        self.watch.notify(*keys)
+
+    def update_alloc_from_client(self, index: int,
+                                 alloc: Allocation) -> None:
+        """Client-authoritative merge: only client status fields move
+        (reference: state_store.go:556-597)."""
+        with self._lock:
+            table = self._writable_table("allocs")
+            existing = table.get(alloc.id)
+            if existing is None:
+                raise KeyError(f"alloc not found: {alloc.id}")
+            new = existing.copy()
+            new.client_status = alloc.client_status
+            new.client_description = alloc.client_description
+            new.task_states = dict(alloc.task_states)
+            new.modify_index = index
+            table[new.id] = new
+            self._bump("allocs", index)
+        self.watch.notify(("allocs",), ("alloc-node", alloc.node_id))
+
+
+class StateRestore:
+    """Accumulates objects into a fresh generation, swapped in atomically.
+
+    Parity role: state_store.go StateRestore / fsm.go Restore — snapshot
+    restore rebuilds the whole store in one transaction.
+    """
+
+    def __init__(self, store: StateStore) -> None:
+        self._store = store
+        self._t = _Tables()
+
+    def node_restore(self, node: Node) -> None:
+        self._t.tables["nodes"][node.id] = node
+        self._t.indexes["nodes"] = max(self._t.indexes["nodes"],
+                                       node.modify_index)
+
+    def job_restore(self, job: Job) -> None:
+        self._t.tables["jobs"][job.id] = job
+        self._t.indexes["jobs"] = max(self._t.indexes["jobs"],
+                                      job.modify_index)
+
+    def eval_restore(self, ev: Evaluation) -> None:
+        self._t.tables["evals"][ev.id] = ev
+        self._t.indexes["evals"] = max(self._t.indexes["evals"],
+                                       ev.modify_index)
+        StateStore._index_add(self._t.evals_by_job, ev.job_id, ev.id)
+
+    def alloc_restore(self, alloc: Allocation) -> None:
+        self._t.tables["allocs"][alloc.id] = alloc
+        self._t.indexes["allocs"] = max(self._t.indexes["allocs"],
+                                        alloc.modify_index)
+        StateStore._index_add(self._t.allocs_by_node, alloc.node_id, alloc.id)
+        StateStore._index_add(self._t.allocs_by_job, alloc.job_id, alloc.id)
+        if alloc.eval_id:
+            StateStore._index_add(self._t.allocs_by_eval, alloc.eval_id,
+                                  alloc.id)
+
+    def index_restore(self, table: str, index: int) -> None:
+        self._t.indexes[table] = index
+
+    def commit(self) -> None:
+        with self._store._lock:
+            self._store._t = self._t
+            self._store._gen_shared = False
+            self._store._shared = set()
+            self._store._idx_shared = set()
+        self._store.watch.notify_all()

@@ -1,0 +1,571 @@
+"""Scheduler unit tests via the Harness rig.
+
+Parity targets: /root/reference/scheduler/{generic_sched,system_sched,
+feasible,rank,select,stack,util}_test.go.
+"""
+import random
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler import (
+    EvalContext,
+    Harness,
+    RejectPlan,
+    new_scheduler,
+)
+from nomad_tpu.scheduler.feasible import (
+    ConstraintIterator,
+    DriverIterator,
+    StaticIterator,
+    check_constraint_values,
+    resolve_constraint_target,
+)
+from nomad_tpu.scheduler.rank import (
+    BinPackIterator,
+    JobAntiAffinityIterator,
+    RankedNode,
+    StaticRankIterator,
+)
+from nomad_tpu.scheduler.select import LimitIterator, MaxScoreIterator
+from nomad_tpu.scheduler.util import (
+    diff_allocs,
+    diff_system_allocs,
+    evict_and_place,
+    materialize_task_groups,
+    tainted_nodes,
+    tasks_updated,
+)
+from nomad_tpu.scheduler.versions import check_constraint, encode_version
+from nomad_tpu.structs import (
+    ALLOC_DESIRED_STATUS_RUN,
+    ALLOC_DESIRED_STATUS_STOP,
+    EVAL_STATUS_COMPLETE,
+    EVAL_STATUS_FAILED,
+    EVAL_TRIGGER_JOB_REGISTER,
+    EVAL_TRIGGER_NODE_UPDATE,
+    NODE_STATUS_DOWN,
+    Constraint,
+    Evaluation,
+    Plan,
+    Resources,
+    generate_uuid,
+)
+
+
+def make_eval(job, triggered_by=EVAL_TRIGGER_JOB_REGISTER):
+    return Evaluation(
+        id=generate_uuid(),
+        priority=job.priority,
+        type=job.type,
+        triggered_by=triggered_by,
+        job_id=job.id,
+        status="pending",
+    )
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: GenericScheduler
+# ---------------------------------------------------------------------------
+
+def test_service_sched_register_places_all():
+    """10 ready nodes + count=10 service job -> 10 placements, spread out."""
+    h = Harness()
+    for i in range(10):
+        h.state.upsert_node(h.next_index(), mock.node(i))
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+
+    ev = make_eval(job)
+    h.process("service", ev)
+
+    assert len(h.plans) == 1
+    plan = h.plans[0]
+    placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+    assert len(placed) == 10
+    assert not plan.failed_allocs
+    # anti-affinity should spread 10 allocs over 10 nodes
+    assert len(plan.node_allocation) > 1
+    # eval marked complete
+    assert h.evals[-1].status == EVAL_STATUS_COMPLETE
+    # state applied
+    assert len(h.state.allocs_by_job(job.id)) == 10
+    for a in placed:
+        assert a.metrics.nodes_evaluated > 0
+
+
+def test_service_sched_no_nodes_fails_allocs():
+    h = Harness()
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    ev = make_eval(job)
+    h.process("service", ev)
+
+    plan = h.plans[0]
+    assert not plan.node_allocation
+    # failures coalesce into a single failed alloc
+    assert len(plan.failed_allocs) == 1
+    assert plan.failed_allocs[0].metrics.coalesced_failures == 9
+    assert h.evals[-1].status == EVAL_STATUS_COMPLETE
+
+
+def test_service_sched_ignores_unknown_trigger():
+    h = Harness()
+    job = mock.job()
+    ev = make_eval(job, triggered_by="bogus")
+    h.process("service", ev)
+    assert h.plans == []
+    assert h.evals[-1].status == EVAL_STATUS_FAILED
+
+
+def test_service_sched_job_deregistered_stops_allocs():
+    h = Harness()
+    job = mock.job()
+    for i in range(4):
+        h.state.upsert_node(h.next_index(), mock.node(i))
+    # Existing allocs for a job that no longer exists in state
+    nodes = list(h.state.nodes())
+    allocs = []
+    for i, n in enumerate(nodes):
+        a = mock.alloc()
+        a.job = job
+        a.job_id = job.id
+        a.node_id = n.id
+        a.name = f"my-job.web[{i}]"
+        allocs.append(a)
+    h.state.upsert_allocs(h.next_index(), allocs)
+
+    ev = make_eval(job)
+    h.process("service", ev)
+    plan = h.plans[0]
+    stopped = [a for ups in plan.node_update.values() for a in ups]
+    assert len(stopped) == 4
+    assert all(a.desired_status == ALLOC_DESIRED_STATUS_STOP for a in stopped)
+
+
+def test_service_sched_node_down_migrates():
+    h = Harness()
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    nodes = [mock.node(i) for i in range(11)]
+    for n in nodes:
+        h.state.upsert_node(h.next_index(), n)
+
+    allocs = []
+    for i in range(10):
+        a = mock.alloc()
+        a.job = h.state.job_by_id(job.id)
+        a.job_id = job.id
+        a.node_id = nodes[0].id if i == 0 else nodes[i].id
+        a.name = f"my-job.web[{i}]"
+        allocs.append(a)
+    h.state.upsert_allocs(h.next_index(), allocs)
+
+    h.state.update_node_status(h.next_index(), nodes[0].id, NODE_STATUS_DOWN)
+    ev = make_eval(job, EVAL_TRIGGER_NODE_UPDATE)
+    h.process("service", ev)
+
+    plan = h.plans[0]
+    stopped = [a for ups in plan.node_update.values() for a in ups]
+    placed = [a for allocs_ in plan.node_allocation.values() for a in allocs_]
+    assert len(stopped) == 1  # the alloc on the dead node
+    assert len(placed) == 1   # replaced elsewhere
+    assert nodes[0].id not in plan.node_allocation
+
+
+def test_service_sched_retry_on_rejected_plans():
+    h = Harness()
+    for i in range(2):
+        h.state.upsert_node(h.next_index(), mock.node(i))
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    h.planner = RejectPlan(h)
+
+    ev = make_eval(job)
+    h.process("service", ev)
+    # 5 attempts then eval failed
+    assert len(h.plans) == 5
+    assert h.evals[-1].status == EVAL_STATUS_FAILED
+
+
+def test_batch_sched_retry_limit_is_two():
+    h = Harness()
+    h.state.upsert_node(h.next_index(), mock.node())
+    job = mock.job()
+    job.type = "batch"
+    h.state.upsert_job(h.next_index(), job)
+    h.planner = RejectPlan(h)
+    ev = make_eval(job)
+    ev.type = "batch"
+    h.process("batch", ev)
+    assert len(h.plans) == 2
+    assert h.evals[-1].status == EVAL_STATUS_FAILED
+
+
+def test_service_sched_inplace_update():
+    """Job modify-index bump w/o task changes -> in-place update, no evict."""
+    h = Harness()
+    nodes = [mock.node(i) for i in range(4)]
+    for n in nodes:
+        h.state.upsert_node(h.next_index(), n)
+    job = mock.job()
+    job.task_groups[0].count = 4
+    h.state.upsert_job(h.next_index(), job)
+
+    old_job = job.copy()
+    old_job.modify_index = 1  # existing allocs made against older version
+    allocs = []
+    for i, n in enumerate(nodes):
+        a = mock.alloc()
+        a.job = old_job
+        a.job_id = job.id
+        a.node_id = n.id
+        a.name = f"my-job.web[{i}]"
+        allocs.append(a)
+    h.state.upsert_allocs(h.next_index(), allocs)
+
+    h.process("service", make_eval(job))
+    plan = h.plans[0]
+    placed = [a for al in plan.node_allocation.values() for a in al]
+    # all in-place: no evictions, every placement stays on its node
+    assert not plan.node_update
+    assert len(placed) == 4
+    assert {a.node_id for a in placed} == {n.id for n in nodes}
+    current = h.state.job_by_id(job.id)
+    assert all(a.job.modify_index == current.modify_index for a in placed)
+
+
+def test_service_sched_rolling_update_limit():
+    """Destructive updates throttled by update.max_parallel + next eval."""
+    h = Harness()
+    nodes = [mock.node(i) for i in range(6)]
+    for n in nodes:
+        h.state.upsert_node(h.next_index(), n)
+    job = mock.job()
+    job.task_groups[0].count = 6
+    job.update.stagger = 30.0
+    job.update.max_parallel = 2
+    # Change the task config so updates are destructive
+    job.task_groups[0].tasks[0].config = {"command": "/bin/sleep"}
+    h.state.upsert_job(h.next_index(), job)
+
+    old_job = job.copy()
+    old_job.modify_index = 1
+    old_job.task_groups[0].tasks[0].config = {"command": "/bin/date"}
+    allocs = []
+    for i, n in enumerate(nodes):
+        a = mock.alloc()
+        a.job = old_job
+        a.job_id = job.id
+        a.node_id = n.id
+        a.name = f"my-job.web[{i}]"
+        allocs.append(a)
+    h.state.upsert_allocs(h.next_index(), allocs)
+
+    h.process("service", make_eval(job))
+    plan = h.plans[0]
+    stopped = [a for ups in plan.node_update.values() for a in ups]
+    assert len(stopped) == 2  # max_parallel
+    assert len(h.create_evals) == 1  # rolling follow-up eval
+    assert h.create_evals[0].wait == 30.0
+    assert h.evals[-1].next_eval == h.create_evals[0].id
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: SystemScheduler
+# ---------------------------------------------------------------------------
+
+def test_system_sched_places_on_all_nodes():
+    h = Harness()
+    nodes = [mock.node(i) for i in range(10)]
+    for n in nodes:
+        h.state.upsert_node(h.next_index(), n)
+    job = mock.system_job()
+    h.state.upsert_job(h.next_index(), job)
+
+    ev = make_eval(job)
+    ev.type = "system"
+    h.process("system", ev)
+
+    plan = h.plans[0]
+    placed = [a for al in plan.node_allocation.values() for a in al]
+    assert len(placed) == 10
+    assert len(plan.node_allocation) == 10  # one per node
+    assert h.evals[-1].status == EVAL_STATUS_COMPLETE
+
+
+def test_system_sched_node_down_stops():
+    h = Harness()
+    nodes = [mock.node(i) for i in range(3)]
+    for n in nodes:
+        h.state.upsert_node(h.next_index(), n)
+    job = mock.system_job()
+    h.state.upsert_job(h.next_index(), job)
+
+    allocs = []
+    for i, n in enumerate(nodes):
+        a = mock.alloc()
+        a.job = h.state.job_by_id(job.id)
+        a.job_id = job.id
+        a.node_id = n.id
+        a.name = f"my-job.web[0]"
+        allocs.append(a)
+    h.state.upsert_allocs(h.next_index(), allocs)
+    h.state.update_node_status(h.next_index(), nodes[0].id, NODE_STATUS_DOWN)
+
+    ev = make_eval(job, EVAL_TRIGGER_NODE_UPDATE)
+    ev.type = "system"
+    h.process("system", ev)
+    plan = h.plans[0]
+    stopped = [a for ups in plan.node_update.values() for a in ups]
+    # system jobs stop (not migrate) on down nodes
+    assert len(stopped) == 1
+    assert list(plan.node_update) == [nodes[0].id]
+
+
+# ---------------------------------------------------------------------------
+# Iterators
+# ---------------------------------------------------------------------------
+
+def _ctx():
+    h = Harness()
+    return h, EvalContext(h.state.snapshot(), Plan())
+
+
+def test_static_iterator_visits_all_once():
+    h, ctx = _ctx()
+    nodes = [mock.node(i) for i in range(3)]
+    it = StaticIterator(ctx, nodes)
+    out = []
+    while (n := it.next()) is not None:
+        out.append(n)
+    assert out == nodes
+    assert ctx.metrics().nodes_evaluated == 3
+
+
+def test_driver_iterator_filters():
+    h, ctx = _ctx()
+    good, bad, invalid = mock.node(), mock.node(), mock.node()
+    del bad.attributes["driver.exec"]
+    invalid.attributes["driver.exec"] = "false"
+    it = DriverIterator(ctx, StaticIterator(ctx, [good, bad, invalid]),
+                        ["exec"])
+    out = []
+    while (n := it.next()) is not None:
+        out.append(n)
+    assert out == [good]
+    assert ctx.metrics().nodes_filtered == 2
+
+
+def test_constraint_iterator_ops():
+    h, ctx = _ctx()
+    n = mock.node()
+    cases = [
+        (Constraint(l_target="$attr.kernel.name", r_target="linux",
+                    operand="="), True),
+        (Constraint(l_target="$attr.kernel.name", r_target="darwin",
+                    operand="!="), True),
+        (Constraint(l_target="$node.datacenter", r_target="dc1",
+                    operand="="), True),
+        (Constraint(l_target="$attr.version", r_target=">= 0.1.0, < 1.0",
+                    operand="version"), True),
+        (Constraint(l_target="$attr.version", r_target=">= 1.2",
+                    operand="version"), False),
+        (Constraint(l_target="$attr.kernel.name", r_target="^lin",
+                    operand="regexp"), True),
+        (Constraint(l_target="$attr.missing", r_target="x", operand="="),
+         False),
+        (Constraint(l_target="$meta.pci-dss", r_target="true", operand="="),
+         True),
+        (Constraint(l_target="bar", r_target="foo", operand="<"), True),
+        (Constraint(l_target="foo", r_target="bar", operand="<"), False),
+    ]
+    for c, expected in cases:
+        it = ConstraintIterator(ctx, StaticIterator(ctx, [n]), [c])
+        got = it.next() is not None
+        assert got == expected, f"{c} -> {got}, want {expected}"
+
+
+def test_soft_constraints_pass():
+    h, ctx = _ctx()
+    n = mock.node()
+    c = Constraint(hard=False, l_target="$attr.missing", r_target="x",
+                   operand="=", weight=5)
+    it = ConstraintIterator(ctx, StaticIterator(ctx, [n]), [c])
+    assert it.next() is not None
+
+
+def test_binpack_scores_and_skips_overfull():
+    h, ctx = _ctx()
+    empty = mock.node(1)
+    full_node = mock.node(2)
+    full_node.resources = Resources(cpu=600, memory_mb=300,
+                                    networks=full_node.resources.networks)
+    full_node.reserved = None
+    task = mock.job().task_groups[0].tasks[0]
+    task = task.copy()
+    task.resources.networks = []  # pure cpu/mem packing
+
+    src = StaticRankIterator(ctx, [RankedNode(empty), RankedNode(full_node)])
+    it = BinPackIterator(ctx, src)
+    it.set_tasks([task])
+    out = []
+    while (o := it.next()) is not None:
+        out.append(o)
+    assert [o.node.id for o in out] == [empty.id, full_node.id]
+    # the nearly-full node gets the better (higher) binpack score
+    assert out[1].score > out[0].score
+
+
+def test_job_anti_affinity_penalty():
+    h, ctx = _ctx()
+    n = mock.node()
+    a = mock.alloc()
+    a.node_id = n.id
+    h.state.upsert_allocs(h.next_index(), [a])
+    ctx.set_state(h.state.snapshot())
+
+    src = StaticRankIterator(ctx, [RankedNode(n)])
+    it = JobAntiAffinityIterator(ctx, src, 10.0, a.job_id)
+    out = it.next()
+    assert out.score == -10.0
+
+
+def test_limit_and_max_score():
+    h, ctx = _ctx()
+    rn = [RankedNode(mock.node(i)) for i in range(5)]
+    for i, r in enumerate(rn):
+        r.score = float(i)
+    it = LimitIterator(ctx, StaticRankIterator(ctx, rn), 3)
+    ms = MaxScoreIterator(ctx, it)
+    best = ms.next()
+    assert best.score == 2.0  # only first 3 scanned
+    assert ms.next() is None
+
+
+def test_distinct_hosts_constraint():
+    h = Harness()
+    nodes = [mock.node(i) for i in range(3)]
+    for n in nodes:
+        h.state.upsert_node(h.next_index(), n)
+    job = mock.job()
+    job.task_groups[0].count = 3
+    job.constraints.append(Constraint(operand="distinct_hosts"))
+    h.state.upsert_job(h.next_index(), job)
+
+    h.process("service", make_eval(job))
+    plan = h.plans[0]
+    placed = [a for al in plan.node_allocation.values() for a in al]
+    assert len(placed) == 3
+    # strictly one per node
+    assert all(len(al) == 1 for al in plan.node_allocation.values())
+
+
+# ---------------------------------------------------------------------------
+# Utils
+# ---------------------------------------------------------------------------
+
+def test_materialize_task_groups():
+    job = mock.job()
+    out = materialize_task_groups(job)
+    assert len(out) == 10
+    assert "my-job.web[0]" in out and "my-job.web[9]" in out
+    assert materialize_task_groups(None) == {}
+
+
+def test_diff_allocs_buckets():
+    job = mock.job()
+    required = materialize_task_groups(job)
+
+    def named_alloc(name, node="n1", stale=False):
+        a = mock.alloc()
+        a.name = name
+        a.node_id = node
+        a.job = job.copy()
+        if stale:
+            a.job.modify_index = 1
+        return a
+
+    allocs = [
+        named_alloc("my-job.web[0]"),                   # ignore
+        named_alloc("my-job.web[1]", node="tainted"),   # migrate
+        named_alloc("my-job.web[2]", stale=True),       # update
+        named_alloc("not-needed[0]"),                   # stop
+    ]
+    d = diff_allocs(job, {"tainted": True}, required, allocs)
+    assert [t.name for t in d.ignore] == ["my-job.web[0]"]
+    assert [t.name for t in d.migrate] == ["my-job.web[1]"]
+    assert [t.name for t in d.update] == ["my-job.web[2]"]
+    assert [t.name for t in d.stop] == ["not-needed[0]"]
+    assert len(d.place) == 7  # web[3..9]
+
+
+def test_diff_system_allocs_marks_node():
+    job = mock.system_job()
+    nodes = [mock.node(i) for i in range(2)]
+    d = diff_system_allocs(job, nodes, {}, [])
+    assert len(d.place) == 2
+    assert {t.alloc.node_id for t in d.place} == {n.id for n in nodes}
+
+
+def test_tainted_nodes():
+    h = Harness()
+    n = mock.node()
+    h.state.upsert_node(h.next_index(), n)
+    a1, a2 = mock.alloc(), mock.alloc()
+    a1.node_id = n.id
+    a2.node_id = "missing-node"
+    out = tainted_nodes(h.state, [a1, a2])
+    assert out == {n.id: False, "missing-node": True}
+
+
+def test_tasks_updated():
+    a = mock.job().task_groups[0]
+    b = mock.job().task_groups[0]
+    assert not tasks_updated(a, b)
+    b2 = b.copy()
+    b2.tasks[0].driver = "docker"
+    assert tasks_updated(a, b2)
+    b3 = b.copy()
+    b3.tasks[0].config = {"command": "/bin/other"}
+    assert tasks_updated(a, b3)
+
+
+def test_evict_and_place_limit():
+    h, ctx = _ctx()
+    from nomad_tpu.scheduler.util import AllocTuple, DiffResult
+
+    allocs = []
+    for i in range(4):
+        a = mock.alloc()
+        a.name = f"x[{i}]"
+        allocs.append(AllocTuple(a.name, None, a))
+    diff = DiffResult()
+    limit = [2]
+    limited = evict_and_place(ctx, diff, allocs, "test", limit)
+    assert limited
+    assert len(diff.place) == 2
+    assert limit[0] == 0
+
+
+# ---------------------------------------------------------------------------
+# Versions
+# ---------------------------------------------------------------------------
+
+def test_version_constraints():
+    assert check_constraint("1.2.3", ">= 1.0, < 2.0")
+    assert not check_constraint("2.1.0", ">= 1.0, < 2.0")
+    assert check_constraint("1.2.3", "= 1.2.3")
+    assert check_constraint("1.3.0", "~> 1.2")
+    assert not check_constraint("2.0.0", "~> 1.2")
+    assert check_constraint("1.2.5", "~> 1.2.3")
+    assert not check_constraint("1.3.0", "~> 1.2.3")
+    assert not check_constraint("garbage", ">= 1.0")
+    assert check_constraint("0.1.0", ">= 0.1.0")
+
+
+def test_version_encoding_order():
+    vs = ["0.0.1", "0.1.0", "0.1.0", "1.0.0-beta", "1.0.0", "1.2.3", "10.0.0"]
+    encoded = [encode_version(v) for v in vs]
+    assert encoded == sorted(encoded)
+    assert encode_version("1.0.0-beta") < encode_version("1.0.0")

@@ -1,0 +1,165 @@
+"""State store tests (parity targets: nomad/state/state_store_test.go)."""
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import (
+    ALLOC_CLIENT_STATUS_RUNNING,
+    NODE_STATUS_DOWN,
+    NODE_STATUS_READY,
+)
+
+
+def test_upsert_node_and_indexes():
+    s = StateStore()
+    n = mock.node()
+    s.upsert_node(1000, n)
+    out = s.node_by_id(n.id)
+    assert out is not None
+    assert out.create_index == 1000 and out.modify_index == 1000
+    assert s.get_index("nodes") == 1000
+    # stored object is a copy, original mutation does not leak
+    n.status = "bogus"
+    assert s.node_by_id(n.id).status == NODE_STATUS_READY
+
+
+def test_update_node_status_and_drain():
+    s = StateStore()
+    n = mock.node()
+    s.upsert_node(1000, n)
+    s.update_node_status(1001, n.id, NODE_STATUS_DOWN)
+    assert s.node_by_id(n.id).status == NODE_STATUS_DOWN
+    assert s.node_by_id(n.id).modify_index == 1001
+    s.update_node_drain(1002, n.id, True)
+    assert s.node_by_id(n.id).drain is True
+    with pytest.raises(ValueError):
+        s.update_node_status(1003, n.id, "bogus")
+    with pytest.raises(KeyError):
+        s.update_node_status(1003, "missing", NODE_STATUS_READY)
+
+
+def test_snapshot_isolation():
+    s = StateStore()
+    n1 = mock.node()
+    s.upsert_node(1000, n1)
+    snap = s.snapshot()
+
+    n2 = mock.node()
+    s.upsert_node(1001, n2)
+    s.delete_node(1002, n1.id)
+
+    # snapshot still sees the old world
+    assert snap.node_by_id(n1.id) is not None
+    assert snap.node_by_id(n2.id) is None
+    assert snap.get_index("nodes") == 1000
+    # live store sees the new world
+    assert s.node_by_id(n1.id) is None
+    assert s.node_by_id(n2.id) is not None
+    assert s.get_index("nodes") == 1002
+
+
+def test_snapshot_isolation_secondary_indexes():
+    s = StateStore()
+    a = mock.alloc()
+    s.upsert_allocs(1000, [a])
+    snap = s.snapshot()
+
+    a2 = mock.alloc()
+    a2.node_id = a.node_id
+    s.upsert_allocs(1001, [a2])
+
+    assert len(snap.allocs_by_node(a.node_id)) == 1
+    assert len(s.allocs_by_node(a.node_id)) == 2
+
+
+def test_upsert_allocs_preserves_client_fields():
+    s = StateStore()
+    a = mock.alloc()
+    s.upsert_allocs(1000, [a])
+
+    client_view = s.alloc_by_id(a.id).copy()
+    client_view.client_status = ALLOC_CLIENT_STATUS_RUNNING
+    client_view.client_description = "up"
+    s.update_alloc_from_client(1001, client_view)
+
+    # A scheduler rewrite must not clobber the client-authoritative fields
+    sched_view = a.copy()
+    sched_view.client_status = "pending"
+    s.upsert_allocs(1002, [sched_view])
+    out = s.alloc_by_id(a.id)
+    assert out.client_status == ALLOC_CLIENT_STATUS_RUNNING
+    assert out.client_description == "up"
+    assert out.create_index == 1000 and out.modify_index == 1002
+
+
+def test_update_alloc_from_client_missing():
+    s = StateStore()
+    with pytest.raises(KeyError):
+        s.update_alloc_from_client(1000, mock.alloc())
+
+
+def test_evals_by_job_and_reap():
+    s = StateStore()
+    ev = mock.eval()
+    s.upsert_evals(1000, [ev])
+    assert [e.id for e in s.evals_by_job(ev.job_id)] == [ev.id]
+
+    a = mock.alloc()
+    a.eval_id = ev.id
+    s.upsert_allocs(1001, [a])
+    assert [x.id for x in s.allocs_by_eval(ev.id)] == [a.id]
+
+    s.delete_eval(1002, [ev.id], [a.id])
+    assert s.eval_by_id(ev.id) is None
+    assert s.alloc_by_id(a.id) is None
+    assert s.evals_by_job(ev.job_id) == []
+    assert s.allocs_by_node(a.node_id) == []
+
+
+def test_watch_notification():
+    s = StateStore()
+    ev = s.watch.watch(("nodes",))
+    assert not ev.is_set()
+    s.upsert_node(1000, mock.node())
+    assert ev.is_set()
+
+    a = mock.alloc()
+    node_ev = s.watch.watch(("alloc-node", a.node_id))
+    other_ev = s.watch.watch(("alloc-node", "other"))
+    s.upsert_allocs(1001, [a])
+    assert node_ev.is_set()
+    assert not other_ev.is_set()
+
+
+def test_restore_swaps_world():
+    s = StateStore()
+    s.upsert_node(5, mock.node())
+    snap = s.snapshot()
+
+    r = s.restore()
+    n = mock.node()
+    j = mock.job()
+    ev = mock.eval()
+    a = mock.alloc()
+    n.modify_index = 100
+    r.node_restore(n)
+    r.job_restore(j)
+    r.eval_restore(ev)
+    r.alloc_restore(a)
+    r.index_restore("nodes", 100)
+    r.commit()
+
+    assert s.node_by_id(n.id) is not None
+    assert s.job_by_id(j.id) is not None
+    assert [e.id for e in s.evals_by_job(ev.job_id)] == [ev.id]
+    assert [x.id for x in s.allocs_by_job(a.job_id)] == [a.id]
+    assert s.get_index("nodes") == 100
+    # pre-restore snapshot still intact
+    assert len(list(snap.nodes())) == 1
+
+
+def test_latest_index():
+    s = StateStore()
+    s.upsert_node(7, mock.node())
+    s.upsert_job(9, mock.job())
+    assert s.latest_index() == 9
