@@ -1,0 +1,154 @@
+"""North-star benchmark: device bin-packing vs in-process sequential packer.
+
+Config 4 of BASELINE.md: synthetic bin-pack stress, 10k nodes x 1k task
+groups.  The sequential service scheduler (reference-faithful iterator chain,
+power-of-two-choices truncation) is the measured baseline; the jax-binpack
+scheduler runs the identical evaluation through the device placement scan.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Run on TPU (default backend); falls back to whatever jax.default_backend()
+is.  ``--nodes/--groups/--quick`` shrink the config for smoke runs.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import nomad_tpu.mock as mock  # noqa: E402
+from nomad_tpu.scheduler import Harness  # noqa: E402
+from nomad_tpu.structs import (  # noqa: E402
+    EVAL_TRIGGER_JOB_REGISTER,
+    JOB_TYPE_SERVICE,
+    Evaluation,
+    NetworkResource,
+    Resources,
+    Task,
+    TaskGroup,
+    generate_uuid,
+)
+
+
+def build_cluster(n_nodes: int, n_groups: int):
+    """Mock state at scale: n_nodes ready nodes, one job with n_groups TGs."""
+    h = Harness()
+    for i in range(n_nodes):
+        h.state.upsert_node(h.next_index(), mock.node(i))
+
+    job = mock.job()
+    job.task_groups = []
+    for g in range(n_groups):
+        job.task_groups.append(TaskGroup(
+            name=f"tg-{g}",
+            count=1,
+            tasks=[Task(
+                name="web",
+                driver="exec",
+                resources=Resources(
+                    cpu=100, memory_mb=64,
+                    networks=[NetworkResource(mbits=5,
+                                              dynamic_ports=["http"])],
+                ),
+            )],
+        ))
+    h.state.upsert_job(h.next_index(), job)
+    return h, job
+
+
+def make_eval(job) -> Evaluation:
+    return Evaluation(
+        id=generate_uuid(), priority=job.priority, type=JOB_TYPE_SERVICE,
+        triggered_by=EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+    )
+
+
+class _RecordOnlyPlanner:
+    """Accepts every plan as fully committed WITHOUT applying it to state,
+    so repeated evals all see the identical empty-fleet snapshot."""
+
+    def __init__(self) -> None:
+        self.plans = []
+
+    def submit_plan(self, plan):
+        from nomad_tpu.structs import PlanResult
+        self.plans.append(plan)
+        return PlanResult(
+            node_update=plan.node_update,
+            node_allocation=plan.node_allocation,
+            failed_allocs=plan.failed_allocs,
+        ), None
+
+    def update_eval(self, ev) -> None:
+        pass
+
+    def create_eval(self, ev) -> None:
+        pass
+
+
+def run_once(h, job, scheduler: str) -> tuple[float, int]:
+    """Process one fresh evaluation; returns (seconds, placements)."""
+    recorder = _RecordOnlyPlanner()
+    h.planner = recorder
+    start = time.perf_counter()
+    h.process(scheduler, make_eval(job))
+    elapsed = time.perf_counter() - start
+    placed = sum(sum(len(v) for v in p.node_allocation.values())
+                 for p in recorder.plans)
+    return elapsed, placed
+
+
+def bench(scheduler: str, n_nodes: int, n_groups: int, repeats: int):
+    """Best-of-N evals/sec; plans recorded but never committed."""
+    h, job = build_cluster(n_nodes, n_groups)
+    times, placed = [], 0
+    for _ in range(repeats):
+        t, placed = run_once(h, job, scheduler)
+        times.append(t)
+    return min(times), placed
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=10_000)
+    ap.add_argument("--groups", type=int, default=1_000)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--quick", action="store_true",
+                    help="256 nodes x 64 groups smoke config")
+    args = ap.parse_args()
+
+    if args.quick:
+        args.nodes, args.groups = 256, 64
+
+    # Warm up device compile caches (shapes identical to the timed run).
+    bench("jax-binpack", args.nodes, args.groups, 1)
+    jax_time, jax_placed = bench("jax-binpack", args.nodes, args.groups,
+                                 args.repeats)
+
+    seq_nodes = args.nodes
+    seq_time, seq_placed = bench("service", seq_nodes, args.groups, 1)
+
+    # evals/sec for the full evaluation (reconcile + place + plan build).
+    jax_eps = 1.0 / jax_time
+    seq_eps = 1.0 / seq_time
+    result = {
+        "metric": f"evals_per_sec_binpack_{args.nodes}n_x_{args.groups}tg",
+        "value": round(jax_eps, 3),
+        "unit": "evals/s",
+        "vs_baseline": round(jax_eps / seq_eps, 2),
+    }
+    print(json.dumps(result))
+    print(f"# jax-binpack: {jax_time:.3f}s/eval ({jax_placed} placements, "
+          f"{jax_placed / jax_time:.0f} placements/s)", file=sys.stderr)
+    print(f"# sequential:  {seq_time:.3f}s/eval ({seq_placed} placements on "
+          f"{seq_nodes} nodes, {seq_placed / seq_time:.0f} placements/s)",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,202 @@
+"""Fleet tensorization: the state -> HBM bridge.
+
+Converts the host data model (Node/Allocation objects in the MVCC store)
+into the device-resident tensors the TPU scheduler consumes:
+
+  capacity  f32[N, D]   node.resources       (D = ALL_FIT_DIMS = 6)
+  reserved  f32[N, D]   node.reserved
+  ready     bool[N]     status == ready and not draining
+  dc_codes  i32[N]      interned datacenter id
+
+plus host-side numpy mirrors used to compile constraint masks
+(nomad_tpu/models/constraints.py).  Capability parity role: this is the
+TPU-native replacement for the iterator walk over memdb state in
+/root/reference/scheduler/feasible.go + rank.go — instead of lazily visiting
+nodes, the whole fleet is resident on device and every candidate is scored in
+one dispatch.
+
+Caching contract: the state store is copy-on-write at table granularity, so
+the identity of a snapshot's frozen ``nodes`` table dict is a sound cache key
+— if any node changes, the store swaps in a new dict.  ``fleet_cache`` keys
+static tensors on that identity; per-eval dynamic state (usage, job counts)
+is rebuilt from the allocs table (vectorized, numpy) and cached the same way.
+
+Port/bandwidth dims are a *sound over-approximation* of the exact host-side
+NetworkIndex accounting (reference nomad/structs/network.go): the device mask
+never admits a node the exact check would reject on total bandwidth, and the
+exact per-device/port assignment runs host-side after selection
+(SURVEY.md section 7, "Network/port allocation").
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from nomad_tpu.structs import (
+    ALL_FIT_DIMS,
+    NODE_STATUS_READY,
+    Allocation,
+    Node,
+    Resources,
+)
+
+NDIMS = len(ALL_FIT_DIMS)  # cpu, memory_mb, disk_mb, iops, mbits, port_slots
+
+# Dynamic port range size: the port_slots capacity over-approximation
+# (reference nomad/structs/network.go:9-18 — 20000..60000 dynamic ports).
+PORT_SLOTS_CAPACITY = 40000.0
+
+
+def _res_vector(res: Optional[Resources]) -> np.ndarray:
+    if res is None:
+        return np.zeros(NDIMS, dtype=np.float32)
+    return np.asarray(res.as_vector(), dtype=np.float32)
+
+
+def _pad_to(n: int) -> int:
+    """Next power of two >= n (>= 8); buckets shapes so jit caches stay hot."""
+    p = 8
+    while p < n:
+        p *= 2
+    return p
+
+
+@dataclass
+class FleetStatics:
+    """Node-static tensors + host mirrors, cached per nodes-table generation."""
+
+    n_real: int
+    n_pad: int
+    node_ids: list                      # index -> node id (real rows only)
+    index_of: dict                      # node id -> index
+    nodes: list                         # index -> Node (host objects)
+    capacity: np.ndarray                # f32[n_pad, D]
+    reserved: np.ndarray                # f32[n_pad, D]
+    ready: np.ndarray                   # bool[n_pad] (padding rows False)
+    datacenters: np.ndarray             # object[n_pad] (host-side dc strings)
+    # Host-side attribute/meta mirrors for constraint compilation:
+    attr_rows: list                     # index -> node.attributes dict
+    meta_rows: list                     # index -> node.meta dict
+    mask_cache: dict = field(default_factory=dict)   # constraint-key -> bool[n_pad]
+    # Device-resident mirrors, populated lazily (jax arrays).  Keys:
+    # "capres" -> (capacity, reserved); ("feas", group-keys) -> bool[G, N].
+    # Keeping these resident avoids re-uploading the fleet every eval —
+    # at 10k nodes the feasibility matrix transfer dominates eval latency.
+    device_cache: dict = field(default_factory=dict)
+
+    def device_capacity_reserved(self):
+        hit = self.device_cache.get("capres")
+        if hit is None:
+            import jax
+            hit = (jax.device_put(self.capacity), jax.device_put(self.reserved))
+            self.device_cache["capres"] = hit
+        return hit
+
+
+def build_fleet(nodes: list[Node]) -> FleetStatics:
+    n_real = len(nodes)
+    n_pad = _pad_to(n_real)
+
+    capacity = np.zeros((n_pad, NDIMS), dtype=np.float32)
+    reserved = np.zeros((n_pad, NDIMS), dtype=np.float32)
+    ready = np.zeros(n_pad, dtype=bool)
+    datacenters = np.empty(n_pad, dtype=object)
+    attr_rows, meta_rows, node_ids = [], [], []
+    index_of: dict = {}
+
+    for i, node in enumerate(nodes):
+        node_ids.append(node.id)
+        index_of[node.id] = i
+        cap = _res_vector(node.resources)
+        cap[5] = PORT_SLOTS_CAPACITY  # port_slots capacity over-approximation
+        capacity[i] = cap
+        reserved[i] = _res_vector(node.reserved)
+        ready[i] = node.status == NODE_STATUS_READY and not node.drain
+        datacenters[i] = node.datacenter
+        attr_rows.append(node.attributes)
+        meta_rows.append(node.meta)
+
+    return FleetStatics(
+        n_real=n_real,
+        n_pad=n_pad,
+        node_ids=node_ids,
+        index_of=index_of,
+        nodes=list(nodes),
+        capacity=capacity,
+        reserved=reserved,
+        ready=ready,
+        datacenters=datacenters,
+        attr_rows=attr_rows,
+        meta_rows=meta_rows,
+    )
+
+
+@dataclass
+class FleetView:
+    """One eval's dynamic view: statics + usage + same-job alloc counts."""
+
+    statics: FleetStatics
+    usage: np.ndarray       # f32[n_pad, D] — sum of non-terminal alloc asks
+    job_counts: np.ndarray  # i32[n_pad] — proposed allocs of the eval's job
+
+
+def build_usage(statics: FleetStatics, allocs: list[Allocation],
+                job_id: str = "") -> FleetView:
+    """Aggregate per-node usage + same-job counts from an alloc list.
+
+    Vectorized host-side: one np.add.at scatter instead of a Python loop per
+    (alloc x dim).  Terminal allocs must already be filtered by the caller.
+    """
+    usage = np.zeros((statics.n_pad, NDIMS), dtype=np.float32)
+    job_counts = np.zeros(statics.n_pad, dtype=np.int32)
+    if allocs:
+        idx = np.empty(len(allocs), dtype=np.int64)
+        vecs = np.empty((len(allocs), NDIMS), dtype=np.float32)
+        keep = 0
+        for a in allocs:
+            i = statics.index_of.get(a.node_id, -1)
+            if i < 0:
+                continue
+            idx[keep] = i
+            vecs[keep] = _res_vector(a.resources)
+            if job_id and a.job_id == job_id:
+                job_counts[i] += 1
+            keep += 1
+        np.add.at(usage, idx[:keep], vecs[:keep])
+    return FleetView(statics=statics, usage=usage, job_counts=job_counts)
+
+
+class FleetCache:
+    """Caches FleetStatics per nodes-table generation.  Sound because the
+    MVCC store is copy-on-write: a frozen table dict is never mutated, only
+    swapped."""
+
+    def __init__(self, max_entries: int = 4) -> None:
+        self.max_entries = max_entries
+        self._statics: dict = {}
+
+    def _table(self, state, table: str):
+        t = getattr(state, "_t", None)
+        if t is None:
+            return None
+        return t.tables[table]
+
+    def statics_for(self, state) -> FleetStatics:
+        table = self._table(state, "nodes")
+        if table is not None:
+            hit = self._statics.get(id(table))
+            # Keep the keyed dict alive inside the entry so its id() cannot
+            # be reused by a different dict while cached.
+            if hit is not None and hit[0] is table:
+                return hit[1]
+        fleet = build_fleet(list(state.nodes()))
+        if table is not None:
+            if len(self._statics) >= self.max_entries:
+                self._statics.clear()
+            self._statics[id(table)] = (table, fleet)
+        return fleet
+
+
+fleet_cache = FleetCache()

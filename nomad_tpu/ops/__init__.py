@@ -1,0 +1,6 @@
+"""Device-side ops: vectorized fit/score/placement kernels."""
+from .binpack import (  # noqa: F401
+    place_sequence,
+    place_sequence_batch,
+    score_all_nodes,
+)

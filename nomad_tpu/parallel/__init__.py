@@ -1,0 +1,6 @@
+"""Multi-chip parallelism: mesh construction + node-axis sharding."""
+from .mesh import (  # noqa: F401
+    fleet_mesh,
+    place_sequence_sharded,
+    shard_fleet_arrays,
+)

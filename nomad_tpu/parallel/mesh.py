@@ -1,0 +1,82 @@
+"""Device-mesh scaling for the scheduler: shard the node axis over ICI.
+
+This is the structural cousin of sequence parallelism for a scheduler
+workload (SURVEY.md section 5, "Long-context"): the problem dimension that
+grows is the fleet (nodes x task groups), so the node axis of every fleet
+tensor is sharded across a 1-D ``jax.sharding.Mesh``.  Per-shard work is the
+elementwise fit/score math; the argmax winner is reduced across devices by
+XLA-inserted collectives riding ICI — no hand-written NCCL/MPI, no host
+round-trips (the reference scales this dimension with iterator laziness +
+LimitIterator truncation, scheduler/stack.go:106-117; we scale it with
+hardware).
+
+Multi-slice/multi-host: the same jit runs under multi-host jax with a mesh
+spanning slices; DCN carries only the (tiny) replicated ask/choice tensors,
+ICI the sharded fleet math.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nomad_tpu.ops.binpack import _place_sequence
+
+FLEET_AXIS = "fleet"
+
+
+def fleet_mesh(devices=None) -> Mesh:
+    """1-D mesh over all (or the given) devices; axis name 'fleet'."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (FLEET_AXIS,))
+
+
+def _shardings(mesh: Mesh):
+    node = NamedSharding(mesh, P(FLEET_AXIS))          # [N, ...] row-sharded
+    group_node = NamedSharding(mesh, P(None, FLEET_AXIS))  # [G, N]
+    repl = NamedSharding(mesh, P())
+    return node, group_node, repl
+
+
+def shard_fleet_arrays(mesh: Mesh, capacity, reserved, usage, job_counts,
+                       feasible):
+    """Place fleet tensors on the mesh, node axis sharded."""
+    node, group_node, repl = _shardings(mesh)
+    return (
+        jax.device_put(capacity, node),
+        jax.device_put(reserved, node),
+        jax.device_put(usage, node),
+        jax.device_put(job_counts, node),
+        jax.device_put(feasible, group_node),
+    )
+
+
+@partial(jax.jit, static_argnames=("unroll",))
+def _place_sharded(capacity, reserved, usage0, job_counts0, feasible, asks,
+                   distinct, group_idx, valid, penalty, unroll=1):
+    return _place_sequence(capacity, reserved, usage0, job_counts0, feasible,
+                           asks, distinct, group_idx, valid, penalty,
+                           unroll=unroll)
+
+
+def place_sequence_sharded(mesh: Mesh, capacity, reserved, usage0,
+                           job_counts0, feasible, asks, distinct, group_idx,
+                           valid, penalty):
+    """Run the placement scan with the node axis sharded over `mesh`.
+
+    Inputs may be host numpy arrays; they are placed with node-axis
+    shardings and the jitted scan lets XLA insert the cross-device argmax
+    reduction + scatter updates (psum/all-gather over ICI).
+    """
+    capacity, reserved, usage0, job_counts0, feasible = shard_fleet_arrays(
+        mesh, capacity, reserved, usage0, job_counts0, feasible)
+    _, _, repl = _shardings(mesh)
+    asks = jax.device_put(asks, repl)
+    distinct = jax.device_put(distinct, repl)
+    group_idx = jax.device_put(group_idx, repl)
+    valid = jax.device_put(valid, repl)
+    return _place_sharded(capacity, reserved, usage0, job_counts0, feasible,
+                          asks, distinct, group_idx, valid, penalty)

@@ -31,10 +31,14 @@ register_scheduler("system", new_system_scheduler)
 
 def _register_jax() -> None:
     try:
-        from .jax_binpack import new_jax_binpack_scheduler
+        from .jax_binpack import (
+            new_jax_binpack_batch_scheduler,
+            new_jax_binpack_scheduler,
+        )
     except ImportError:  # pragma: no cover - jax always present in CI
         return
     register_scheduler("jax-binpack", new_jax_binpack_scheduler)
+    register_scheduler("jax-binpack-batch", new_jax_binpack_batch_scheduler)
 
 
 try:

@@ -102,6 +102,7 @@ class NetworkIndex:
             offer = NetworkResource(
                 device=n.device,
                 ip=ip_str,
+                mbits=ask.mbits,
                 reserved_ports=list(ask.reserved_ports),
                 dynamic_ports=list(ask.dynamic_ports),
             )

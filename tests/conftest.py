@@ -3,6 +3,11 @@
 Force JAX onto a virtual 8-device CPU mesh so sharding/pjit paths are
 exercised without TPU hardware (the driver separately dry-runs the
 multi-chip path; bench runs on the real chip).
+
+Note: the environment's sitecustomize may register a TPU backend at
+interpreter start, so JAX_PLATFORMS cannot always be overridden here —
+instead the default *device* is pinned to cpu:0 and mesh tests build meshes
+from ``jax.devices("cpu")`` explicitly.
 """
 import os
 
@@ -15,3 +20,7 @@ if "xla_force_host_platform_device_count" not in flags:
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_default_device", jax.devices("cpu")[0])

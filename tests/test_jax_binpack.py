@@ -1,0 +1,393 @@
+"""Golden-parity + property tests for the TPU jax-binpack scheduler.
+
+Parity model: the sequential schedulers (GenericStack with the LimitIterator
+truncation) are the reference-faithful truth; the device path scores every
+feasible node, so its *scores* must match the scalar score_fit math exactly
+and its plans must obey the same invariants (fit, constraints, counts).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.models.constraints import compile_group_mask
+from nomad_tpu.models.fleet import build_fleet, build_usage
+from nomad_tpu.ops.binpack import place_sequence, score_all_nodes
+from nomad_tpu.scheduler import Harness
+from nomad_tpu.scheduler.context import EvalContext
+from nomad_tpu.scheduler.feasible import check_single_constraint
+from nomad_tpu.scheduler.util import task_group_constraints
+from nomad_tpu.structs import (
+    EVAL_TRIGGER_JOB_REGISTER,
+    JOB_TYPE_SERVICE,
+    Allocation,
+    Constraint,
+    Evaluation,
+    Plan,
+    Resources,
+    allocs_fit,
+    score_fit,
+)
+
+
+def make_eval(job):
+    return Evaluation(
+        id="eval-1", priority=job.priority, type=JOB_TYPE_SERVICE,
+        triggered_by=EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+    )
+
+
+# ---------------------------------------------------------------------------
+# score parity: device score == scalar score_fit for every node
+# ---------------------------------------------------------------------------
+
+def test_score_parity_all_nodes():
+    nodes = [mock.node(i) for i in range(13)]
+    # Vary free capacity: preload usage on some nodes.
+    allocs = []
+    for i in (0, 3, 7):
+        a = Allocation(id=f"a{i}", node_id=nodes[i].id, job_id="other",
+                       resources=Resources(cpu=2000, memory_mb=4096),
+                       desired_status="run")
+        allocs.append(a)
+
+    fleet = build_fleet(nodes)
+    view = build_usage(fleet, allocs, job_id="j1")
+
+    ask = Resources(cpu=500, memory_mb=256)
+    ask_vec = np.asarray(ask.as_vector(), dtype=np.float32)
+
+    feasible = np.zeros(fleet.n_pad, dtype=bool)
+    feasible[:fleet.n_real] = True
+
+    scores = np.asarray(score_all_nodes(
+        fleet.capacity, fleet.reserved, view.usage, view.job_counts,
+        ask_vec, feasible, False, 10.0))
+
+    for i, node in enumerate(nodes):
+        proposed = [a for a in allocs if a.node_id == node.id]
+        proposed = proposed + [Allocation(resources=ask)]
+        fit, _dim, util = allocs_fit(node, proposed)
+        assert fit, f"mock node {i} should fit the ask"
+        expected = score_fit(node, util)
+        assert scores[i] == pytest.approx(expected, abs=1e-4), f"node {i}"
+
+
+def test_score_marks_unfit_nodes():
+    nodes = [mock.node(i) for i in range(4)]
+    fleet = build_fleet(nodes)
+    view = build_usage(fleet, [])
+    # Ask for more cpu than any node has.
+    ask = np.asarray(Resources(cpu=99999, memory_mb=10).as_vector(),
+                     dtype=np.float32)
+    feasible = np.ones(fleet.n_pad, dtype=bool)
+    scores = np.asarray(score_all_nodes(
+        fleet.capacity, fleet.reserved, view.usage, view.job_counts,
+        ask, feasible, False, 10.0))
+    assert (scores < -1e29).all()
+
+
+def test_anti_affinity_penalty_applied():
+    nodes = [mock.node(i) for i in range(4)]
+    a = Allocation(id="a1", node_id=nodes[0].id, job_id="j1",
+                   resources=Resources(cpu=100, memory_mb=100),
+                   desired_status="run")
+    fleet = build_fleet(nodes)
+    view = build_usage(fleet, [a], job_id="j1")
+    assert view.job_counts[0] == 1
+
+    ask = np.asarray(Resources(cpu=100, memory_mb=64).as_vector(),
+                     dtype=np.float32)
+    feasible = np.ones(fleet.n_pad, dtype=bool)
+    feasible[fleet.n_real:] = False
+    scores = np.asarray(score_all_nodes(
+        fleet.capacity, fleet.reserved, view.usage, view.job_counts,
+        ask, feasible, False, 10.0))
+    # Node 0 carries the same-job alloc: penalized by 10 (plus usage delta).
+    assert scores[0] < scores[1] - 5.0
+
+
+# ---------------------------------------------------------------------------
+# constraint mask parity vs the sequential predicate walk
+# ---------------------------------------------------------------------------
+
+def test_constraint_mask_parity():
+    nodes = []
+    for i in range(20):
+        n = mock.node(i)
+        if i % 3 == 0:
+            n.attributes["kernel.name"] = "windows"
+        if i % 4 == 0:
+            n.attributes["driver.exec"] = "0"
+        nodes.append(n)
+
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg_constr = task_group_constraints(tg)
+    fleet = build_fleet(nodes)
+    mask, distinct = compile_group_mask(
+        fleet, job.datacenters, job.constraints, tg_constr.constraints,
+        tg_constr.drivers)
+    assert not distinct
+
+    ctx = EvalContext(None, Plan())
+    for i, node in enumerate(nodes):
+        expected = all(
+            check_single_constraint(ctx, c, node)
+            for c in job.constraints + tg_constr.constraints if c.hard)
+        for d in tg_constr.drivers:
+            v = node.attributes.get(f"driver.{d}")
+            expected = expected and v is not None and \
+                str(v).strip().lower() in ("1", "t", "true")
+        assert mask[i] == expected, f"node {i}"
+    assert not mask[fleet.n_real:].any()
+
+
+def test_version_and_regexp_masks():
+    nodes = [mock.node(i) for i in range(6)]
+    for i, n in enumerate(nodes):
+        n.attributes["version"] = f"0.{i}.0"
+    fleet = build_fleet(nodes)
+    cons = [Constraint(hard=True, l_target="$attr.version",
+                       r_target=">= 0.3.0", operand="version")]
+    mask, _ = compile_group_mask(fleet, ["dc1"], cons, [], set())
+    assert list(mask[:6]) == [False, False, False, True, True, True]
+
+    cons = [Constraint(hard=True, l_target="$node.name",
+                       r_target=r"node-[0-2]$", operand="regexp")]
+    mask, _ = compile_group_mask(fleet, ["dc1"], cons, [], set())
+    assert list(mask[:6]) == [True, True, True, False, False, False]
+
+
+# ---------------------------------------------------------------------------
+# placement scan semantics
+# ---------------------------------------------------------------------------
+
+def test_place_sequence_spreads_via_anti_affinity():
+    nodes = [mock.node(i) for i in range(8)]
+    fleet = build_fleet(nodes)
+    view = build_usage(fleet, [])
+
+    ask = np.zeros((1, 6), dtype=np.float32)
+    ask[0] = Resources(cpu=500, memory_mb=256).as_vector()
+    feasible = np.zeros((1, fleet.n_pad), dtype=bool)
+    feasible[0, :fleet.n_real] = True
+    group_idx = np.zeros(8, dtype=np.int32)
+    valid = np.ones(8, dtype=bool)
+
+    chosen, scores, usage = place_sequence(
+        fleet.capacity, fleet.reserved, view.usage, view.job_counts,
+        feasible, ask, np.zeros(1, dtype=bool), group_idx, valid, 10.0)
+    chosen = np.asarray(chosen)
+    # 8 placements on 8 identical nodes with a 10-point penalty: all spread.
+    assert sorted(chosen.tolist()) == list(range(8))
+    # Usage accounted on device.
+    assert np.asarray(usage)[:8, 0].sum() == pytest.approx(500 * 8)
+
+
+def test_place_sequence_distinct_hosts_exhausts():
+    nodes = [mock.node(i) for i in range(4)]
+    fleet = build_fleet(nodes)
+    view = build_usage(fleet, [])
+
+    ask = np.zeros((1, 6), dtype=np.float32)
+    ask[0] = Resources(cpu=10, memory_mb=10).as_vector()
+    feasible = np.zeros((1, fleet.n_pad), dtype=bool)
+    feasible[0, :fleet.n_real] = True
+    group_idx = np.zeros(8, dtype=np.int32)
+    valid = np.ones(8, dtype=bool)
+
+    chosen, _, _ = place_sequence(
+        fleet.capacity, fleet.reserved, view.usage, view.job_counts,
+        feasible, ask, np.ones(1, dtype=bool), group_idx, valid, 0.0)
+    chosen = np.asarray(chosen).tolist()
+    # 4 distinct hosts then exhaustion (-1): placements beyond N fail.
+    assert sorted(c for c in chosen if c >= 0) == list(range(4))
+    assert chosen.count(-1) == 4
+
+
+def test_padding_rows_never_chosen():
+    nodes = [mock.node(i) for i in range(3)]  # padded to 8
+    fleet = build_fleet(nodes)
+    view = build_usage(fleet, [])
+    ask = np.zeros((1, 6), dtype=np.float32)
+    ask[0] = Resources(cpu=10, memory_mb=10).as_vector()
+    feasible = np.zeros((1, fleet.n_pad), dtype=bool)
+    feasible[0, :fleet.n_real] = True
+    group_idx = np.zeros(8, dtype=np.int32)
+    valid = np.ones(8, dtype=bool)
+    chosen, _, _ = place_sequence(
+        fleet.capacity, fleet.reserved, view.usage, view.job_counts,
+        feasible, ask, np.zeros(1, dtype=bool), group_idx, valid, 10.0)
+    assert max(np.asarray(chosen).tolist()) <= 2
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through the Harness: jax-binpack vs sequential service scheduler
+# ---------------------------------------------------------------------------
+
+def _register_cluster(h: Harness, n_nodes: int):
+    nodes = [mock.node(i) for i in range(n_nodes)]
+    for n in nodes:
+        h.state.upsert_node(h.next_index(), n)
+    return nodes
+
+
+def test_jax_scheduler_places_all():
+    h = Harness()
+    _register_cluster(h, 10)
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+
+    h.process("jax-binpack", make_eval(job))
+
+    assert len(h.plans) == 1
+    plan = h.plans[0]
+    placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+    assert len(placed) == 10
+    assert not plan.failed_allocs
+    # Anti-affinity spreads 10 allocs over 10 nodes.
+    assert len(plan.node_allocation) == 10
+    for a in placed:
+        assert a.node_id
+        assert a.task_resources["web"].networks[0].mbits == 50
+        assert len(a.task_resources["web"].networks[0].reserved_ports) == 1
+        assert a.metrics.nodes_evaluated == 10
+
+
+def test_jax_scheduler_matches_sequential_counts():
+    """Same cluster, same job -> both schedulers place the full count and
+    produce fitting, constraint-respecting plans."""
+    for name in ("service", "jax-binpack"):
+        h = Harness()
+        nodes = _register_cluster(h, 16)
+        # Poison half the nodes: wrong kernel.
+        for n in nodes[8:]:
+            n2 = n.copy()
+            n2.attributes = dict(n2.attributes)
+            n2.attributes["kernel.name"] = "windows"
+            h.state.upsert_node(h.next_index(), n2)
+        job = mock.job()
+        job.task_groups[0].count = 8
+        h.state.upsert_job(h.next_index(), job)
+
+        h.process(name, make_eval(job))
+        plan = h.plans[0]
+        placed = [a for allocs in plan.node_allocation.values()
+                  for a in allocs]
+        assert len(placed) == 8, name
+        good = {n.id for n in nodes[:8]}
+        for a in placed:
+            assert a.node_id in good, name
+
+
+def test_jax_scheduler_exhaustion_fails_allocs():
+    h = Harness()
+    _register_cluster(h, 2)
+    job = mock.job()
+    job.task_groups[0].count = 4
+    job.task_groups[0].tasks[0].resources.cpu = 3000  # 2 per fleet max
+    h.state.upsert_job(h.next_index(), job)
+
+    h.process("jax-binpack", make_eval(job))
+    plan = h.plans[0]
+    placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+    # 4000 MHz nodes, 100 reserved: one 3000 MHz task fits per node.
+    assert len(placed) == 2
+    assert len(plan.failed_allocs) >= 1  # coalesced failures
+
+    # Evals recorded as complete.
+    assert h.evals and h.evals[0].status == "complete"
+
+
+def test_jax_scheduler_distinct_hosts_end_to_end():
+    h = Harness()
+    _register_cluster(h, 4)
+    job = mock.job()
+    job.task_groups[0].count = 6
+    job.constraints.append(Constraint(hard=True, operand="distinct_hosts"))
+    h.state.upsert_job(h.next_index(), job)
+
+    h.process("jax-binpack", make_eval(job))
+    plan = h.plans[0]
+    placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+    assert len(placed) == 4
+    assert len({a.node_id for a in placed}) == 4
+    assert plan.failed_allocs
+
+
+def test_jax_scheduler_plans_fit():
+    """Every node's final proposed alloc set passes the exact allocs_fit."""
+    h = Harness()
+    nodes = _register_cluster(h, 6)
+    job = mock.job()
+    job.task_groups[0].count = 30
+    job.task_groups[0].tasks[0].resources.cpu = 700
+    h.state.upsert_job(h.next_index(), job)
+
+    h.process("jax-binpack", make_eval(job))
+    plan = h.plans[0]
+    by_node = {n.id: n for n in nodes}
+    for node_id, allocs in plan.node_allocation.items():
+        fit, dim, _ = allocs_fit(by_node[node_id], allocs)
+        assert fit, f"node {node_id} overcommitted: {dim}"
+
+
+def test_jax_scheduler_updates_in_place():
+    """Job modify-index bump with unchanged tasks -> in-place update path
+    still works (runs through the sequential single-node stack)."""
+    h = Harness()
+    _register_cluster(h, 4)
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    h.process("jax-binpack", make_eval(job))
+    allocs = [a for allocs in h.plans[0].node_allocation.values()
+              for a in allocs]
+    h.state.upsert_allocs(h.next_index(), allocs)
+
+    job2 = job.copy()
+    job2.modify_index = job.modify_index + 1
+    h.state.upsert_job(h.next_index(), job2)
+    h.process("jax-binpack", make_eval(job2))
+
+    plan = h.plans[-1]
+    placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+    assert len(placed) == 10  # all updated in place
+    assert not plan.failed_allocs
+
+
+def test_fallback_divergence_never_oversubscribes(monkeypatch):
+    """When the exact host network check rejects a device winner (forcing a
+    sequential fallback), later device choices must be re-verified so the
+    plan never oversubscribes a node (code-review regression)."""
+    from nomad_tpu.scheduler.jax_binpack import JaxBinPackScheduler
+
+    h = Harness()
+    nodes = _register_cluster(h, 4)
+    job = mock.job()
+    job.task_groups[0].count = 8
+    job.task_groups[0].tasks[0].resources.cpu = 900
+    h.state.upsert_job(h.next_index(), job)
+
+    # Reject the first two device winners to force fallback + divergence.
+    real = JaxBinPackScheduler._assign_networks
+    calls = {"n": 0}
+
+    def flaky(self, node, tg):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            return None
+        return real(self, node, tg)
+
+    monkeypatch.setattr(JaxBinPackScheduler, "_assign_networks", flaky)
+    h.process("jax-binpack", make_eval(job))
+
+    plan = h.plans[0]
+    by_node = {n.id: n for n in nodes}
+    for node_id, allocs in plan.node_allocation.items():
+        fit, dim, _ = allocs_fit(by_node[node_id], allocs)
+        assert fit, f"node {node_id} oversubscribed: {dim}"
+    placed = sum(len(v) for v in plan.node_allocation.values())
+    assert placed + len(plan.failed_allocs) >= 8 - 7  # coalescing allowed
+    assert placed >= 4

@@ -36,7 +36,7 @@ from nomad_tpu.scheduler.util import (
     tainted_nodes,
     tasks_updated,
 )
-from nomad_tpu.scheduler.versions import check_constraint, encode_version
+from nomad_tpu.utils.versions import check_constraint, encode_version
 from nomad_tpu.structs import (
     ALLOC_DESIRED_STATUS_RUN,
     ALLOC_DESIRED_STATUS_STOP,
