@@ -122,11 +122,12 @@ place_sequence = jax.jit(_place_sequence, static_argnames=("unroll",))
 # Batched over independent evaluations (axis 0 of per-eval args):
 # optimistic concurrency on device — every eval starts from the SAME
 # snapshot usage (broadcast on device, no per-eval upload) and evolves its
-# own copy through the scan; the host plan-apply loop serializes commits
-# (reference nomad/plan_apply.go parity).
+# own copy through the scan; job_counts IS per-eval (each eval schedules its
+# own job).  The host plan-apply loop serializes commits (reference
+# nomad/plan_apply.go parity).
 place_sequence_batch = jax.jit(
     jax.vmap(
         partial(_place_sequence, unroll=1),
-        in_axes=(None, None, None, None, 0, 0, 0, 0, 0, None),
+        in_axes=(None, None, None, 0, 0, 0, 0, 0, 0, 0),
     )
 )

@@ -39,6 +39,8 @@ def _register_jax() -> None:
         return
     register_scheduler("jax-binpack", new_jax_binpack_scheduler)
     register_scheduler("jax-binpack-batch", new_jax_binpack_batch_scheduler)
+    global BatchEvalRunner
+    from .batch import BatchEvalRunner  # noqa: F401
 
 
 try:

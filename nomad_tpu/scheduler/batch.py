@@ -1,0 +1,145 @@
+"""Batched optimistic scheduling: many evaluations, one device dispatch.
+
+This is the TPU-native replacement for the reference's worker-pool
+concurrency (reference nomad/worker.go:50-437 — NumCPU goroutines each
+processing one eval at a time against its own snapshot).  Here a batch of
+evaluations is reconciled on host, their placement sequences are stacked
+along a vmap axis, and a single device dispatch plans ALL of them against
+the same state snapshot.  Exactly like the reference's optimistic
+concurrency, plans may conflict; the plan applier serializes commits and
+rejected plans are retried individually (reference nomad/plan_apply.go).
+
+Fast-path contract: an eval joins the fused dispatch only if its plan has no
+deltas yet (no migrations/in-place updates), so every lane shares the same
+base usage tensor — lanes diverge only through their own placements.  Evals
+with plan deltas fall back to their own dispatch (still device-side).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from nomad_tpu.structs import (
+    EVAL_STATUS_COMPLETE,
+    EVAL_TRIGGER_JOB_DEREGISTER,
+    EVAL_TRIGGER_JOB_REGISTER,
+    EVAL_TRIGGER_NODE_UPDATE,
+    EVAL_TRIGGER_ROLLING_UPDATE,
+    Evaluation,
+)
+
+from .interfaces import SetStatusError
+from .jax_binpack import JaxBinPackScheduler
+from .util import set_status
+
+_VALID_TRIGGERS = (
+    EVAL_TRIGGER_JOB_REGISTER, EVAL_TRIGGER_NODE_UPDATE,
+    EVAL_TRIGGER_JOB_DEREGISTER, EVAL_TRIGGER_ROLLING_UPDATE,
+)
+
+
+class BatchEvalRunner:
+    """Fuses a batch of evaluations into one device dispatch."""
+
+    def __init__(self, state, planner) -> None:
+        self.state = state
+        self.planner = planner
+
+    def process(self, evals: list[Evaluation]) -> None:
+        from nomad_tpu.ops.binpack import place_sequence_batch
+
+        pending = []  # (scheduler, place, DeviceArgs)
+        for ev in evals:
+            sched = JaxBinPackScheduler(self.state, self.planner,
+                                        batch=(ev.type == "batch"))
+            sched.eval = ev
+            if ev.triggered_by not in _VALID_TRIGGERS:
+                set_status(self.planner, ev, None, "failed",
+                           f"scheduler cannot handle '{ev.triggered_by}' "
+                           "evaluation reason")
+                continue
+            sched.defer_device = True
+            try:
+                sched._begin()
+            except SetStatusError as e:
+                set_status(self.planner, ev, None, e.eval_status, str(e))
+                continue
+            sched.defer_device = False
+
+            if sched.deferred is None:
+                # No placements needed: submit stops/updates directly.
+                self._finish(sched)
+                continue
+            place, args = sched.deferred
+            if sched.plan.node_update or sched.plan.node_allocation:
+                # Plan already carries deltas (migrations, in-place
+                # updates): base usage differs, run its own dispatch.
+                self._run_single(sched, place, args)
+                continue
+            pending.append((sched, place, args))
+
+        if not pending:
+            return
+
+        # Harmonize pad shapes across lanes, stack, one dispatch.
+        g_max = max(a.g_pad for _, _, a in pending)
+        p_max = max(a.p_pad for _, _, a in pending)
+        statics = pending[0][2].statics
+        B = len(pending)
+        feasible = np.zeros((B, g_max, statics.n_pad), dtype=bool)
+        asks = np.zeros((B, g_max, pending[0][2].asks.shape[1]),
+                        dtype=np.float32)
+        distinct = np.zeros((B, g_max), dtype=bool)
+        group_idx = np.zeros((B, p_max), dtype=np.int32)
+        valid = np.zeros((B, p_max), dtype=bool)
+        job_counts = np.zeros((B, statics.n_pad), dtype=np.int32)
+        for b, (_s, _p, a) in enumerate(pending):
+            feasible[b, :a.g_pad] = a.feasible_h
+            asks[b, :a.g_pad] = a.asks
+            distinct[b, :a.g_pad] = a.distinct
+            group_idx[b, :a.p_pad] = a.group_idx
+            valid[b, :a.p_pad] = a.valid
+            job_counts[b] = a.view.job_counts
+
+        capacity_d, reserved_d = statics.device_capacity_reserved()
+        base_usage = pending[0][2].view.usage
+        penalty = np.asarray([a.penalty for _, _, a in pending],
+                             dtype=np.float32)
+        chosen, scores, _usage = place_sequence_batch(
+            capacity_d, reserved_d, base_usage, job_counts, feasible, asks,
+            distinct, group_idx, valid, penalty)
+        chosen = np.asarray(chosen)
+        scores = np.asarray(scores)
+
+        for b, (sched, place, args) in enumerate(pending):
+            sched.finish_deferred(place, args, chosen[b], scores[b])
+            self._finish(sched)
+
+    def _run_single(self, sched, place, args) -> None:
+        from nomad_tpu.ops.binpack import place_sequence
+
+        capacity_d, reserved_d = args.statics.device_capacity_reserved()
+        chosen, scores, _ = place_sequence(
+            capacity_d, reserved_d, args.view.usage, args.view.job_counts,
+            args.feasible_d, args.asks, args.distinct, args.group_idx,
+            args.valid, args.penalty)
+        sched.finish_deferred(place, args, np.asarray(chosen),
+                              np.asarray(scores))
+        self._finish(sched)
+
+    def _finish(self, sched) -> None:
+        """Submit the plan; on rejection/partial commit fall back to the
+        sequential retry loop (fresh scheduler, full process)."""
+        ev = sched.eval
+        try:
+            ok = sched._submit()
+        except SetStatusError as e:  # pragma: no cover - defensive
+            set_status(self.planner, ev, sched.next_eval, e.eval_status,
+                       str(e))
+            return
+        if ok:
+            set_status(self.planner, ev, sched.next_eval,
+                       EVAL_STATUS_COMPLETE)
+        else:
+            retry = JaxBinPackScheduler(
+                sched.state, self.planner, batch=(ev.type == "batch"))
+            retry.process(ev)

@@ -89,7 +89,10 @@ class GenericScheduler:
         set_status(self.planner, ev, self.next_eval, EVAL_STATUS_COMPLETE)
 
     # -- one attempt ------------------------------------------------------
-    def _process(self) -> bool:
+    def _begin(self) -> None:
+        """Reconcile phase: build plan/ctx/stack and compute job allocs.
+        Split from submission so a batch driver can pause between the two
+        (nomad_tpu/scheduler/batch.py)."""
         self.job = self.state.job_by_id(self.eval.job_id)
         self.plan = self.eval.make_plan(self.job)
         self.ctx = EvalContext(self.state, self.plan, logger)
@@ -99,6 +102,11 @@ class GenericScheduler:
 
         self._compute_job_allocs()
 
+    def _process(self) -> bool:
+        self._begin()
+        return self._submit()
+
+    def _submit(self) -> bool:
         if self.plan.is_noop():
             return True
 

@@ -50,8 +50,31 @@ from .stack import (
 from .util import ready_nodes_in_dcs, task_group_constraints
 
 
+class DeviceArgs:
+    """Everything one eval contributes to a (possibly batched) dispatch."""
+
+    __slots__ = ("statics", "view", "feasible_d", "feasible_h", "asks",
+                 "distinct", "group_idx", "valid", "sizes", "slot_of_tg",
+                 "penalty", "g_pad", "p_pad", "start")
+
+    def __init__(self, **kw) -> None:
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
 class JaxBinPackScheduler(GenericScheduler):
-    """GenericScheduler with the placement hot loop moved to TPU."""
+    """GenericScheduler with the placement hot loop moved to TPU.
+
+    ``defer_device=True`` pauses after argument preparation so a batch
+    driver (nomad_tpu/scheduler/batch.py) can fuse many evals into one
+    device dispatch; ``finish_deferred`` resumes with the device results.
+    """
+
+    defer_device = False
+
+    def __init__(self, state, planner, batch: bool) -> None:
+        super().__init__(state, planner, batch)
+        self.deferred: tuple | None = None  # (place, DeviceArgs)
 
     def _proposed_allocs_all(self) -> list:
         """All non-terminal allocs under the in-flight plan: existing minus
@@ -67,6 +90,19 @@ class JaxBinPackScheduler(GenericScheduler):
         return allocs
 
     def _compute_placements(self, place: list) -> None:
+        args = self._prepare_device(place)
+        if self.defer_device:
+            self.deferred = (place, args)
+            return
+        capacity_d, reserved_d = args.statics.device_capacity_reserved()
+        chosen, scores, _ = place_sequence(
+            capacity_d, reserved_d, args.view.usage, args.view.job_counts,
+            args.feasible_d, args.asks, args.distinct, args.group_idx,
+            args.valid, args.penalty)
+        self.finish_deferred(place, args, np.asarray(chosen),
+                             np.asarray(scores))
+
+    def _prepare_device(self, place: list) -> DeviceArgs:
         start = time.perf_counter()
         statics = fleet_cache.statics_for(self.state)
         view = build_usage(statics, self._proposed_allocs_all(),
@@ -111,21 +147,24 @@ class JaxBinPackScheduler(GenericScheduler):
         distinct = np.zeros(g_pad, dtype=bool)
         distinct[:len(groups)] = distinct_rows
 
-        # Feasibility matrix: composed per-slot host masks, uploaded once per
-        # (fleet generation, slot-key tuple) and kept device-resident.
+        # Feasibility matrix: composed per-slot host masks; the single-eval
+        # path keeps a device-resident copy per (fleet generation, slot-key
+        # tuple), the batch driver stacks the host copies instead.
         feas_key = ("feas", tuple(slot_keys), g_pad)
-        feasible_d = statics.device_cache.get(feas_key)
-        if feasible_d is None:
-            feasible = np.zeros((g_pad, statics.n_pad), dtype=bool)
+        cached = statics.device_cache.get(feas_key)
+        if cached is None:
+            feasible_h = np.zeros((g_pad, statics.n_pad), dtype=bool)
             for g, tg in enumerate(groups):
                 tg_constr = task_group_constraints(tg)
                 mask, _dist = compile_group_mask(
                     statics, self.job.datacenters, self.job.constraints,
                     tg_constr.constraints, tg_constr.drivers)
-                feasible[g] = mask
+                feasible_h[g] = mask
             import jax
-            feasible_d = jax.device_put(feasible)
-            statics.device_cache[feas_key] = feasible_d
+            feasible_d = jax.device_put(feasible_h)
+            statics.device_cache[feas_key] = (feasible_h, feasible_d)
+        else:
+            feasible_h, feasible_d = cached
 
         group_idx = np.zeros(p_pad, dtype=np.int32)
         valid = np.zeros(p_pad, dtype=bool)
@@ -136,13 +175,21 @@ class JaxBinPackScheduler(GenericScheduler):
         penalty = BATCH_JOB_ANTI_AFFINITY_PENALTY if self.batch else \
             SERVICE_JOB_ANTI_AFFINITY_PENALTY
 
-        capacity_d, reserved_d = statics.device_capacity_reserved()
-        chosen, scores, _ = place_sequence(
-            capacity_d, reserved_d, view.usage, view.job_counts,
-            feasible_d, asks, distinct, group_idx, valid, penalty)
-        chosen = np.asarray(chosen)
-        scores = np.asarray(scores)
-        device_time = time.perf_counter() - start
+        return DeviceArgs(
+            statics=statics, view=view, feasible_d=feasible_d,
+            feasible_h=feasible_h, asks=asks, distinct=distinct,
+            group_idx=group_idx, valid=valid, sizes=sizes,
+            slot_of_tg=slot_of_tg, penalty=penalty, g_pad=g_pad,
+            p_pad=p_pad, start=start)
+
+    def finish_deferred(self, place: list, args: DeviceArgs,
+                        chosen: np.ndarray, scores: np.ndarray) -> None:
+        """Consume device decisions into the plan (exact host re-checks +
+        network assignment + Allocation construction)."""
+        statics = args.statics
+        sizes = args.sizes
+        slot_of_tg = args.slot_of_tg
+        device_time = time.perf_counter() - args.start
 
         failed_tg: dict = {}
         fallback_nodes = None

@@ -166,10 +166,11 @@ class NetworkResource(_Struct):
     dynamic_ports: list = field(default_factory=list)  # labels
 
     def copy(self) -> "NetworkResource":
-        n = replace(self)
-        n.reserved_ports = list(self.reserved_ports)
-        n.dynamic_ports = list(self.dynamic_ports)
-        return n
+        return NetworkResource(
+            device=self.device, cidr=self.cidr, ip=self.ip,
+            mbits=self.mbits,
+            reserved_ports=list(self.reserved_ports),
+            dynamic_ports=list(self.dynamic_ports))
 
     def add(self, delta: "NetworkResource") -> None:
         if delta.reserved_ports:
@@ -201,9 +202,9 @@ class Resources(_Struct):
     networks: list = field(default_factory=list)
 
     def copy(self) -> "Resources":
-        r = replace(self)
-        r.networks = [n.copy() for n in self.networks]
-        return r
+        return Resources(
+            cpu=self.cpu, memory_mb=self.memory_mb, disk_mb=self.disk_mb,
+            iops=self.iops, networks=[n.copy() for n in self.networks])
 
     def net_index(self, n: NetworkResource) -> int:
         for i, net in enumerate(self.networks):

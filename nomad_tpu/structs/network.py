@@ -22,6 +22,26 @@ from .model import (
 )
 
 
+_CIDR_CACHE: dict = {}
+
+
+def _cidr_ips(cidr: str) -> list:
+    """Expand a CIDR to its IP strings, cached (node CIDRs are static and
+    tiny — typically /32 — but re-parsing per placement dominated the
+    scheduler's host time)."""
+    ips = _CIDR_CACHE.get(cidr)
+    if ips is None:
+        try:
+            net = ipaddress.ip_network(cidr, strict=False)
+            ips = [str(ip) for ip in net]
+        except ValueError:
+            ips = []
+        if len(_CIDR_CACHE) > 65536:
+            _CIDR_CACHE.clear()
+        _CIDR_CACHE[cidr] = ips
+    return ips
+
+
 class NetworkIndex:
     """Tracks available and used network resources on one node."""
 
@@ -74,12 +94,8 @@ class NetworkIndex:
 
     def _yield_ips(self):
         for n in self.avail_networks:
-            try:
-                net = ipaddress.ip_network(n.cidr, strict=False)
-            except ValueError:
-                continue
-            for ip in net:
-                yield n, str(ip)
+            for ip in _cidr_ips(n.cidr):
+                yield n, ip
 
     def assign_network(
         self, ask: NetworkResource,

@@ -1,0 +1,115 @@
+"""Batched optimistic scheduling: many evals fused into one dispatch."""
+from __future__ import annotations
+
+import nomad_tpu.mock as mock
+from nomad_tpu.scheduler import Harness
+from nomad_tpu.scheduler.batch import BatchEvalRunner
+from nomad_tpu.structs import (
+    EVAL_TRIGGER_JOB_REGISTER,
+    JOB_TYPE_SERVICE,
+    Evaluation,
+    allocs_fit,
+    generate_uuid,
+)
+
+
+def make_eval(job):
+    return Evaluation(
+        id=generate_uuid(), priority=job.priority, type=job.type,
+        triggered_by=EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+    )
+
+
+def test_batch_runner_schedules_many_jobs():
+    h = Harness()
+    nodes = [mock.node(i) for i in range(16)]
+    for n in nodes:
+        h.state.upsert_node(h.next_index(), n)
+
+    jobs = []
+    for _ in range(6):
+        j = mock.job()
+        j.task_groups[0].count = 4
+        h.state.upsert_job(h.next_index(), j)
+        jobs.append(j)
+
+    runner = BatchEvalRunner(h.state.snapshot(), h)
+    runner.process([make_eval(j) for j in jobs])
+
+    assert len(h.plans) == 6
+    by_node = {n.id: n for n in nodes}
+    for plan, job in zip(h.plans, jobs):
+        placed = [a for v in plan.node_allocation.values() for a in v]
+        assert len(placed) == 4
+        assert all(a.job_id == job.id for a in placed)
+        # Anti-affinity spreads each job's allocs.
+        assert len(plan.node_allocation) == 4
+    # Each eval marked complete.
+    assert len(h.evals) == 6
+    assert all(e.status == "complete" for e in h.evals)
+
+
+def test_batch_runner_mixed_service_and_batch():
+    h = Harness()
+    for i in range(8):
+        h.state.upsert_node(h.next_index(), mock.node(i))
+    j1 = mock.job()
+    j1.task_groups[0].count = 3
+    j2 = mock.job()
+    j2.type = "batch"
+    j2.task_groups[0].count = 3
+    for j in (j1, j2):
+        h.state.upsert_job(h.next_index(), j)
+
+    runner = BatchEvalRunner(h.state.snapshot(), h)
+    runner.process([make_eval(j1), make_eval(j2)])
+    assert len(h.plans) == 2
+    for plan in h.plans:
+        assert sum(len(v) for v in plan.node_allocation.values()) == 3
+
+
+def test_batch_runner_noop_and_invalid_trigger():
+    h = Harness()
+    for i in range(4):
+        h.state.upsert_node(h.next_index(), mock.node(i))
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+
+    good = make_eval(job)
+    bad = make_eval(job)
+    bad.triggered_by = "bogus-trigger"
+    missing_job = make_eval(job)
+    missing_job.job_id = "no-such-job"
+
+    runner = BatchEvalRunner(h.state.snapshot(), h)
+    runner.process([good, bad, missing_job])
+
+    statuses = {e.id: e.status for e in h.evals}
+    assert statuses[good.id] == "complete"
+    assert statuses[bad.id] == "failed"
+    assert statuses[missing_job.id] == "complete"  # noop plan
+
+
+def test_batch_runner_plans_all_fit():
+    """Fused lanes plan optimistically against the same snapshot; each
+    individual plan must still fit on an empty fleet."""
+    h = Harness()
+    nodes = [mock.node(i) for i in range(4)]
+    for n in nodes:
+        h.state.upsert_node(h.next_index(), n)
+    jobs = []
+    for _ in range(3):
+        j = mock.job()
+        j.task_groups[0].count = 2
+        j.task_groups[0].tasks[0].resources.cpu = 1000
+        h.state.upsert_job(h.next_index(), j)
+        jobs.append(j)
+
+    runner = BatchEvalRunner(h.state.snapshot(), h)
+    runner.process([make_eval(j) for j in jobs])
+
+    by_node = {n.id: n for n in nodes}
+    for plan in h.plans:
+        for node_id, allocs in plan.node_allocation.items():
+            fit, dim, _ = allocs_fit(by_node[node_id], allocs)
+            assert fit, dim

@@ -59,16 +59,18 @@ def test_batched_evals_are_independent():
         n_nodes=8, n_place=8)
 
     batch = 4
-    # usage/job_counts are NOT batched: every eval starts from the shared
-    # snapshot (broadcast happens on device).
+    # usage is NOT batched (shared snapshot, broadcast on device);
+    # job_counts/penalty are per-eval.
     chosen, scores, usage = place_sequence_batch(
-        fleet.capacity, fleet.reserved, view.usage, view.job_counts,
+        fleet.capacity, fleet.reserved, view.usage,
+        np.broadcast_to(view.job_counts,
+                        (batch,) + view.job_counts.shape).copy(),
         np.broadcast_to(feasible, (batch,) + feasible.shape).copy(),
         np.broadcast_to(asks, (batch,) + asks.shape).copy(),
         np.broadcast_to(distinct, (batch,) + distinct.shape).copy(),
         np.broadcast_to(group_idx, (batch,) + group_idx.shape).copy(),
         np.broadcast_to(valid, (batch,) + valid.shape).copy(),
-        10.0)
+        np.full(batch, 10.0, dtype=np.float32))
     chosen = np.asarray(chosen)
     # Every eval sees the same snapshot -> identical independent decisions.
     for b in range(1, batch):
