@@ -35,28 +35,35 @@ from nomad_tpu.structs import (  # noqa: E402
 )
 
 
+def _bench_task_group(name: str) -> TaskGroup:
+    """The one benchmark workload shape, shared by configs 4 and 5."""
+    return TaskGroup(
+        name=name,
+        count=1,
+        tasks=[Task(
+            name="web",
+            driver="exec",
+            resources=Resources(
+                cpu=100, memory_mb=64,
+                networks=[NetworkResource(mbits=5,
+                                          dynamic_ports=["http"])],
+            ),
+        )],
+    )
+
+
+def _bench_job(n_groups: int):
+    job = mock.job()
+    job.task_groups = [_bench_task_group(f"tg-{g}") for g in range(n_groups)]
+    return job
+
+
 def build_cluster(n_nodes: int, n_groups: int):
     """Mock state at scale: n_nodes ready nodes, one job with n_groups TGs."""
     h = Harness()
     for i in range(n_nodes):
         h.state.upsert_node(h.next_index(), mock.node(i))
-
-    job = mock.job()
-    job.task_groups = []
-    for g in range(n_groups):
-        job.task_groups.append(TaskGroup(
-            name=f"tg-{g}",
-            count=1,
-            tasks=[Task(
-                name="web",
-                driver="exec",
-                resources=Resources(
-                    cpu=100, memory_mb=64,
-                    networks=[NetworkResource(mbits=5,
-                                              dynamic_ports=["http"])],
-                ),
-            )],
-        ))
+    job = _bench_job(n_groups)
     h.state.upsert_job(h.next_index(), job)
     return h, job
 
@@ -120,19 +127,7 @@ def build_storm(n_nodes: int, n_jobs: int, n_groups: int):
         h.state.upsert_node(h.next_index(), mock.node(i))
     jobs = []
     for _ in range(n_jobs):
-        job = mock.job()
-        job.task_groups = []
-        for g in range(n_groups):
-            job.task_groups.append(TaskGroup(
-                name=f"tg-{g}", count=1,
-                tasks=[Task(
-                    name="web", driver="exec",
-                    resources=Resources(
-                        cpu=100, memory_mb=64,
-                        networks=[NetworkResource(
-                            mbits=5, dynamic_ports=["http"])]),
-                )],
-            ))
+        job = _bench_job(n_groups)
         h.state.upsert_job(h.next_index(), job)
         jobs.append(job)
     return h, jobs

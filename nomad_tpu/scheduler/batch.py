@@ -18,42 +18,58 @@ from __future__ import annotations
 
 import numpy as np
 
+from typing import Callable, Optional
+
 from nomad_tpu.structs import (
     EVAL_STATUS_COMPLETE,
-    EVAL_TRIGGER_JOB_DEREGISTER,
-    EVAL_TRIGGER_JOB_REGISTER,
-    EVAL_TRIGGER_NODE_UPDATE,
-    EVAL_TRIGGER_ROLLING_UPDATE,
+    EVAL_STATUS_FAILED,
     Evaluation,
 )
 
+from .generic import VALID_GENERIC_TRIGGERS
 from .interfaces import SetStatusError
 from .jax_binpack import JaxBinPackScheduler
 from .util import set_status
 
-_VALID_TRIGGERS = (
-    EVAL_TRIGGER_JOB_REGISTER, EVAL_TRIGGER_NODE_UPDATE,
-    EVAL_TRIGGER_JOB_DEREGISTER, EVAL_TRIGGER_ROLLING_UPDATE,
-)
-
 
 class BatchEvalRunner:
-    """Fuses a batch of evaluations into one device dispatch."""
+    """Fuses a batch of evaluations into one device dispatch.
 
-    def __init__(self, state, planner) -> None:
+    Per-job serialization: the eval broker guarantees at most one in-flight
+    eval per job, so batches it hands out never collide.  When called
+    directly with several evals for the SAME job, only the first joins each
+    round; the rest run in follow-up rounds against a refreshed snapshot
+    (``state_refresh``) so they see the earlier round's commits — without a
+    refresh hook the leftovers would double-place, so they are then failed
+    rather than silently over-scheduled.
+    """
+
+    def __init__(self, state, planner,
+                 state_refresh: Optional[Callable] = None) -> None:
         self.state = state
         self.planner = planner
+        self.state_refresh = state_refresh
 
     def process(self, evals: list[Evaluation]) -> None:
         from nomad_tpu.ops.binpack import place_sequence_batch
 
-        pending = []  # (scheduler, place, DeviceArgs)
+        # Serialize by job: one eval per job per round.
+        seen_jobs: set = set()
+        this_round, leftovers = [], []
         for ev in evals:
+            if ev.job_id in seen_jobs:
+                leftovers.append(ev)
+            else:
+                seen_jobs.add(ev.job_id)
+                this_round.append(ev)
+
+        pending = []  # (scheduler, place, DeviceArgs)
+        for ev in this_round:
             sched = JaxBinPackScheduler(self.state, self.planner,
                                         batch=(ev.type == "batch"))
             sched.eval = ev
-            if ev.triggered_by not in _VALID_TRIGGERS:
-                set_status(self.planner, ev, None, "failed",
+            if ev.triggered_by not in VALID_GENERIC_TRIGGERS:
+                set_status(self.planner, ev, None, EVAL_STATUS_FAILED,
                            f"scheduler cannot handle '{ev.triggered_by}' "
                            "evaluation reason")
                 continue
@@ -78,6 +94,8 @@ class BatchEvalRunner:
             pending.append((sched, place, args))
 
         if not pending:
+            if leftovers:
+                self._process_leftovers(leftovers)
             return
 
         # Harmonize pad shapes across lanes, stack, one dispatch.
@@ -113,6 +131,19 @@ class BatchEvalRunner:
         for b, (sched, place, args) in enumerate(pending):
             sched.finish_deferred(place, args, chosen[b], scores[b])
             self._finish(sched)
+
+        if leftovers:
+            self._process_leftovers(leftovers)
+
+    def _process_leftovers(self, leftovers: list) -> None:
+        if self.state_refresh is None:
+            for ev in leftovers:
+                set_status(self.planner, ev, None, EVAL_STATUS_FAILED,
+                           "duplicate eval for job in one batch and no "
+                           "state refresh available")
+            return
+        self.state = self.state_refresh()
+        self.process(leftovers)
 
     def _run_single(self, sched, place, args) -> None:
         from nomad_tpu.ops.binpack import place_sequence

@@ -48,6 +48,12 @@ from .util import (
 MAX_SERVICE_SCHEDULE_ATTEMPTS = 5
 MAX_BATCH_SCHEDULE_ATTEMPTS = 2
 
+# Triggers the generic scheduler accepts (shared with the batch runner).
+VALID_GENERIC_TRIGGERS = (
+    EVAL_TRIGGER_JOB_REGISTER, EVAL_TRIGGER_NODE_UPDATE,
+    EVAL_TRIGGER_JOB_DEREGISTER, EVAL_TRIGGER_ROLLING_UPDATE,
+)
+
 logger = logging.getLogger("nomad_tpu.scheduler.generic")
 
 
@@ -69,9 +75,7 @@ class GenericScheduler:
     def process(self, ev: Evaluation) -> None:
         self.eval = ev
 
-        if ev.triggered_by not in (
-                EVAL_TRIGGER_JOB_REGISTER, EVAL_TRIGGER_NODE_UPDATE,
-                EVAL_TRIGGER_JOB_DEREGISTER, EVAL_TRIGGER_ROLLING_UPDATE):
+        if ev.triggered_by not in VALID_GENERIC_TRIGGERS:
             set_status(self.planner, ev, self.next_eval, EVAL_STATUS_FAILED,
                        f"scheduler cannot handle '{ev.triggered_by}' "
                        "evaluation reason")

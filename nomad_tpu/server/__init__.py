@@ -1,0 +1,16 @@
+"""Server core: broker, plan queue, plan applier, FSM, raft, worker.
+
+Capability parity with the reference server layer (/root/reference/nomad/):
+leader-side in-memory queues feeding scheduler workers, a serialized plan
+applier with optimistic-concurrency semantics, a replicated-log FSM over the
+MVCC state store, and the state->HBM bridge keeping device fleet tensors in
+sync with commits.
+"""
+from .eval_broker import EvalBroker  # noqa: F401
+from .fsm import NomadFSM  # noqa: F401
+from .plan_apply import PlanApplier, evaluate_plan  # noqa: F401
+from .plan_queue import PlanQueue  # noqa: F401
+from .raft import FileLogStore, InmemRaft, SnapshotStore  # noqa: F401
+from .server import Server, ServerConfig  # noqa: F401
+from .timetable import TimeTable  # noqa: F401
+from .worker import BatchWorker, Worker  # noqa: F401

@@ -1,0 +1,261 @@
+"""Evaluation broker: leader-side priority queue with at-least-once delivery.
+
+Capability parity with /root/reference/nomad/eval_broker.go:31-604:
+  - per-scheduler-type ready heaps, highest priority first (FIFO by create
+    index within a priority);
+  - per-JobID serialization: one in-flight eval per job, later ones blocked
+    until Ack promotes the next;
+  - Wait-delayed evals armed on timers;
+  - explicit Ack/Nack with per-delivery tokens and Nack timers;
+  - delivery limit: past it the eval is routed to the ``_failed`` queue for
+    the leader's reaper.
+
+TPU-native extension: ``dequeue_batch`` drains up to ``max_batch`` ready
+evals in one call (still one per job) so the device worker can fuse them
+into a single vmapped dispatch (nomad_tpu/scheduler/batch.py).  The
+reference dequeues one eval per worker goroutine; batching is what turns
+the device's throughput into scheduler throughput.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from typing import Optional
+
+from nomad_tpu.structs import Evaluation, generate_uuid
+
+FAILED_QUEUE = "_failed"
+
+
+class _PendingHeap:
+    """Priority heap: priority desc, create index asc (eval_broker.go:570)."""
+
+    def __init__(self) -> None:
+        self._heap: list = []
+        self._count = itertools.count()
+
+    def push(self, ev: Evaluation) -> None:
+        heapq.heappush(self._heap,
+                       (-ev.priority, ev.create_index, next(self._count), ev))
+
+    def pop(self) -> Optional[Evaluation]:
+        if not self._heap:
+            return None
+        return heapq.heappop(self._heap)[3]
+
+    def peek(self) -> Optional[Evaluation]:
+        if not self._heap:
+            return None
+        return self._heap[0][3]
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+
+class _Unack:
+    __slots__ = ("eval", "token", "timer")
+
+    def __init__(self, ev: Evaluation, token: str,
+                 timer: threading.Timer) -> None:
+        self.eval = ev
+        self.token = token
+        self.timer = timer
+
+
+class EvalBroker:
+    def __init__(self, nack_timeout: float = 60.0,
+                 delivery_limit: int = 3) -> None:
+        if nack_timeout < 0:
+            raise ValueError("timeout cannot be negative")
+        self.nack_timeout = nack_timeout
+        self.delivery_limit = delivery_limit
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._enabled = False
+        self._evals: dict = {}       # eval id -> delivery attempts
+        self._job_evals: dict = {}   # job id -> in-flight eval id
+        self._blocked: dict = {}     # job id -> _PendingHeap
+        self._ready: dict = {}       # scheduler type -> _PendingHeap
+        self._unack: dict = {}       # eval id -> _Unack
+        self._time_wait: dict = {}   # eval id -> threading.Timer
+
+    # -- lifecycle --------------------------------------------------------
+    def enabled(self) -> bool:
+        with self._lock:
+            return self._enabled
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self._enabled = enabled
+        if not enabled:
+            self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            for unack in self._unack.values():
+                unack.timer.cancel()
+            for timer in self._time_wait.values():
+                timer.cancel()
+            self._evals.clear()
+            self._job_evals.clear()
+            self._blocked.clear()
+            self._ready.clear()
+            self._unack.clear()
+            self._time_wait.clear()
+            self._cond.notify_all()
+
+    # -- enqueue ----------------------------------------------------------
+    def enqueue(self, ev: Evaluation) -> None:
+        with self._lock:
+            if ev.id in self._evals:
+                return
+            if self._enabled:
+                self._evals[ev.id] = 0
+
+            if ev.wait > 0:
+                timer = threading.Timer(ev.wait, self._enqueue_waiting, [ev])
+                timer.daemon = True
+                self._time_wait[ev.id] = timer
+                timer.start()
+                return
+
+            self._enqueue_locked(ev, ev.type)
+
+    def _enqueue_waiting(self, ev: Evaluation) -> None:
+        with self._lock:
+            self._time_wait.pop(ev.id, None)
+            self._enqueue_locked(ev, ev.type)
+
+    def _enqueue_locked(self, ev: Evaluation, queue: str) -> None:
+        if not self._enabled:
+            return
+        pending = self._job_evals.get(ev.job_id)
+        if pending is None:
+            self._job_evals[ev.job_id] = ev.id
+        elif pending != ev.id:
+            self._blocked.setdefault(ev.job_id, _PendingHeap()).push(ev)
+            return
+        self._ready.setdefault(queue, _PendingHeap()).push(ev)
+        self._cond.notify_all()
+
+    # -- dequeue ----------------------------------------------------------
+    def dequeue(self, schedulers: list,
+                timeout: Optional[float] = None
+                ) -> tuple[Optional[Evaluation], str]:
+        """Blocking dequeue of the highest-priority ready eval.  A timeout
+        of None or 0 blocks indefinitely (0 matches the reference's
+        "no timer" behavior, worker.go dequeues with timeout 0)."""
+        import time as _time
+        end = None if timeout in (None, 0) else _time.monotonic() + timeout
+        with self._lock:
+            while True:
+                if not self._enabled:
+                    raise RuntimeError("eval broker disabled")
+                ev, token = self._scan_locked(schedulers)
+                if ev is not None:
+                    return ev, token
+                if end is not None:
+                    remaining = end - _time.monotonic()
+                    if remaining <= 0:
+                        return None, ""
+                    self._cond.wait(remaining)
+                else:
+                    self._cond.wait()
+
+    def dequeue_batch(self, schedulers: list, max_batch: int,
+                      timeout: Optional[float] = None) -> list:
+        """Drain up to max_batch ready evals (one per job) in one call;
+        blocks for the first one like ``dequeue``.  Returns
+        [(eval, token), ...]."""
+        first = self.dequeue(schedulers, timeout)
+        if first[0] is None:
+            return []
+        out = [first]
+        with self._lock:
+            while len(out) < max_batch:
+                ev, token = self._scan_locked(schedulers)
+                if ev is None:
+                    break
+                out.append((ev, token))
+        return out
+
+    def _scan_locked(self, schedulers: list
+                     ) -> tuple[Optional[Evaluation], str]:
+        best_sched = None
+        best_priority = None
+        for sched in schedulers:
+            heapq_ = self._ready.get(sched)
+            if not heapq_:
+                continue
+            ready = heapq_.peek()
+            if ready is None:
+                continue
+            if best_priority is None or ready.priority > best_priority:
+                best_sched, best_priority = sched, ready.priority
+        if best_sched is None:
+            return None, ""
+        ev = self._ready[best_sched].pop()
+        token = generate_uuid()
+        timer = threading.Timer(self.nack_timeout, self.nack, [ev.id, token])
+        timer.daemon = True
+        self._unack[ev.id] = _Unack(ev, token, timer)
+        self._evals[ev.id] = self._evals.get(ev.id, 0) + 1
+        timer.start()
+        return ev, token
+
+    # -- acknowledgement --------------------------------------------------
+    def outstanding(self, eval_id: str) -> tuple[str, bool]:
+        with self._lock:
+            unack = self._unack.get(eval_id)
+            if unack is None:
+                return "", False
+            return unack.token, True
+
+    def ack(self, eval_id: str, token: str) -> None:
+        with self._lock:
+            unack = self._unack.get(eval_id)
+            if unack is None:
+                raise ValueError("Evaluation ID not found")
+            if unack.token != token:
+                raise ValueError("Token does not match for Evaluation ID")
+            job_id = unack.eval.job_id
+            unack.timer.cancel()
+
+            del self._unack[eval_id]
+            self._evals.pop(eval_id, None)
+            self._job_evals.pop(job_id, None)
+
+            blocked = self._blocked.get(job_id)
+            if blocked and len(blocked):
+                ev = blocked.pop()
+                if not len(blocked):
+                    self._blocked.pop(job_id, None)
+                self._enqueue_locked(ev, ev.type)
+
+    def nack(self, eval_id: str, token: str) -> None:
+        with self._lock:
+            unack = self._unack.get(eval_id)
+            if unack is None:
+                raise ValueError("Evaluation ID not found")
+            if unack.token != token:
+                raise ValueError("Token does not match for Evaluation ID")
+            unack.timer.cancel()
+            del self._unack[eval_id]
+
+            if self._evals.get(eval_id, 0) >= self.delivery_limit:
+                self._enqueue_locked(unack.eval, FAILED_QUEUE)
+            else:
+                self._enqueue_locked(unack.eval, unack.eval.type)
+
+    # -- introspection ----------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            by_sched = {q: len(h) for q, h in self._ready.items() if len(h)}
+            return {
+                "total_ready": sum(by_sched.values()),
+                "total_unacked": len(self._unack),
+                "total_blocked": sum(len(h) for h in self._blocked.values()),
+                "total_waiting": len(self._time_wait),
+                "by_scheduler": by_sched,
+            }

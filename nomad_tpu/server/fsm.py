@@ -1,0 +1,195 @@
+"""Replicated-log state machine over the MVCC store.
+
+Capability parity with /root/reference/nomad/fsm.go:47-594: each log entry is
+a 1-byte MessageType + msgpack body; apply dispatches into the StateStore;
+pending evaluations re-enter the broker on apply (leader only); snapshots
+persist TimeTable + all tables as type-prefixed msgpack records and restore
+rebuilds a fresh store.
+
+This is also where the state->HBM bridge hangs: alloc/node applies
+invalidate the fleet-tensor caches (table-generation identity changes do it
+implicitly — see nomad_tpu/models/fleet.py FleetCache).
+"""
+from __future__ import annotations
+
+import io
+import time
+from typing import Callable, Optional
+
+import msgpack
+
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import (
+    Allocation,
+    Evaluation,
+    Job,
+    Node,
+    codec,
+)
+from nomad_tpu.structs.codec import (
+    ALLOC_CLIENT_UPDATE_REQUEST,
+    ALLOC_UPDATE_REQUEST,
+    EVAL_DELETE_REQUEST,
+    EVAL_UPDATE_REQUEST,
+    JOB_DEREGISTER_REQUEST,
+    JOB_REGISTER_REQUEST,
+    NODE_DEREGISTER_REQUEST,
+    NODE_REGISTER_REQUEST,
+    NODE_UPDATE_DRAIN_REQUEST,
+    NODE_UPDATE_STATUS_REQUEST,
+)
+
+from .timetable import TimeTable
+
+# Snapshot record types (reference fsm.go:33-42).
+SNAP_TIME_TABLE = 0
+SNAP_NODE = 1
+SNAP_JOB = 2
+SNAP_EVAL = 3
+SNAP_ALLOC = 4
+SNAP_INDEX = 5
+
+
+class NomadFSM:
+    """Applies replicated log entries to the state store."""
+
+    def __init__(self, eval_broker=None,
+                 on_apply: Optional[Callable] = None) -> None:
+        self.state = StateStore()
+        self.timetable = TimeTable()
+        self.eval_broker = eval_broker
+        self.on_apply = on_apply  # hook: (index, msg_type, payload)
+        self._handlers = {
+            NODE_REGISTER_REQUEST: self._apply_node_register,
+            NODE_DEREGISTER_REQUEST: self._apply_node_deregister,
+            NODE_UPDATE_STATUS_REQUEST: self._apply_node_status,
+            NODE_UPDATE_DRAIN_REQUEST: self._apply_node_drain,
+            JOB_REGISTER_REQUEST: self._apply_job_register,
+            JOB_DEREGISTER_REQUEST: self._apply_job_deregister,
+            EVAL_UPDATE_REQUEST: self._apply_eval_update,
+            EVAL_DELETE_REQUEST: self._apply_eval_delete,
+            ALLOC_UPDATE_REQUEST: self._apply_alloc_update,
+            ALLOC_CLIENT_UPDATE_REQUEST: self._apply_alloc_client_update,
+        }
+
+    # -- apply ------------------------------------------------------------
+    def apply(self, index: int, entry: bytes):
+        msg_type, payload, ignorable = codec.decode(entry)
+        self.timetable.witness(index, time.time())
+        handler = self._handlers.get(msg_type)
+        if handler is None:
+            if ignorable:
+                return None
+            raise ValueError(f"failed to apply request: unknown type "
+                             f"{msg_type}")
+        result = handler(index, payload)
+        if self.on_apply is not None:
+            self.on_apply(index, msg_type, payload)
+        return result
+
+    def _apply_node_register(self, index: int, payload: dict):
+        node = Node.from_dict(payload["node"])
+        self.state.upsert_node(index, node)
+        return None
+
+    def _apply_node_deregister(self, index: int, payload: dict):
+        self.state.delete_node(index, payload["node_id"])
+        return None
+
+    def _apply_node_status(self, index: int, payload: dict):
+        self.state.update_node_status(index, payload["node_id"],
+                                      payload["status"])
+        return None
+
+    def _apply_node_drain(self, index: int, payload: dict):
+        self.state.update_node_drain(index, payload["node_id"],
+                                     payload["drain"])
+        return None
+
+    def _apply_job_register(self, index: int, payload: dict):
+        self.state.upsert_job(index, Job.from_dict(payload["job"]))
+        return None
+
+    def _apply_job_deregister(self, index: int, payload: dict):
+        self.state.delete_job(index, payload["job_id"])
+        return None
+
+    def _apply_eval_update(self, index: int, payload: dict):
+        evals = [Evaluation.from_dict(e) for e in payload["evals"]]
+        self.state.upsert_evals(index, evals)
+        # Pending evals (re-)enter the broker on apply (fsm.go:243-250);
+        # the broker no-ops unless enabled (leader only).
+        if self.eval_broker is not None:
+            for ev in evals:
+                if ev.should_enqueue():
+                    self.eval_broker.enqueue(ev)
+        return None
+
+    def _apply_eval_delete(self, index: int, payload: dict):
+        self.state.delete_eval(index, payload.get("evals", []),
+                               payload.get("allocs", []))
+        return None
+
+    def _apply_alloc_update(self, index: int, payload: dict):
+        allocs = [Allocation.from_dict(a) for a in payload["alloc"]]
+        self.state.upsert_allocs(index, allocs)
+        return None
+
+    def _apply_alloc_client_update(self, index: int, payload: dict):
+        allocs = [Allocation.from_dict(a) for a in payload["alloc"]]
+        for a in allocs:
+            self.state.update_alloc_from_client(index, a)
+        return None
+
+    # -- snapshot / restore -----------------------------------------------
+    def snapshot(self) -> bytes:
+        """Serialize the full state as a stream of (kind, payload) records
+        (type-prefixed records, fsm.go:412-453)."""
+        snap = self.state.snapshot()
+        buf = io.BytesIO()
+
+        def rec(kind: int, payload) -> None:
+            buf.write(msgpack.packb((kind, payload), use_bin_type=True))
+
+        rec(SNAP_TIME_TABLE, self.timetable.serialize())
+        rec(SNAP_INDEX, {t: snap.get_index(t)
+                         for t in ("nodes", "jobs", "evals", "allocs")})
+        for node in snap.nodes():
+            rec(SNAP_NODE, node.to_dict())
+        for job in snap.jobs():
+            rec(SNAP_JOB, job.to_dict())
+        for ev in snap.evals():
+            rec(SNAP_EVAL, ev.to_dict())
+        for alloc in snap.allocs():
+            rec(SNAP_ALLOC, alloc.to_dict())
+        return buf.getvalue()
+
+    def restore(self, blob: bytes) -> None:
+        """Rebuild a fresh store from a snapshot blob (one big txn,
+        fsm.go:313-410 / state_store.go:104-112)."""
+        store = StateStore()
+        timetable = TimeTable()
+        restore = store.restore()
+        indexes: dict = {}
+        unpacker = msgpack.Unpacker(raw=False, strict_map_key=False)
+        unpacker.feed(blob)
+        for kind, payload in unpacker:
+            if kind == SNAP_TIME_TABLE:
+                timetable.deserialize(payload)
+            elif kind == SNAP_INDEX:
+                indexes = payload
+            elif kind == SNAP_NODE:
+                restore.node_restore(Node.from_dict(payload))
+            elif kind == SNAP_JOB:
+                restore.job_restore(Job.from_dict(payload))
+            elif kind == SNAP_EVAL:
+                restore.eval_restore(Evaluation.from_dict(payload))
+            elif kind == SNAP_ALLOC:
+                restore.alloc_restore(Allocation.from_dict(payload))
+            else:
+                raise ValueError(f"unrecognized snapshot record {kind}")
+        for table, index in indexes.items():
+            restore.index_restore(table, index)
+        restore.commit()
+        self.state = store
+        self.timetable = timetable

@@ -1,0 +1,90 @@
+"""Leader-side node heartbeat TTLs.
+
+Capability parity with /root/reference/nomad/heartbeat.go:13-148: each node
+gets a TTL timer; heartbeats reset it; expiry forces the node's status to
+``down``, which emits node-update evaluations so every affected job is
+rescheduled.  The TTL is rate-scaled so heartbeats stay under a target
+aggregate rate (50/s), with a floor, jitter, and a long failover TTL re-armed
+for every node when leadership moves (a new leader can't know when the last
+heartbeats happened).
+"""
+from __future__ import annotations
+
+import logging
+import random
+import threading
+from typing import Optional
+
+from nomad_tpu.structs import NODE_STATUS_DOWN
+
+logger = logging.getLogger("nomad_tpu.server.heartbeat")
+
+MIN_HEARTBEAT_TTL = 10.0
+MAX_HEARTBEATS_PER_SECOND = 50.0
+HEARTBEAT_GRACE = 10.0
+FAILOVER_HEARTBEAT_TTL = 300.0
+
+
+class HeartbeatManager:
+    def __init__(self, server,
+                 min_ttl: float = MIN_HEARTBEAT_TTL,
+                 max_rate: float = MAX_HEARTBEATS_PER_SECOND,
+                 grace: float = HEARTBEAT_GRACE,
+                 failover_ttl: float = FAILOVER_HEARTBEAT_TTL) -> None:
+        self.server = server
+        self.min_ttl = min_ttl
+        self.max_rate = max_rate
+        self.grace = grace
+        self.failover_ttl = failover_ttl
+        self._lock = threading.Lock()
+        self._timers: dict = {}  # node id -> threading.Timer
+
+    def initialize(self) -> None:
+        """On leadership gain: re-arm every known node at the failover TTL
+        (heartbeat.go:21-35)."""
+        for node in self.server.fsm.state.nodes():
+            if node.terminal_status():
+                continue
+            self._arm(node.id, self.failover_ttl)
+
+    def clear(self) -> None:
+        with self._lock:
+            for timer in self._timers.values():
+                timer.cancel()
+            self._timers.clear()
+
+    def active(self) -> int:
+        with self._lock:
+            return len(self._timers)
+
+    def reset_heartbeat_timer(self, node_id: str) -> float:
+        """Reset a node's TTL; returns the TTL the client should wait
+        (heartbeat.go:37-72)."""
+        with self._lock:
+            n = max(len(self._timers), 1)
+            ttl = max(n / self.max_rate, self.min_ttl)
+        ttl += random.random() * ttl / 16  # jitter
+        self._arm(node_id, ttl + self.grace)
+        return ttl
+
+    def _arm(self, node_id: str, ttl: float) -> None:
+        with self._lock:
+            old = self._timers.get(node_id)
+            if old is not None:
+                old.cancel()
+            timer = threading.Timer(ttl, self._invalidate, [node_id])
+            timer.daemon = True
+            self._timers[node_id] = timer
+            timer.start()
+
+    def _invalidate(self, node_id: str) -> None:
+        """TTL expired: mark the node down, spawning node-update evals
+        (heartbeat.go:84-104)."""
+        with self._lock:
+            self._timers.pop(node_id, None)
+        logger.warning("heartbeat missed for node %s, marking down", node_id)
+        try:
+            self.server.node_update_status(node_id, NODE_STATUS_DOWN)
+        except Exception:
+            logger.exception("failed to invalidate heartbeat for %s",
+                             node_id)

@@ -1,0 +1,204 @@
+"""Plan applier: the serialization point of optimistic concurrency.
+
+Capability parity with /root/reference/nomad/plan_apply.go: a single leader
+thread pops plans off the PlanQueue, verifies the eval token is outstanding,
+evaluates every touched node against a state snapshot (node ready +
+AllocsFit), partially accepts (or wholly rejects for AllAtOnce plans) with a
+RefreshIndex that forces schedulers to refresh stale state, then applies the
+accepted allocs through raft.  Verification of plan N+1 overlaps the raft
+apply of plan N via an optimistic overlay snapshot (plan_apply.go:39-124).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from nomad_tpu.structs import (
+    NODE_STATUS_READY,
+    Allocation,
+    Plan,
+    PlanResult,
+    allocs_fit,
+    codec,
+    filter_terminal_allocs,
+    remove_allocs,
+)
+
+logger = logging.getLogger("nomad_tpu.server.plan_apply")
+
+
+class OptimisticSnapshot:
+    """Read view = base snapshot + not-yet-committed alloc upserts.
+
+    Lets the applier verify plan N+1 while plan N's raft apply is still in
+    flight (the reference mutates its state snapshot in place; our MVCC
+    snapshots are immutable, so this overlay provides the same effect)."""
+
+    def __init__(self, base) -> None:
+        self.base = base
+        self._overlay: dict = {}        # alloc id -> Allocation
+        self._by_node: dict = {}        # node id -> [alloc ids]
+
+    def upsert_allocs(self, allocs: list) -> None:
+        for a in allocs:
+            if a.id not in self._overlay:
+                self._by_node.setdefault(a.node_id, []).append(a.id)
+            self._overlay[a.id] = a
+
+    # -- read API used by plan evaluation ---------------------------------
+    def node_by_id(self, node_id: str):
+        return self.base.node_by_id(node_id)
+
+    def allocs_by_node(self, node_id: str) -> list:
+        base = self.base.allocs_by_node(node_id)
+        if not self._overlay:
+            return base
+        merged = {a.id: a for a in base}
+        for aid in self._by_node.get(node_id, ()):
+            merged[aid] = self._overlay[aid]
+        return list(merged.values())
+
+    def get_index(self, table: str) -> int:
+        return self.base.get_index(table)
+
+
+def evaluate_plan(snap, plan: Plan) -> PlanResult:
+    """Determine the committable portion of a plan
+    (plan_apply.go:171-233)."""
+    result = PlanResult(failed_allocs=list(plan.failed_allocs))
+
+    node_ids = set(plan.node_update) | set(plan.node_allocation)
+    for node_id in node_ids:
+        if _evaluate_node_plan(snap, plan, node_id):
+            if plan.node_update.get(node_id):
+                result.node_update[node_id] = plan.node_update[node_id]
+            if plan.node_allocation.get(node_id):
+                result.node_allocation[node_id] = \
+                    plan.node_allocation[node_id]
+            continue
+
+        # Scheduler had stale data: RefreshIndex forces a fresh view.
+        result.refresh_index = max(snap.get_index("nodes"),
+                                   snap.get_index("allocs"))
+        if plan.all_at_once:
+            result.node_update = {}
+            result.node_allocation = {}
+            return result
+        # Partial acceptance: skip this node only.
+    return result
+
+
+def _evaluate_node_plan(snap, plan: Plan, node_id: str) -> bool:
+    """Is the plan valid for one node? (plan_apply.go:238-284)."""
+    placements = plan.node_allocation.get(node_id, [])
+    if not placements:
+        return True  # evict-only plans always fit
+
+    node = snap.node_by_id(node_id)
+    if node is None or node.status != NODE_STATUS_READY or node.drain:
+        return False
+
+    existing = filter_terminal_allocs(snap.allocs_by_node(node_id))
+    remove = list(plan.node_update.get(node_id, ())) + list(placements)
+    proposed = remove_allocs(existing, remove) + list(placements)
+
+    fit, _dim, _util = allocs_fit(node, proposed)
+    return fit
+
+
+class PlanApplier:
+    """Single leader thread draining the plan queue."""
+
+    def __init__(self, plan_queue, eval_broker, raft, state_fn) -> None:
+        self.plan_queue = plan_queue
+        self.eval_broker = eval_broker
+        self.raft = raft
+        self.state_fn = state_fn  # () -> StateStore (the FSM's live store)
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name="plan-applier")
+        self._thread.start()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def run(self) -> None:
+        wait_future = None
+        snap: Optional[OptimisticSnapshot] = None
+        while True:
+            pending = self.plan_queue.dequeue(0)
+            if pending is None:
+                return  # queue disabled: leadership lost
+
+            plan = pending.plan
+            # Token fencing: the eval must be outstanding and the token
+            # must match (guards split-brain schedulers, plan_apply.go:53).
+            token, ok = self.eval_broker.outstanding(plan.eval_id)
+            if not ok:
+                pending.respond(None, RuntimeError(
+                    "evaluation is not outstanding"))
+                continue
+            if plan.eval_token != token:
+                pending.respond(None, RuntimeError(
+                    "evaluation token does not match"))
+                continue
+
+            # If the previous apply finished, drop the stale overlay; else
+            # keep verifying against the optimistic view (this is the
+            # verify/apply overlap, plan_apply.go:68-85).
+            if wait_future is not None and wait_future.done():
+                wait_future = None
+                snap = None
+            if snap is None:
+                snap = OptimisticSnapshot(self.state_fn().snapshot())
+
+            result = evaluate_plan(snap, plan)
+            if result.is_noop():
+                pending.respond(result, None)
+                continue
+
+            # One apply in flight at a time: wait for the previous one and
+            # refresh the snapshot before dispatching (plan_apply.go:100-110;
+            # the evaluation above already ran against the optimistic view).
+            if wait_future is not None:
+                try:
+                    wait_future.wait()
+                except Exception:
+                    pass
+                wait_future = None
+                snap = OptimisticSnapshot(self.state_fn().snapshot())
+
+            # Apply through raft; respond when committed.
+            allocs = []
+            for updates in result.node_update.values():
+                allocs.extend(updates)
+            for placements in result.node_allocation.values():
+                allocs.extend(placements)
+            allocs.extend(result.failed_allocs)
+            entry = codec.encode(codec.ALLOC_UPDATE_REQUEST,
+                                 {"alloc": [a.to_dict() for a in allocs]})
+            try:
+                future = self.raft.apply(entry)
+            except Exception as e:
+                pending.respond(None, e)
+                continue
+
+            # Optimistically fold the result into the overlay so the next
+            # plan verifies against it.
+            snap.upsert_allocs(allocs)
+            wait_future = future
+
+            def respond(fut=future, res=result, pend=pending) -> None:
+                try:
+                    index, _ = fut.wait()
+                except Exception as e:
+                    pend.respond(None, e)
+                    return
+                res.alloc_index = index
+                pend.respond(res, None)
+
+            threading.Thread(target=respond, daemon=True).start()

@@ -1,0 +1,98 @@
+"""Plan queue: leader-side priority queue of pending plans.
+
+Capability parity with /root/reference/nomad/plan_queue.go:29-258: workers
+submit plans and block on a future; the leader's single plan-applier
+goroutine pops plans in priority order (priority desc, enqueue order asc)
+and responds through the future.  This is the serialization point of the
+optimistic-concurrency design.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from typing import Optional
+
+from nomad_tpu.structs import Plan, PlanResult
+
+
+class PlanFuture:
+    """Result slot a submitting worker blocks on."""
+
+    def __init__(self, plan: Plan) -> None:
+        self.plan = plan
+        self._event = threading.Event()
+        self._result: Optional[PlanResult] = None
+        self._error: Optional[Exception] = None
+
+    def respond(self, result: Optional[PlanResult],
+                error: Optional[Exception] = None) -> None:
+        self._result = result
+        self._error = error
+        self._event.set()
+
+    def wait(self, timeout: Optional[float] = None) -> PlanResult:
+        if not self._event.wait(timeout):
+            raise TimeoutError("timed out waiting for plan result")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class PlanQueue:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._enabled = False
+        self._heap: list = []
+        self._count = itertools.count()
+
+    def enabled(self) -> bool:
+        with self._lock:
+            return self._enabled
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self._enabled = enabled
+        if not enabled:
+            self.flush()
+
+    def enqueue(self, plan: Plan) -> PlanFuture:
+        with self._lock:
+            if not self._enabled:
+                raise RuntimeError("plan queue is disabled")
+            future = PlanFuture(plan)
+            heapq.heappush(self._heap,
+                           (-plan.priority, next(self._count), future))
+            self._cond.notify_all()
+            return future
+
+    def dequeue(self, timeout: Optional[float] = None
+                ) -> Optional[PlanFuture]:
+        """Block until a pending plan is available (the plan applier loop)."""
+        import time as _time
+        end = None if timeout in (None, 0) else _time.monotonic() + timeout
+        with self._lock:
+            while True:
+                if not self._enabled:
+                    return None
+                if self._heap:
+                    return heapq.heappop(self._heap)[2]
+                if end is not None:
+                    remaining = end - _time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    self._cond.wait(remaining)
+                else:
+                    self._cond.wait()
+
+    def flush(self) -> None:
+        with self._lock:
+            for _, _, future in self._heap:
+                future.respond(None, RuntimeError("plan queue flushed"))
+            self._heap.clear()
+            self._cond.notify_all()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"depth": len(self._heap)}

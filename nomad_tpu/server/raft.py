@@ -1,0 +1,204 @@
+"""Replicated log backends.
+
+The server core talks to raft through a tiny seam (``apply(entry) ->
+ApplyFuture``) mirroring how the reference submits type-prefixed log entries
+(/root/reference/nomad/rpc.go:230-256 raftApply).  Two backends:
+
+  - ``InmemRaft``: single-node, applies synchronously — the dev-mode /
+    single-server path, optionally durable via FileLogStore + snapshots
+    (BoltDB + FileSnapshotStore parity, reference nomad/server.go:397-500).
+  - ``NetRaft`` (nomad_tpu/server/raft_net.py): leader election +
+    log replication over TCP for multi-server clusters.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+import msgpack
+
+
+class ApplyFuture:
+    """Resolved when the log entry is committed and applied."""
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self.index: int = 0
+        self.response = None
+        self.error: Optional[Exception] = None
+
+    def respond(self, index: int, response=None,
+                error: Optional[Exception] = None) -> None:
+        self.index = index
+        self.response = response
+        self.error = error
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("timed out waiting for raft apply")
+        if self.error is not None:
+            raise self.error
+        return self.index, self.response
+
+
+class FileLogStore:
+    """Append-only durable log: length-prefixed msgpack records.
+
+    Parity role: raft-boltdb log store (server.go:27,429-465) — survives
+    restarts; replayed into the FSM on boot.
+    """
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._fh = open(path, "ab")
+        self._lock = threading.Lock()
+
+    def append(self, index: int, entry: bytes) -> None:
+        record = msgpack.packb((index, entry), use_bin_type=True)
+        with self._lock:
+            self._fh.write(len(record).to_bytes(4, "big"))
+            self._fh.write(record)
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+
+    def replay(self):
+        """Yield (index, entry) pairs from disk."""
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as fh:
+            while True:
+                head = fh.read(4)
+                if len(head) < 4:
+                    return
+                record = fh.read(int.from_bytes(head, "big"))
+                if not record:
+                    return
+                index, entry = msgpack.unpackb(record, raw=False)
+                yield index, entry
+
+    def truncate(self) -> None:
+        """Drop the log (after a snapshot covers it)."""
+        with self._lock:
+            self._fh.close()
+            self._fh = open(self.path, "wb")
+
+    def close(self) -> None:
+        with self._lock:
+            self._fh.close()
+
+
+class SnapshotStore:
+    """Retains the N most recent FSM snapshots on disk."""
+
+    def __init__(self, directory: str, retain: int = 2) -> None:
+        self.directory = directory
+        self.retain = retain
+        os.makedirs(directory, exist_ok=True)
+
+    def save(self, index: int, blob: bytes) -> str:
+        path = os.path.join(self.directory, f"snapshot-{index:020d}.bin")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(blob)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.rename(tmp, path)
+        self._prune()
+        return path
+
+    def latest(self) -> Optional[tuple[int, bytes]]:
+        snaps = self._list()
+        if not snaps:
+            return None
+        index, path = snaps[-1]
+        with open(path, "rb") as fh:
+            return index, fh.read()
+
+    def _list(self) -> list:
+        out = []
+        for name in sorted(os.listdir(self.directory)):
+            if name.startswith("snapshot-") and name.endswith(".bin"):
+                out.append((int(name[len("snapshot-"):-4]),
+                            os.path.join(self.directory, name)))
+        return out
+
+    def _prune(self) -> None:
+        snaps = self._list()
+        for _, path in snaps[:-self.retain]:
+            os.unlink(path)
+
+
+class InmemRaft:
+    """Single-node raft: every apply commits immediately.
+
+    With a FileLogStore the log is durable and replayed on construction;
+    ``maybe_snapshot`` compacts it through the SnapshotStore.
+    """
+
+    def __init__(self, fsm, log_store: Optional[FileLogStore] = None,
+                 snapshots: Optional[SnapshotStore] = None,
+                 snapshot_threshold: int = 8192) -> None:
+        self.fsm = fsm
+        self.log_store = log_store
+        self.snapshots = snapshots
+        self.snapshot_threshold = snapshot_threshold
+        self._lock = threading.Lock()
+        self._applied = 0
+        self._entries_since_snap = 0
+
+        # Boot: restore newest snapshot, then replay the tail of the log.
+        if snapshots is not None:
+            latest = snapshots.latest()
+            if latest is not None:
+                index, blob = latest
+                fsm.restore(blob)
+                self._applied = index
+        if log_store is not None:
+            for index, entry in log_store.replay():
+                if index <= self._applied:
+                    continue
+                fsm.apply(index, entry)
+                self._applied = index
+
+    def applied_index(self) -> int:
+        with self._lock:
+            return self._applied
+
+    def apply(self, entry: bytes) -> ApplyFuture:
+        future = ApplyFuture()
+        with self._lock:
+            index = self._applied + 1
+            if self.log_store is not None:
+                self.log_store.append(index, entry)
+            try:
+                response = self.fsm.apply(index, entry)
+            except Exception as e:  # surface apply errors to the caller
+                future.respond(index, None, e)
+                return future
+            self._applied = index
+            self._entries_since_snap += 1
+        future.respond(index, response)
+        self._maybe_snapshot()
+        return future
+
+    def barrier(self) -> int:
+        """All prior applies are visible once this returns (trivially true
+        for the in-memory backend)."""
+        return self.applied_index()
+
+    def _maybe_snapshot(self) -> None:
+        if self.snapshots is None or \
+                self._entries_since_snap < self.snapshot_threshold:
+            return
+        with self._lock:
+            blob = self.fsm.snapshot()
+            self.snapshots.save(self._applied, blob)
+            if self.log_store is not None:
+                self.log_store.truncate()
+            self._entries_since_snap = 0

@@ -1,0 +1,350 @@
+"""Server: wires raft/FSM, broker, plan queue, applier, and workers.
+
+Capability parity with /root/reference/nomad/server.go + leader.go for the
+single-server path: construction brings up the replicated log and the
+scheduling pipeline; ``establish_leadership`` enables the leader-only
+machinery (broker, plan queue, plan applier, broker restore from state) and
+``revoke_leadership`` tears it down.  The RPC/endpoint layer
+(nomad_tpu/server/endpoints.py) calls the ``apply_*``/``job_register``-style
+methods; in-process callers (agent, tests) use them directly — the same
+in-proc shortcut the reference uses (agent.go:176-178).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from nomad_tpu.structs import (
+    CORE_JOB_PRIORITY,
+    EVAL_STATUS_FAILED,
+    Evaluation,
+    Job,
+    Node,
+    codec,
+    generate_uuid,
+)
+
+from .eval_broker import FAILED_QUEUE, EvalBroker
+from .fsm import NomadFSM
+from .plan_apply import PlanApplier
+from .plan_queue import PlanQueue
+from .raft import FileLogStore, InmemRaft, SnapshotStore
+from .worker import BatchWorker, Worker
+
+logger = logging.getLogger("nomad_tpu.server")
+
+DEFAULT_SCHEDULERS = ["service", "batch", "system", "_core"]
+
+
+class ServerConfig:
+    """Tunables (reference nomad/config.go:46-236)."""
+
+    def __init__(self, **kw) -> None:
+        self.data_dir: Optional[str] = None
+        self.num_schedulers: int = 2
+        self.enabled_schedulers: list = list(DEFAULT_SCHEDULERS)
+        self.eval_nack_timeout: float = 60.0
+        self.eval_delivery_limit: int = 3
+        self.use_device_scheduler: bool = True   # jax-binpack for service
+        self.device_batch: int = 64
+        self.failed_eval_reap_interval: float = 60.0
+        self.eval_gc_interval: float = 300.0
+        self.eval_gc_threshold: float = 3600.0
+        self.node_gc_interval: float = 300.0
+        self.node_gc_threshold: float = 24 * 3600.0
+        self.region: str = "global"
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise TypeError(f"unknown config key {k!r}")
+            setattr(self, k, v)
+
+
+class Server:
+    def __init__(self, config: Optional[ServerConfig] = None) -> None:
+        self.config = config or ServerConfig()
+        self.eval_broker = EvalBroker(self.config.eval_nack_timeout,
+                                      self.config.eval_delivery_limit)
+        self.plan_queue = PlanQueue()
+        self.fsm = NomadFSM(eval_broker=self.eval_broker)
+
+        log_store = snapshots = None
+        if self.config.data_dir:
+            log_store = FileLogStore(f"{self.config.data_dir}/raft/log.bin")
+            snapshots = SnapshotStore(f"{self.config.data_dir}/snapshots")
+        self.raft = InmemRaft(self.fsm, log_store, snapshots)
+
+        self.plan_applier = PlanApplier(
+            self.plan_queue, self.eval_broker, self.raft,
+            lambda: self.fsm.state)
+        from .heartbeat import HeartbeatManager
+        self.heartbeats = HeartbeatManager(self)
+        self.workers: list = []
+        self._leader = False
+        self._shutdown = threading.Event()
+        self._leader_threads: list = []
+
+        self._setup_workers()
+
+    # -- setup ------------------------------------------------------------
+    def _setup_workers(self) -> None:
+        n = self.config.num_schedulers
+        if self.config.use_device_scheduler:
+            # One device batch worker replaces the goroutine fleet for
+            # service/batch evals; plain workers cover system/_core so the
+            # two pools never race for the same queues.
+            self.workers.append(BatchWorker(self,
+                                            self.config.device_batch))
+            rest = [q for q in self.config.enabled_schedulers
+                    if q not in BatchWorker.DEVICE_QUEUES]
+            for _ in range(max(1, n - 1)):
+                self.workers.append(Worker(self, queues=rest))
+        else:
+            for _ in range(n):
+                self.workers.append(Worker(self))
+        for w in self.workers:
+            w.start()
+
+    def enabled_schedulers(self) -> list:
+        return self.config.enabled_schedulers
+
+    # -- leadership -------------------------------------------------------
+    def establish_leadership(self) -> None:
+        """Single-node leader bring-up (reference leader.go:99-140)."""
+        if self._leader:
+            return
+        self._leader = True
+        if self.workers:
+            self.workers[0].set_pause(True)
+        self.plan_queue.set_enabled(True)
+        self.eval_broker.set_enabled(True)
+        self.plan_applier.start()
+        self._restore_eval_broker()
+        if self.workers:
+            self.workers[0].set_pause(False)
+        self.heartbeats.initialize()
+        for target, name in ((self._reap_failed_evals,
+                              "failed-eval-reaper"),
+                             (self._schedule_periodic, "periodic-gc")):
+            t = threading.Thread(target=target, daemon=True, name=name)
+            t.start()
+            self._leader_threads.append(t)
+
+    def revoke_leadership(self) -> None:
+        self._leader = False
+        self.plan_queue.set_enabled(False)
+        self.eval_broker.set_enabled(False)
+        self.heartbeats.clear()
+
+    def is_leader(self) -> bool:
+        return self._leader
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        for w in self.workers:
+            w.stop()
+        self.revoke_leadership()
+
+    def _restore_eval_broker(self) -> None:
+        """Broker is volatile; state is durable.  Re-enqueue all
+        non-terminal evals from replicated state (leader.go:145-168)."""
+        for ev in self.fsm.state.evals():
+            if ev.should_enqueue():
+                self.eval_broker.enqueue(ev)
+
+    def _reap_failed_evals(self) -> None:
+        """Mark evals past the delivery limit as failed
+        (leader.go:204-238)."""
+        while not self._shutdown.is_set() and self._leader:
+            try:
+                ev, token = self.eval_broker.dequeue(
+                    [FAILED_QUEUE], timeout=0.25)
+            except RuntimeError:
+                return
+            if ev is None:
+                continue
+            updated = ev.copy()
+            updated.status = EVAL_STATUS_FAILED
+            updated.status_description = (
+                "evaluation reached delivery limit "
+                f"({self.config.eval_delivery_limit})")
+            self.apply_eval_update([updated], token)
+            try:
+                self.eval_broker.ack(ev.id, token)
+            except ValueError:
+                pass
+
+    def _schedule_periodic(self) -> None:
+        """Emit eval-gc / node-gc core evals on their intervals
+        (leader.go:171-199)."""
+        from nomad_tpu.structs import CORE_JOB_EVAL_GC, CORE_JOB_NODE_GC
+
+        last_eval_gc = last_node_gc = time.monotonic()
+        while not self._shutdown.is_set() and self._leader:
+            time.sleep(0.25)
+            now = time.monotonic()
+            if now - last_eval_gc >= self.config.eval_gc_interval:
+                self._enqueue_core_eval(CORE_JOB_EVAL_GC)
+                last_eval_gc = now
+            if now - last_node_gc >= self.config.node_gc_interval:
+                self._enqueue_core_eval(CORE_JOB_NODE_GC)
+                last_node_gc = now
+
+    def _enqueue_core_eval(self, core_job_id: str) -> None:
+        ev = Evaluation(
+            id=generate_uuid(),
+            priority=CORE_JOB_PRIORITY,
+            type="_core",
+            triggered_by="scheduled",
+            job_id=core_job_id,
+            status="pending",
+            modify_index=self.raft.applied_index(),
+        )
+        # Core evals skip raft: they are leader-local work
+        # (leader.go:188-199).
+        self.eval_broker.enqueue(ev)
+
+    # -- raft-backed mutations (the endpoint layer calls these) -----------
+    def raft_apply(self, msg_type: int, payload: dict) -> int:
+        entry = codec.encode(msg_type, payload)
+        index, _ = self.raft.apply(entry).wait(30.0)
+        return index
+
+    def apply_eval_update(self, evals: list, token: str = "") -> int:
+        # Token fencing for in-flight evals (eval_endpoint.go:123-143):
+        # an eval that is outstanding may only be updated by its holder.
+        for ev in evals:
+            held, ok = self.eval_broker.outstanding(ev.id)
+            if ok and held != token:
+                raise PermissionError(
+                    f"eval {ev.id} token does not match outstanding token")
+        return self.raft_apply(
+            codec.EVAL_UPDATE_REQUEST,
+            {"evals": [e.to_dict() for e in evals]})
+
+    # -- convenience write paths (job/node endpoints use these) ------------
+    def job_register(self, job: Job) -> tuple[int, str]:
+        errs = job.validate()
+        if errs:
+            raise ValueError("; ".join(errs))
+        index = self.raft_apply(codec.JOB_REGISTER_REQUEST,
+                                {"job": job.to_dict()})
+        ev = Evaluation(
+            id=generate_uuid(),
+            priority=job.priority,
+            type=job.type,
+            triggered_by="job-register",
+            job_id=job.id,
+            job_modify_index=index,
+            status="pending",
+            modify_index=index,
+            create_index=index,
+        )
+        self.apply_eval_update([ev])
+        return index, ev.id
+
+    def job_deregister(self, job_id: str) -> tuple[int, str]:
+        job = self.fsm.state.job_by_id(job_id)
+        index = self.raft_apply(codec.JOB_DEREGISTER_REQUEST,
+                                {"job_id": job_id})
+        ev = Evaluation(
+            id=generate_uuid(),
+            priority=job.priority if job else CORE_JOB_PRIORITY,
+            type=job.type if job else "service",
+            triggered_by="job-deregister",
+            job_id=job_id,
+            modify_index=index,
+            create_index=index,
+            status="pending",
+        )
+        self.apply_eval_update([ev])
+        return index, ev.id
+
+    def node_register(self, node: Node) -> int:
+        return self.raft_apply(codec.NODE_REGISTER_REQUEST,
+                               {"node": node.to_dict()})
+
+    def node_deregister(self, node_id: str) -> int:
+        index = self.raft_apply(codec.NODE_DEREGISTER_REQUEST,
+                                {"node_id": node_id})
+        self.create_node_evals(node_id, index)
+        return index
+
+    def node_update_status(self, node_id: str, status: str) -> int:
+        """Transition a node's status; drain-worthy transitions emit
+        node-update evals (node_endpoint.go:121-170)."""
+        from nomad_tpu.structs import should_drain_node, valid_node_status
+
+        if not valid_node_status(status):
+            raise ValueError(f"invalid node status {status!r}")
+        index = self.raft_apply(codec.NODE_UPDATE_STATUS_REQUEST,
+                                {"node_id": node_id, "status": status})
+        if should_drain_node(status):
+            self.create_node_evals(node_id, index)
+        return index
+
+    def node_update_drain(self, node_id: str, drain: bool) -> int:
+        index = self.raft_apply(codec.NODE_UPDATE_DRAIN_REQUEST,
+                                {"node_id": node_id, "drain": drain})
+        if drain:
+            self.create_node_evals(node_id, index)
+        return index
+
+    def node_heartbeat(self, node_id: str) -> float:
+        """Client heartbeat: re-arms the TTL timer, returns the next TTL."""
+        node = self.fsm.state.node_by_id(node_id)
+        if node is None:
+            raise KeyError(f"node not found: {node_id}")
+        return self.heartbeats.reset_heartbeat_timer(node_id)
+
+    def node_evaluate(self, node_id: str) -> list:
+        """Force evals for all jobs with allocs on a node."""
+        return self.create_node_evals(node_id, self.raft.applied_index())
+
+    def create_node_evals(self, node_id: str, node_index: int) -> list:
+        """One eval per job with allocs on the node, plus every system job
+        (node_endpoint.go:440-532)."""
+        state = self.fsm.state
+        jobs: dict = {}
+        for alloc in state.allocs_by_node(node_id):
+            if alloc.job_id not in jobs:
+                job = state.job_by_id(alloc.job_id) or alloc.job
+                if job is not None:
+                    jobs[alloc.job_id] = job
+        for job in state.jobs_by_scheduler("system"):
+            jobs.setdefault(job.id, job)
+
+        evals = []
+        for job in jobs.values():
+            evals.append(Evaluation(
+                id=generate_uuid(),
+                priority=job.priority,
+                type=job.type,
+                triggered_by="node-update",
+                job_id=job.id,
+                node_id=node_id,
+                node_modify_index=node_index,
+                status="pending",
+            ))
+        if evals:
+            self.apply_eval_update(evals)
+        return [e.id for e in evals]
+
+    def wait_for_evals(self, eval_ids: list, timeout: float = 10.0) -> dict:
+        """Test/CLI helper: poll until the given evals reach a terminal
+        status; returns eval id -> status."""
+        deadline = time.monotonic() + timeout
+        out: dict = {}
+        while time.monotonic() < deadline:
+            done = True
+            for eid in eval_ids:
+                ev = self.fsm.state.eval_by_id(eid)
+                if ev is None or not ev.terminal_status():
+                    done = False
+                    break
+                out[eid] = ev.status
+            if done:
+                return out
+            time.sleep(0.01)
+        raise TimeoutError(f"evals not terminal after {timeout}s")

@@ -1,0 +1,222 @@
+"""Scheduler workers: dequeue evals, invoke schedulers, submit plans.
+
+Capability parity with /root/reference/nomad/worker.go:50-437: each worker
+loops dequeue -> wait for raft catch-up -> snapshot -> instantiate scheduler
+by eval type -> Process -> Ack/Nack.  The worker implements the scheduler's
+``Planner`` seam: SubmitPlan stamps the eval token, enqueues on the plan
+queue, blocks on the future, and hands back a refreshed state snapshot when
+the applier signals stale data (RefreshIndex).
+
+TPU-native extension: ``BatchWorker`` drains a batch of ready evals in one
+call and fuses them through BatchEvalRunner into a single device dispatch —
+the device replaces the reference's NumCPU-goroutine worker pool as the
+source of scheduling throughput.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from nomad_tpu.scheduler import new_scheduler
+from nomad_tpu.structs import Evaluation, Plan, PlanResult, codec
+
+logger = logging.getLogger("nomad_tpu.server.worker")
+
+RAFT_SYNC_LIMIT = 5.0  # reference worker.go:34-37
+BACKOFF_BASE = 0.05
+BACKOFF_LIMIT = 3.0
+
+
+class Worker:
+    """One scheduling worker thread."""
+
+    def __init__(self, server, scheduler_override: Optional[str] = None,
+                 queues: Optional[list] = None) -> None:
+        self.server = server
+        self.scheduler_override = scheduler_override
+        self.queues = queues  # None = all enabled schedulers
+        self._stop = threading.Event()
+        self._paused = threading.Event()
+        self._pause_cond = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+        self.eval_token: str = ""
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name="scheduler-worker")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def set_pause(self, paused: bool) -> None:
+        """Leader reserves a worker's CPU for its own duties
+        (worker.go:77-93)."""
+        with self._pause_cond:
+            if paused:
+                self._paused.set()
+            else:
+                self._paused.clear()
+                self._pause_cond.notify_all()
+
+    def _check_paused(self) -> None:
+        with self._pause_cond:
+            while self._paused.is_set() and not self._stop.is_set():
+                self._pause_cond.wait(0.1)
+
+    # -- main loop --------------------------------------------------------
+    def run(self) -> None:
+        while not self._stop.is_set():
+            self._check_paused()
+            queues = self.queues or self.server.enabled_schedulers()
+            try:
+                ev, token = self.server.eval_broker.dequeue(
+                    queues, timeout=0.25)
+            except RuntimeError:
+                time.sleep(BACKOFF_BASE)
+                continue
+            if ev is None:
+                continue
+            self.eval_token = token
+            try:
+                self._wait_for_index(ev.modify_index, RAFT_SYNC_LIMIT)
+                self._invoke_scheduler(ev)
+            except Exception:
+                logger.exception("worker: failed to process eval %s", ev.id)
+                try:
+                    self.server.eval_broker.nack(ev.id, token)
+                except ValueError:
+                    pass
+                continue
+            try:
+                self.server.eval_broker.ack(ev.id, token)
+            except ValueError:
+                pass
+
+    def _wait_for_index(self, index: int, timeout: float) -> None:
+        """Block until the local FSM has applied at least `index`
+        (worker.go:209-230)."""
+        deadline = time.monotonic() + timeout
+        while self.server.raft.applied_index() < index:
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"timed out waiting for raft index {index}")
+            time.sleep(0.005)
+
+    def _invoke_scheduler(self, ev: Evaluation) -> None:
+        state = self.server.fsm.state.snapshot()
+        name = self.scheduler_override or ev.type
+        if name == "_core":
+            from .core_sched import CoreScheduler
+            CoreScheduler(self.server, state).process(ev)
+            return
+        sched = new_scheduler(name, state, self)
+        sched.process(ev)
+
+    # -- Planner seam ------------------------------------------------------
+    def submit_plan(self, plan: Plan) -> tuple[PlanResult, Optional[object]]:
+        plan.eval_token = self.eval_token
+        future = self.server.plan_queue.enqueue(plan)
+        result = future.wait()
+        state = None
+        if result is not None and result.refresh_index > 0:
+            # Stale scheduler data: catch up and hand back a fresh view.
+            self._wait_for_index(result.refresh_index, RAFT_SYNC_LIMIT)
+            state = self.server.fsm.state.snapshot()
+        return result, state
+
+    def update_eval(self, ev: Evaluation) -> None:
+        self.server.apply_eval_update([ev], self.eval_token)
+
+    def create_eval(self, ev: Evaluation) -> None:
+        self.server.apply_eval_update([ev], self.eval_token)
+
+
+class BatchWorker(Worker):
+    """Drains ready evals in batches and fuses them on device."""
+
+    def __init__(self, server, max_batch: int = 64) -> None:
+        super().__init__(server, scheduler_override=None)
+        self.max_batch = max_batch
+        self._tokens: dict = {}
+
+    # The fused device runner implements generic (service/batch) semantics;
+    # system and _core evals go to the plain workers.
+    DEVICE_QUEUES = ("service", "batch")
+
+    def run(self) -> None:
+        from nomad_tpu.scheduler.batch import BatchEvalRunner
+
+        while not self._stop.is_set():
+            self._check_paused()
+            queues = [q for q in self.server.enabled_schedulers()
+                      if q in self.DEVICE_QUEUES]
+            try:
+                batch = self.server.eval_broker.dequeue_batch(
+                    queues, self.max_batch,
+                    timeout=0.25)
+            except RuntimeError:
+                time.sleep(BACKOFF_BASE)
+                continue
+            if not batch:
+                continue
+            max_index = max(ev.modify_index for ev, _ in batch)
+            try:
+                self._wait_for_index(max_index, RAFT_SYNC_LIMIT)
+            except TimeoutError:
+                for ev, token in batch:
+                    try:
+                        self.server.eval_broker.nack(ev.id, token)
+                    except ValueError:
+                        pass
+                continue
+
+            self._tokens = {ev.id: token for ev, token in batch}
+            state = self.server.fsm.state.snapshot()
+            runner = BatchEvalRunner(
+                state, _BatchPlanner(self),
+                state_refresh=lambda: self.server.fsm.state.snapshot())
+            try:
+                runner.process([ev for ev, _ in batch])
+            except Exception:
+                logger.exception("batch worker: dispatch failed")
+                for ev, token in batch:
+                    try:
+                        self.server.eval_broker.nack(ev.id, token)
+                    except ValueError:
+                        pass
+                continue
+            for ev, token in batch:
+                try:
+                    self.server.eval_broker.ack(ev.id, token)
+                except ValueError:
+                    pass
+
+
+class _BatchPlanner:
+    """Planner seam for the batch runner: per-eval token stamping."""
+
+    def __init__(self, worker: BatchWorker) -> None:
+        self.worker = worker
+
+    def submit_plan(self, plan: Plan):
+        plan.eval_token = self.worker._tokens.get(plan.eval_id, "")
+        future = self.worker.server.plan_queue.enqueue(plan)
+        result = future.wait()
+        state = None
+        if result is not None and result.refresh_index > 0:
+            self.worker._wait_for_index(result.refresh_index,
+                                        RAFT_SYNC_LIMIT)
+            state = self.worker.server.fsm.state.snapshot()
+        return result, state
+
+    def update_eval(self, ev: Evaluation) -> None:
+        self.worker.server.apply_eval_update(
+            [ev], self.worker._tokens.get(ev.id, ""))
+
+    def create_eval(self, ev: Evaluation) -> None:
+        self.worker.server.apply_eval_update(
+            [ev], self.worker._tokens.get(ev.previous_eval, ""))

@@ -23,23 +23,37 @@ from .model import (
 
 
 _CIDR_CACHE: dict = {}
+_CIDR_CACHE_MAX_IPS = 256
 
 
-def _cidr_ips(cidr: str) -> list:
-    """Expand a CIDR to its IP strings, cached (node CIDRs are static and
-    tiny — typically /32 — but re-parsing per placement dominated the
-    scheduler's host time)."""
-    ips = _CIDR_CACHE.get(cidr)
-    if ips is None:
+def _cidr_ips(cidr: str):
+    """Yield a CIDR's IP strings; the first 256 are cached (node CIDRs are
+    static and usually /32 — re-parsing per placement dominated the
+    scheduler's host time), the rest iterate lazily so a /8 or IPv6 block
+    never materializes in memory."""
+    cached = _CIDR_CACHE.get(cidr)
+    if cached is None:
         try:
             net = ipaddress.ip_network(cidr, strict=False)
-            ips = [str(ip) for ip in net]
         except ValueError:
-            ips = []
+            _CIDR_CACHE[cidr] = ([], True)
+            return
+        head: list = []
+        complete = True
+        for ip in net:
+            if len(head) >= _CIDR_CACHE_MAX_IPS:
+                complete = False
+                break
+            head.append(str(ip))
         if len(_CIDR_CACHE) > 65536:
             _CIDR_CACHE.clear()
-        _CIDR_CACHE[cidr] = ips
-    return ips
+        _CIDR_CACHE[cidr] = cached = (head, complete)
+    head, complete = cached
+    yield from head
+    if not complete:
+        for i, ip in enumerate(ipaddress.ip_network(cidr, strict=False)):
+            if i >= _CIDR_CACHE_MAX_IPS:
+                yield str(ip)
 
 
 class NetworkIndex:

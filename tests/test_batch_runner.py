@@ -113,3 +113,41 @@ def test_batch_runner_plans_all_fit():
         for node_id, allocs in plan.node_allocation.items():
             fit, dim, _ = allocs_fit(by_node[node_id], allocs)
             assert fit, dim
+
+
+def test_batch_runner_serializes_same_job_evals():
+    """Two evals for the same job in one call must not double-place
+    (code-review regression): the second runs against refreshed state."""
+    h = Harness()
+    for i in range(8):
+        h.state.upsert_node(h.next_index(), mock.node(i))
+    job = mock.job()
+    job.task_groups[0].count = 4
+    h.state.upsert_job(h.next_index(), job)
+
+    runner = BatchEvalRunner(h.state.snapshot(), h,
+                             state_refresh=lambda: h.state.snapshot())
+    runner.process([make_eval(job), make_eval(job)])
+
+    live = [a for a in h.state.allocs_by_job(job.id)
+            if not a.terminal_status()]
+    assert len(live) == 4, f"expected 4 allocs, got {len(live)}"
+
+
+def test_batch_runner_same_job_without_refresh_fails_safe():
+    h = Harness()
+    for i in range(8):
+        h.state.upsert_node(h.next_index(), mock.node(i))
+    job = mock.job()
+    job.task_groups[0].count = 2
+    h.state.upsert_job(h.next_index(), job)
+
+    runner = BatchEvalRunner(h.state.snapshot(), h)
+    e1, e2 = make_eval(job), make_eval(job)
+    runner.process([e1, e2])
+    statuses = {e.id: e.status for e in h.evals}
+    assert statuses[e1.id] == "complete"
+    assert statuses[e2.id] == "failed"
+    live = [a for a in h.state.allocs_by_job(job.id)
+            if not a.terminal_status()]
+    assert len(live) == 2

@@ -1,0 +1,487 @@
+"""Server-core tests: broker, plan queue, timetable, FSM, full pipeline."""
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.server import (
+    EvalBroker,
+    NomadFSM,
+    InmemRaft,
+    PlanQueue,
+    Server,
+    ServerConfig,
+    TimeTable,
+)
+from nomad_tpu.structs import Evaluation, Plan, codec, generate_uuid
+
+
+def make_eval(priority=50, type_="service", job_id=None) -> Evaluation:
+    return Evaluation(
+        id=generate_uuid(), priority=priority, type=type_,
+        job_id=job_id or generate_uuid(), status="pending",
+        triggered_by="job-register",
+    )
+
+
+# ---------------------------------------------------------------------------
+# EvalBroker
+# ---------------------------------------------------------------------------
+
+class TestEvalBroker:
+    def test_enqueue_dequeue_priority(self):
+        b = EvalBroker(nack_timeout=5, delivery_limit=3)
+        b.set_enabled(True)
+        low = make_eval(priority=20)
+        high = make_eval(priority=90)
+        b.enqueue(low)
+        b.enqueue(high)
+        ev, token = b.dequeue(["service"], timeout=1)
+        assert ev.id == high.id
+        assert token
+        ev2, _ = b.dequeue(["service"], timeout=1)
+        assert ev2.id == low.id
+
+    def test_disabled_raises(self):
+        b = EvalBroker(5, 3)
+        with pytest.raises(RuntimeError):
+            b.dequeue(["service"], timeout=0.05)
+
+    def test_per_job_serialization(self):
+        b = EvalBroker(5, 3)
+        b.set_enabled(True)
+        e1 = make_eval(job_id="job-1")
+        e2 = make_eval(job_id="job-1")
+        b.enqueue(e1)
+        b.enqueue(e2)
+        ev, token = b.dequeue(["service"], timeout=1)
+        assert ev.id == e1.id
+        # Second eval for the job is blocked.
+        none, _ = b.dequeue(["service"], timeout=0.05)
+        assert none is None
+        assert b.stats()["total_blocked"] == 1
+        # Ack unblocks it.
+        b.ack(e1.id, token)
+        ev2, _ = b.dequeue(["service"], timeout=1)
+        assert ev2.id == e2.id
+
+    def test_nack_requeues_then_fails(self):
+        b = EvalBroker(5, delivery_limit=2)
+        b.set_enabled(True)
+        ev = make_eval()
+        b.enqueue(ev)
+        for _ in range(2):
+            got, token = b.dequeue(["service"], timeout=1)
+            assert got.id == ev.id
+            b.nack(ev.id, token)
+        # Past the delivery limit: routed to _failed.
+        got, token = b.dequeue(["_failed"], timeout=1)
+        assert got.id == ev.id
+
+    def test_nack_timer_fires(self):
+        b = EvalBroker(nack_timeout=0.05, delivery_limit=3)
+        b.set_enabled(True)
+        ev = make_eval()
+        b.enqueue(ev)
+        got, token = b.dequeue(["service"], timeout=1)
+        time.sleep(0.15)  # nack timer auto-fires
+        got2, _ = b.dequeue(["service"], timeout=1)
+        assert got2.id == ev.id
+
+    def test_wait_delay(self):
+        b = EvalBroker(5, 3)
+        b.set_enabled(True)
+        ev = make_eval()
+        ev.wait = 0.08
+        b.enqueue(ev)
+        none, _ = b.dequeue(["service"], timeout=0.02)
+        assert none is None
+        got, _ = b.dequeue(["service"], timeout=1)
+        assert got.id == ev.id
+
+    def test_dequeue_batch(self):
+        b = EvalBroker(5, 3)
+        b.set_enabled(True)
+        evs = [make_eval() for _ in range(5)]
+        for e in evs:
+            b.enqueue(e)
+        batch = b.dequeue_batch(["service"], max_batch=3, timeout=1)
+        assert len(batch) == 3
+        assert len({e.id for e, _ in batch}) == 3
+
+    def test_dedup_enqueue(self):
+        b = EvalBroker(5, 3)
+        b.set_enabled(True)
+        ev = make_eval()
+        b.enqueue(ev)
+        b.enqueue(ev)
+        b.dequeue(["service"], timeout=1)
+        none, _ = b.dequeue(["service"], timeout=0.05)
+        assert none is None
+
+    def test_token_mismatch(self):
+        b = EvalBroker(5, 3)
+        b.set_enabled(True)
+        ev = make_eval()
+        b.enqueue(ev)
+        got, token = b.dequeue(["service"], timeout=1)
+        with pytest.raises(ValueError):
+            b.ack(ev.id, "wrong-token")
+        b.ack(ev.id, token)
+
+
+# ---------------------------------------------------------------------------
+# PlanQueue
+# ---------------------------------------------------------------------------
+
+class TestPlanQueue:
+    def test_priority_order_and_future(self):
+        q = PlanQueue()
+        q.set_enabled(True)
+        f1 = q.enqueue(Plan(priority=10))
+        f2 = q.enqueue(Plan(priority=90))
+        first = q.dequeue(timeout=1)
+        assert first.plan.priority == 90
+        second = q.dequeue(timeout=1)
+        assert second.plan.priority == 10
+        # future round trip
+        from nomad_tpu.structs import PlanResult
+        result = PlanResult(alloc_index=7)
+        first.respond(result)
+        assert f2.wait(1).alloc_index == 7
+
+    def test_flush_fails_waiters(self):
+        q = PlanQueue()
+        q.set_enabled(True)
+        f = q.enqueue(Plan())
+        q.set_enabled(False)
+        with pytest.raises(RuntimeError):
+            f.wait(1)
+
+
+# ---------------------------------------------------------------------------
+# TimeTable
+# ---------------------------------------------------------------------------
+
+def test_timetable_witness_and_lookup():
+    tt = TimeTable(granularity=10, limit=3)
+    tt.witness(10, 100.0)
+    tt.witness(20, 200.0)
+    tt.witness(30, 300.0)
+    tt.witness(25, 305.0)  # lower index ignored
+    assert tt.nearest_index(250.0) == 20
+    assert tt.nearest_index(50.0) == 0
+    assert tt.nearest_index(1000.0) == 30
+    rows = tt.serialize()
+    tt2 = TimeTable()
+    tt2.deserialize(rows)
+    assert tt2.nearest_index(250.0) == 20
+
+
+# ---------------------------------------------------------------------------
+# FSM
+# ---------------------------------------------------------------------------
+
+class TestFSM:
+    def test_apply_and_snapshot_roundtrip(self):
+        fsm = NomadFSM()
+        node = mock.node()
+        job = mock.job()
+        fsm.apply(1, codec.encode(codec.NODE_REGISTER_REQUEST,
+                                  {"node": node.to_dict()}))
+        fsm.apply(2, codec.encode(codec.JOB_REGISTER_REQUEST,
+                                  {"job": job.to_dict()}))
+        ev = make_eval(job_id=job.id)
+        fsm.apply(3, codec.encode(codec.EVAL_UPDATE_REQUEST,
+                                  {"evals": [ev.to_dict()]}))
+        alloc = mock.alloc()
+        alloc.node_id = node.id
+        fsm.apply(4, codec.encode(codec.ALLOC_UPDATE_REQUEST,
+                                  {"alloc": [alloc.to_dict()]}))
+
+        blob = fsm.snapshot()
+        fsm2 = NomadFSM()
+        fsm2.restore(blob)
+        assert fsm2.state.node_by_id(node.id).name == node.name
+        assert fsm2.state.job_by_id(job.id).name == job.name
+        assert fsm2.state.eval_by_id(ev.id) is not None
+        restored = fsm2.state.alloc_by_id(alloc.id)
+        assert restored.resources.cpu == alloc.resources.cpu
+        assert restored.job.task_groups[0].tasks[0].name == "web"
+
+    def test_eval_apply_enqueues_into_broker(self):
+        broker = EvalBroker(5, 3)
+        broker.set_enabled(True)
+        fsm = NomadFSM(eval_broker=broker)
+        ev = make_eval()
+        fsm.apply(1, codec.encode(codec.EVAL_UPDATE_REQUEST,
+                                  {"evals": [ev.to_dict()]}))
+        got, _ = broker.dequeue(["service"], timeout=1)
+        assert got.id == ev.id
+
+    def test_unknown_type(self):
+        fsm = NomadFSM()
+        with pytest.raises(ValueError):
+            fsm.apply(1, codec.encode(99, {}))
+        # ignorable flag: no error
+        fsm.apply(2, codec.encode(99 | codec.IGNORE_UNKNOWN_TYPE_FLAG, {}))
+
+    def test_client_update_merges_status_only(self):
+        fsm = NomadFSM()
+        alloc = mock.alloc()
+        fsm.apply(1, codec.encode(codec.ALLOC_UPDATE_REQUEST,
+                                  {"alloc": [alloc.to_dict()]}))
+        update = alloc.copy()
+        update.client_status = "running"
+        update.desired_status = "SHOULD-NOT-MOVE"
+        fsm.apply(2, codec.encode(codec.ALLOC_CLIENT_UPDATE_REQUEST,
+                                  {"alloc": [update.to_dict()]}))
+        stored = fsm.state.alloc_by_id(alloc.id)
+        assert stored.client_status == "running"
+        assert stored.desired_status == alloc.desired_status
+
+
+# ---------------------------------------------------------------------------
+# Durable raft backend
+# ---------------------------------------------------------------------------
+
+def test_raft_log_replay_and_snapshot(tmp_path):
+    from nomad_tpu.server.raft import FileLogStore, SnapshotStore
+
+    log = FileLogStore(str(tmp_path / "log.bin"))
+    fsm = NomadFSM()
+    raft = InmemRaft(fsm, log)
+    node = mock.node()
+    raft.apply(codec.encode(codec.NODE_REGISTER_REQUEST,
+                            {"node": node.to_dict()})).wait(1)
+    log.close()
+
+    # Reboot: replay from disk.
+    fsm2 = NomadFSM()
+    raft2 = InmemRaft(fsm2, FileLogStore(str(tmp_path / "log.bin")))
+    assert raft2.applied_index() == 1
+    assert fsm2.state.node_by_id(node.id) is not None
+
+
+# ---------------------------------------------------------------------------
+# Full pipeline: Server end-to-end
+# ---------------------------------------------------------------------------
+
+def make_server(**kw) -> Server:
+    cfg = ServerConfig(num_schedulers=2, **kw)
+    srv = Server(cfg)
+    srv.establish_leadership()
+    return srv
+
+
+class TestServerEndToEnd:
+    def test_job_register_schedules_allocs(self):
+        srv = make_server()
+        try:
+            for i in range(5):
+                srv.node_register(mock.node(i))
+            job = mock.job()
+            job.task_groups[0].count = 5
+            _, eval_id = srv.job_register(job)
+            statuses = srv.wait_for_evals([eval_id], timeout=15)
+            assert statuses[eval_id] == "complete"
+            allocs = srv.fsm.state.allocs_by_job(job.id)
+            placed = [a for a in allocs if a.node_id]
+            assert len(placed) == 5
+            # Spread across nodes by anti-affinity.
+            assert len({a.node_id for a in placed}) == 5
+        finally:
+            srv.shutdown()
+
+    def test_job_register_device_scheduler_off(self):
+        srv = make_server(use_device_scheduler=False)
+        try:
+            for i in range(4):
+                srv.node_register(mock.node(i))
+            job = mock.job()
+            job.task_groups[0].count = 4
+            _, eval_id = srv.job_register(job)
+            statuses = srv.wait_for_evals([eval_id], timeout=15)
+            assert statuses[eval_id] == "complete"
+            assert len(srv.fsm.state.allocs_by_job(job.id)) == 4
+        finally:
+            srv.shutdown()
+
+    def test_concurrent_jobs_no_oversubscription(self):
+        from nomad_tpu.structs import allocs_fit
+
+        srv = make_server()
+        try:
+            nodes = [mock.node(i) for i in range(4)]
+            for n in nodes:
+                srv.node_register(n)
+            eval_ids, jobs = [], []
+            for _ in range(6):
+                job = mock.job()
+                job.task_groups[0].count = 2
+                job.task_groups[0].tasks[0].resources.cpu = 800
+                _, eid = srv.job_register(job)
+                jobs.append(job)
+                eval_ids.append(eid)
+            srv.wait_for_evals(eval_ids, timeout=20)
+            # The plan applier must never commit an oversubscribed node.
+            state = srv.fsm.state
+            for node in nodes:
+                allocs = [a for a in state.allocs_by_node(node.id)
+                          if not a.terminal_status() and a.node_id]
+                fit, dim, _ = allocs_fit(node, allocs)
+                assert fit, f"node oversubscribed: {dim}"
+        finally:
+            srv.shutdown()
+
+    def test_job_deregister_stops_allocs(self):
+        srv = make_server()
+        try:
+            for i in range(3):
+                srv.node_register(mock.node(i))
+            job = mock.job()
+            job.task_groups[0].count = 3
+            _, e1 = srv.job_register(job)
+            srv.wait_for_evals([e1], timeout=15)
+            _, e2 = srv.job_deregister(job.id)
+            srv.wait_for_evals([e2], timeout=15)
+            allocs = srv.fsm.state.allocs_by_job(job.id)
+            stopped = [a for a in allocs if a.desired_status == "stop"]
+            assert len(stopped) == 3
+        finally:
+            srv.shutdown()
+
+
+class TestNodeLifecycle:
+    def test_node_down_triggers_migration(self):
+        srv = make_server()
+        try:
+            nodes = [mock.node(i) for i in range(4)]
+            for n in nodes:
+                srv.node_register(n)
+            job = mock.job()
+            job.task_groups[0].count = 2
+            _, e1 = srv.job_register(job)
+            srv.wait_for_evals([e1], timeout=15)
+            placed = {a.node_id for a in srv.fsm.state.allocs_by_job(job.id)}
+
+            victim = next(iter(placed))
+            srv.node_update_status(victim, "down")
+            # A node-update eval per affected job reschedules the allocs.
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                allocs = srv.fsm.state.allocs_by_job(job.id)
+                live = [a for a in allocs if not a.terminal_status()]
+                if len(live) == 2 and all(a.node_id != victim for a in live):
+                    break
+                time.sleep(0.02)
+            else:
+                raise AssertionError("allocs were not migrated off the "
+                                     "down node")
+        finally:
+            srv.shutdown()
+
+    def test_drain_migrates_allocs(self):
+        srv = make_server()
+        try:
+            for i in range(3):
+                srv.node_register(mock.node(i))
+            job = mock.job()
+            job.task_groups[0].count = 1
+            _, e1 = srv.job_register(job)
+            srv.wait_for_evals([e1], timeout=15)
+            alloc = srv.fsm.state.allocs_by_job(job.id)[0]
+
+            srv.node_update_drain(alloc.node_id, True)
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                live = [a for a in srv.fsm.state.allocs_by_job(job.id)
+                        if not a.terminal_status()]
+                if live and all(a.node_id != alloc.node_id for a in live):
+                    break
+                time.sleep(0.02)
+            else:
+                raise AssertionError("alloc not migrated off drained node")
+        finally:
+            srv.shutdown()
+
+    def test_heartbeat_ttl_and_expiry(self):
+        srv = make_server()
+        srv.heartbeats.min_ttl = 0.1
+        srv.heartbeats.grace = 0.05
+        try:
+            node = mock.node()
+            srv.node_register(node)
+            ttl = srv.node_heartbeat(node.id)
+            assert ttl >= 0.1
+            # Stop heartbeating: the node must be marked down.
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                n = srv.fsm.state.node_by_id(node.id)
+                if n.status == "down":
+                    break
+                time.sleep(0.02)
+            else:
+                raise AssertionError("node not marked down after TTL")
+        finally:
+            srv.shutdown()
+
+    def test_system_job_runs_everywhere(self):
+        srv = make_server()
+        try:
+            for i in range(3):
+                srv.node_register(mock.node(i))
+            job = mock.system_job()
+            _, e1 = srv.job_register(job)
+            srv.wait_for_evals([e1], timeout=15)
+            allocs = srv.fsm.state.allocs_by_job(job.id)
+            assert len({a.node_id for a in allocs}) == 3
+            # A new node joining gets the system job via node evals.
+            late = mock.node(99)
+            srv.node_register(late)
+            eval_ids = srv.node_evaluate(late.id)
+            srv.wait_for_evals(eval_ids, timeout=15)
+            allocs = [a for a in srv.fsm.state.allocs_by_job(job.id)
+                      if not a.terminal_status()]
+            assert len({a.node_id for a in allocs}) == 4
+        finally:
+            srv.shutdown()
+
+
+class TestCoreGC:
+    def test_eval_gc_reaps_old_terminal_evals(self):
+        from nomad_tpu.server.core_sched import CoreScheduler
+        from nomad_tpu.structs import CORE_JOB_EVAL_GC
+
+        srv = make_server()
+        srv.config.eval_gc_threshold = 0.0  # everything is old
+        try:
+            srv.node_register(mock.node())
+            job = mock.job()
+            job.task_groups[0].count = 1
+            _, e1 = srv.job_register(job)
+            srv.wait_for_evals([e1], timeout=15)
+            _, e2 = srv.job_deregister(job.id)
+            srv.wait_for_evals([e2], timeout=15)
+            # Mark allocs terminal via client update so GC can take them.
+            for a in srv.fsm.state.allocs_by_job(job.id):
+                up = a.copy()
+                up.client_status = "dead"
+                srv.raft_apply(codec.ALLOC_CLIENT_UPDATE_REQUEST,
+                               {"alloc": [up.to_dict()]})
+            # Force the timetable to see current indexes as old (bypass the
+            # 5-minute witness granularity).
+            srv.fsm.timetable.granularity = 0.0
+            srv.fsm.timetable.witness(srv.raft.applied_index() + 1,
+                                      time.time())
+
+            gc_eval = Evaluation(id=generate_uuid(), type="_core",
+                                 job_id=CORE_JOB_EVAL_GC)
+            CoreScheduler(srv, srv.fsm.state.snapshot()).process(gc_eval)
+            assert srv.fsm.state.eval_by_id(e1) is None
+            assert srv.fsm.state.eval_by_id(e2) is None
+        finally:
+            srv.shutdown()

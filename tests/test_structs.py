@@ -219,3 +219,36 @@ def test_as_vector_dims():
     assert vec[0] == 500 and vec[1] == 256
     assert vec[4] == 100  # mbits
     assert vec[5] == 2    # 1 reserved + 1 dynamic port
+
+
+def test_copy_round_trips_every_field():
+    """Hand-rolled copy() constructors must cover every dataclass field —
+    this test fails when a new field is added but not copied."""
+    import dataclasses
+    from nomad_tpu.structs import NetworkResource, Resources
+
+    def distinct_value(f, i):
+        if f.type in ("int", int):
+            return 1000 + i
+        if f.type in ("str", str):
+            return f"sentinel-{i}"
+        if f.type in ("list", list):
+            return [f"item-{i}"]
+        if f.type in ("dict", dict):
+            return {f"k{i}": i}
+        return None
+
+    for cls in (NetworkResource, Resources):
+        kwargs = {}
+        for i, f in enumerate(dataclasses.fields(cls)):
+            v = distinct_value(f, i)
+            if v is not None:
+                kwargs[f.name] = v
+        obj = cls(**{k: v for k, v in kwargs.items()
+                     if k != "networks"})
+        copied = obj.copy()
+        for f in dataclasses.fields(cls):
+            if f.name == "networks":
+                continue
+            assert getattr(copied, f.name) == getattr(obj, f.name), \
+                f"{cls.__name__}.copy() drops field {f.name!r}"
