@@ -1,0 +1,365 @@
+"""RPC endpoints: the server's wire API.
+
+Capability parity with /root/reference/nomad/{status,node,job,eval,plan,
+alloc}_endpoint.go: every mutating endpoint raft-applies then (where the
+reference does) creates evaluations; reads support blocking queries
+(min_query_index + max wait with jitter, reference nomad/rpc.go:269-338)
+and stale reads; on a follower, writes forward to the leader over the conn
+pool (reference nomad/rpc.go:162-227).
+
+Wire shapes are the structs' dict forms; query options ride in the args map
+("min_query_index", "max_query_time", "stale", "region").
+"""
+from __future__ import annotations
+
+import random
+import time
+from typing import Optional
+
+from nomad_tpu.structs import Allocation, Evaluation, Job, Node
+
+MAX_BLOCKING_WAIT = 300.0  # reference nomad/rpc.go:30-40
+
+
+def _jittered(wait: float) -> float:
+    wait = min(wait, MAX_BLOCKING_WAIT)
+    return wait + wait * random.random() / 16
+
+
+class Endpoints:
+    """All RPC services for one server; registered onto an RPCServer."""
+
+    def __init__(self, server) -> None:
+        self.server = server
+
+    def install(self, rpc_server) -> None:
+        for service, methods in {
+            "Status": ["Ping", "Version", "Leader", "Peers"],
+            "Node": ["Register", "Deregister", "UpdateStatus",
+                     "UpdateDrain", "Evaluate", "GetNode", "GetAllocs",
+                     "UpdateAlloc", "List", "Heartbeat"],
+            "Job": ["Register", "Deregister", "Evaluate", "GetJob",
+                    "List", "Allocations", "Evaluations"],
+            "Eval": ["GetEval", "Dequeue", "Ack", "Nack", "Update",
+                     "Create", "Reap", "List", "Allocations"],
+            "Plan": ["Submit"],
+            "Alloc": ["List", "GetAlloc"],
+        }.items():
+            for m in methods:
+                handler = getattr(self, f"{service.lower()}_{_snake(m)}")
+                rpc_server.register(f"{service}.{m}", handler)
+
+    # -- plumbing ---------------------------------------------------------
+    def _forward(self, method: str, args: dict) -> Optional[dict]:
+        """Returns None if this server should handle the request, else the
+        forwarded response from the leader.  Guards: never forward to self
+        (leadership-transition window) and at most one hop."""
+        if self.server.is_leader():
+            return None
+        if args.get("stale"):
+            return None
+        if args.get("_forwarded"):
+            # Second hop: handle locally rather than bouncing between
+            # servers with stale leadership views.
+            return None
+        leader = self.server.leader_rpc_address()
+        if leader is None:
+            raise RuntimeError("no cluster leader")
+        if tuple(leader) == self.server.rpc_address():
+            return None
+        fwd_args = dict(args)
+        fwd_args["_forwarded"] = True
+        return self.server.conn_pool.call(tuple(leader), method, fwd_args)
+
+    def _state(self):
+        return self.server.fsm.state
+
+    def _blocking(self, args: dict, table: str, run) -> dict:
+        """Blocking-query wrapper: wait until the table index passes
+        min_query_index or the (jittered, capped) wait expires."""
+        min_index = int(args.get("min_query_index") or 0)
+        if min_index <= 0:
+            out = run()
+            out["index"] = self._state().get_index(table)
+            out["known_leader"] = self.server.has_leader()
+            return out
+        wait = _jittered(float(args.get("max_query_time") or
+                               MAX_BLOCKING_WAIT))
+        deadline = time.monotonic() + wait
+        while True:
+            index = self._state().get_index(table)
+            if index > min_index or time.monotonic() >= deadline:
+                out = run()
+                out["index"] = index
+                out["known_leader"] = self.server.has_leader()
+                return out
+            ev = self._state().watch.watch((table,))
+            # Re-check after registering to avoid a lost wakeup.
+            if self._state().get_index(table) > min_index:
+                self._state().watch.stop_watch((table,), ev)
+                continue
+            ev.wait(min(0.25, max(0.0, deadline - time.monotonic())))
+            self._state().watch.stop_watch((table,), ev)
+
+    # -- Status -----------------------------------------------------------
+    def status_ping(self, args: dict) -> dict:
+        return {}
+
+    def status_version(self, args: dict) -> dict:
+        from nomad_tpu import __version__
+
+        return {"version": __version__}
+
+    def status_leader(self, args: dict) -> dict:
+        leader = self.server.leader_rpc_address()
+        return {"leader": f"{leader[0]}:{leader[1]}" if leader else ""}
+
+    def status_peers(self, args: dict) -> dict:
+        return {"peers": [f"{h}:{p}" for h, p in self.server.peers()]}
+
+    # -- Node -------------------------------------------------------------
+    def node_register(self, args: dict) -> dict:
+        fwd = self._forward("Node.Register", args)
+        if fwd is not None:
+            return fwd
+        node = Node.from_dict(args["node"])
+        if not node.id:
+            raise ValueError("missing node ID for client registration")
+        if not node.datacenter:
+            raise ValueError("missing datacenter for client registration")
+        index = self.server.node_register(node)
+        ttl = self.server.node_heartbeat(node.id) \
+            if self.server.is_leader() else 0.0
+        return {"index": index, "heartbeat_ttl": ttl,
+                "eval_ids": self.server.create_node_evals(node.id, index)
+                if _needs_evals(self._state(), node) else []}
+
+    def node_deregister(self, args: dict) -> dict:
+        fwd = self._forward("Node.Deregister", args)
+        if fwd is not None:
+            return fwd
+        index = self.server.node_deregister(args["node_id"])
+        return {"index": index}
+
+    def node_update_status(self, args: dict) -> dict:
+        fwd = self._forward("Node.UpdateStatus", args)
+        if fwd is not None:
+            return fwd
+        index = self.server.node_update_status(args["node_id"],
+                                               args["status"])
+        ttl = 0.0
+        if args["status"] == "ready":
+            ttl = self.server.node_heartbeat(args["node_id"])
+        return {"index": index, "heartbeat_ttl": ttl}
+
+    def node_heartbeat(self, args: dict) -> dict:
+        fwd = self._forward("Node.Heartbeat", args)
+        if fwd is not None:
+            return fwd
+        ttl = self.server.node_heartbeat(args["node_id"])
+        return {"heartbeat_ttl": ttl}
+
+    def node_update_drain(self, args: dict) -> dict:
+        fwd = self._forward("Node.UpdateDrain", args)
+        if fwd is not None:
+            return fwd
+        index = self.server.node_update_drain(args["node_id"],
+                                              bool(args["drain"]))
+        return {"index": index}
+
+    def node_evaluate(self, args: dict) -> dict:
+        fwd = self._forward("Node.Evaluate", args)
+        if fwd is not None:
+            return fwd
+        eval_ids = self.server.node_evaluate(args["node_id"])
+        return {"eval_ids": eval_ids,
+                "index": self.server.raft.applied_index()}
+
+    def node_get_node(self, args: dict) -> dict:
+        def run() -> dict:
+            node = self._state().node_by_id(args["node_id"])
+            return {"node": node.to_dict() if node else None}
+        return self._blocking(args, "nodes", run)
+
+    def node_get_allocs(self, args: dict) -> dict:
+        def run() -> dict:
+            allocs = self._state().allocs_by_node(args["node_id"])
+            return {"allocs": [a.to_dict() for a in allocs]}
+        return self._blocking(args, "allocs", run)
+
+    def node_update_alloc(self, args: dict) -> dict:
+        fwd = self._forward("Node.UpdateAlloc", args)
+        if fwd is not None:
+            return fwd
+        from nomad_tpu.structs import codec
+
+        index = self.server.raft_apply(codec.ALLOC_CLIENT_UPDATE_REQUEST,
+                                       {"alloc": args["alloc"]})
+        return {"index": index}
+
+    def node_list(self, args: dict) -> dict:
+        def run() -> dict:
+            return {"nodes": [n.to_dict() for n in self._state().nodes()]}
+        return self._blocking(args, "nodes", run)
+
+    # -- Job --------------------------------------------------------------
+    def job_register(self, args: dict) -> dict:
+        fwd = self._forward("Job.Register", args)
+        if fwd is not None:
+            return fwd
+        job = Job.from_dict(args["job"])
+        index, eval_id = self.server.job_register(job)
+        return {"index": index, "eval_id": eval_id,
+                "job_modify_index": index}
+
+    def job_deregister(self, args: dict) -> dict:
+        fwd = self._forward("Job.Deregister", args)
+        if fwd is not None:
+            return fwd
+        index, eval_id = self.server.job_deregister(args["job_id"])
+        return {"index": index, "eval_id": eval_id}
+
+    def job_evaluate(self, args: dict) -> dict:
+        fwd = self._forward("Job.Evaluate", args)
+        if fwd is not None:
+            return fwd
+        job = self._state().job_by_id(args["job_id"])
+        if job is None:
+            raise KeyError(f"job not found: {args['job_id']}")
+        from nomad_tpu.structs import generate_uuid
+
+        ev = Evaluation(
+            id=generate_uuid(), priority=job.priority, type=job.type,
+            triggered_by="job-register", job_id=job.id,
+            job_modify_index=job.modify_index, status="pending")
+        self.server.apply_eval_update([ev])
+        return {"eval_id": ev.id,
+                "index": self.server.raft.applied_index()}
+
+    def job_get_job(self, args: dict) -> dict:
+        def run() -> dict:
+            job = self._state().job_by_id(args["job_id"])
+            return {"job": job.to_dict() if job else None}
+        return self._blocking(args, "jobs", run)
+
+    def job_list(self, args: dict) -> dict:
+        def run() -> dict:
+            return {"jobs": [j.to_dict() for j in self._state().jobs()]}
+        return self._blocking(args, "jobs", run)
+
+    def job_allocations(self, args: dict) -> dict:
+        def run() -> dict:
+            allocs = self._state().allocs_by_job(args["job_id"])
+            return {"allocations": [a.to_dict() for a in allocs]}
+        return self._blocking(args, "allocs", run)
+
+    def job_evaluations(self, args: dict) -> dict:
+        def run() -> dict:
+            evals = self._state().evals_by_job(args["job_id"])
+            return {"evaluations": [e.to_dict() for e in evals]}
+        return self._blocking(args, "evals", run)
+
+    # -- Eval -------------------------------------------------------------
+    def eval_get_eval(self, args: dict) -> dict:
+        def run() -> dict:
+            ev = self._state().eval_by_id(args["eval_id"])
+            return {"eval": ev.to_dict() if ev else None}
+        return self._blocking(args, "evals", run)
+
+    def eval_dequeue(self, args: dict) -> dict:
+        fwd = self._forward("Eval.Dequeue", args)
+        if fwd is not None:
+            return fwd
+        ev, token = self.server.eval_broker.dequeue(
+            args["schedulers"], float(args.get("timeout") or 0.5))
+        return {"eval": ev.to_dict() if ev else None, "token": token}
+
+    def eval_ack(self, args: dict) -> dict:
+        fwd = self._forward("Eval.Ack", args)
+        if fwd is not None:
+            return fwd
+        self.server.eval_broker.ack(args["eval_id"], args["token"])
+        return {}
+
+    def eval_nack(self, args: dict) -> dict:
+        fwd = self._forward("Eval.Nack", args)
+        if fwd is not None:
+            return fwd
+        self.server.eval_broker.nack(args["eval_id"], args["token"])
+        return {}
+
+    def eval_update(self, args: dict) -> dict:
+        fwd = self._forward("Eval.Update", args)
+        if fwd is not None:
+            return fwd
+        evals = [Evaluation.from_dict(e) for e in args["evals"]]
+        index = self.server.apply_eval_update(evals,
+                                              args.get("eval_token", ""))
+        return {"index": index}
+
+    def eval_create(self, args: dict) -> dict:
+        return self.eval_update(args)
+
+    def eval_reap(self, args: dict) -> dict:
+        fwd = self._forward("Eval.Reap", args)
+        if fwd is not None:
+            return fwd
+        from nomad_tpu.structs import codec
+
+        index = self.server.raft_apply(
+            codec.EVAL_DELETE_REQUEST,
+            {"evals": args.get("evals", []),
+             "allocs": args.get("allocs", [])})
+        return {"index": index}
+
+    def eval_list(self, args: dict) -> dict:
+        def run() -> dict:
+            return {"evaluations": [e.to_dict()
+                                    for e in self._state().evals()]}
+        return self._blocking(args, "evals", run)
+
+    def eval_allocations(self, args: dict) -> dict:
+        def run() -> dict:
+            allocs = self._state().allocs_by_eval(args["eval_id"])
+            return {"allocations": [a.to_dict() for a in allocs]}
+        return self._blocking(args, "allocs", run)
+
+    # -- Plan -------------------------------------------------------------
+    def plan_submit(self, args: dict) -> dict:
+        fwd = self._forward("Plan.Submit", args)
+        if fwd is not None:
+            return fwd
+        from nomad_tpu.structs import Plan
+
+        plan = Plan.from_dict(args["plan"])
+        future = self.server.plan_queue.enqueue(plan)
+        result = future.wait(60.0)
+        return {"result": result.to_dict() if result else None}
+
+    # -- Alloc ------------------------------------------------------------
+    def alloc_list(self, args: dict) -> dict:
+        def run() -> dict:
+            return {"allocations": [a.to_dict()
+                                    for a in self._state().allocs()]}
+        return self._blocking(args, "allocs", run)
+
+    def alloc_get_alloc(self, args: dict) -> dict:
+        def run() -> dict:
+            alloc = self._state().alloc_by_id(args["alloc_id"])
+            return {"alloc": alloc.to_dict() if alloc else None}
+        return self._blocking(args, "allocs", run)
+
+
+def _needs_evals(state, node: Node) -> bool:
+    """A (re-)registering node triggers evals when it transitions into the
+    ready state with things to schedule (node_endpoint.go:64-90)."""
+    return node.status == "ready"
+
+
+def _snake(name: str) -> str:
+    out = []
+    for i, ch in enumerate(name):
+        if ch.isupper() and i > 0:
+            out.append("_")
+        out.append(ch.lower())
+    return "".join(out)

@@ -1,0 +1,574 @@
+"""Networked raft: leader election + replicated log over the RPC plane.
+
+Capability parity role: the reference replicates state with hashicorp/raft
+sharing the server's RPC port (reference nomad/raft_rpc.go, RaftLayer;
+nomad/server.go:397-500).  Here the three raft RPCs (RequestVote,
+AppendEntries, InstallSnapshot) ride the same msgpack-RPC listener as the
+nomad endpoints — same single-port design, Python implementation of the
+standard algorithm:
+
+  - randomized election timeouts; terms; majority voting;
+  - one long-lived replication thread per peer (no per-tick thread churn,
+    single writer for that peer's next_index/match_index);
+  - commit advance only for current-term entries with majority match;
+  - snapshot installation for far-behind followers;
+  - optional durability: term/vote metadata + appended log entries under
+    ``data_dir`` are reloaded on boot (raft safety across restarts).
+
+Leadership changes surface through ``notify`` callbacks delivered IN ORDER
+by a single notifier thread — the Server's establish/revoke must observe
+gains and losses in the sequence they happened (reference
+nomad/leader.go:16-50 monitorLeadership).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+from nomad_tpu.structs import codec
+
+from .raft import ApplyFuture, FileLogStore
+
+logger = logging.getLogger("nomad_tpu.server.raft_net")
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+# Leader no-op: an ignorable-typed entry the FSM skips (committed at the
+# start of each term so commit_index can advance, and used by barrier()).
+NOOP_ENTRY = codec.encode(codec.IGNORE_UNKNOWN_TYPE_FLAG | 127, {})
+
+
+class _PeerReplicator:
+    """One long-lived thread replicating the leader's log to one peer."""
+
+    def __init__(self, raft: "NetRaft", peer: tuple) -> None:
+        self.raft = raft
+        self.peer = peer
+        self.wake = threading.Event()
+        self.stop = threading.Event()
+        self.thread = threading.Thread(
+            target=self.run, daemon=True,
+            name=f"raft-repl-{peer[0]}:{peer[1]}")
+        self.thread.start()
+
+    def run(self) -> None:
+        while not self.stop.is_set():
+            self.wake.wait(self.raft.heartbeat_interval)
+            self.wake.clear()
+            if self.stop.is_set():
+                return
+            if not self.raft.is_leader():
+                continue
+            try:
+                self.raft._append_to_peer(self.peer)
+            except Exception:
+                logger.debug("replication to %s failed", self.peer,
+                             exc_info=True)
+
+
+class NetRaft:
+    def __init__(self, fsm, rpc_server, conn_pool,
+                 peers: Optional[list] = None,
+                 election_timeout: tuple = (0.15, 0.30),
+                 heartbeat_interval: float = 0.05,
+                 snapshot_threshold: int = 8192,
+                 data_dir: Optional[str] = None) -> None:
+        self.fsm = fsm
+        self.rpc = rpc_server
+        self.pool = conn_pool
+        self.address = tuple(rpc_server.address)
+        self.election_timeout = election_timeout
+        self.heartbeat_interval = heartbeat_interval
+        self.snapshot_threshold = snapshot_threshold
+
+        self._lock = threading.RLock()
+        self._state = FOLLOWER
+        self._term = 0
+        self._voted_for: Optional[tuple] = None
+        self._leader: Optional[tuple] = None
+        # Log: list of dicts {term, index, data}; 1-indexed via offset.
+        self._log: list = []
+        self._log_base_index = 0   # index of entry before self._log[0]
+        self._log_base_term = 0
+        self._commit_index = 0
+        self._last_applied = 0
+        self._peers: list = []
+        self._replicators: dict = {}   # peer -> _PeerReplicator
+        self._match_index: dict = {}
+        self._next_index: dict = {}
+        self._futures: dict = {}   # log index -> ApplyFuture
+        self._stop = threading.Event()
+        self._election_deadline = 0.0
+        self._snap_blob: Optional[bytes] = None
+        self._snap_index = 0
+        self._snap_term = 0
+
+        # Durability (term/vote + log), reloaded on boot.
+        self._meta_path = None
+        self._log_store = None
+        if data_dir:
+            os.makedirs(f"{data_dir}/raft", exist_ok=True)
+            self._meta_path = f"{data_dir}/raft/meta.json"
+            self._load_meta()
+            self._log_store = FileLogStore(f"{data_dir}/raft/log.bin")
+            for index, record in self._log_store.replay():
+                term, data = record["t"], record["d"]
+                if index == self._last_index() + 1:
+                    self._log.append({"term": term, "index": index,
+                                      "data": data})
+
+        # Ordered leadership notifications.
+        self._notify: list = []
+        self._notify_queue: queue.Queue = queue.Queue()
+        self._notifier = threading.Thread(target=self._notify_loop,
+                                          daemon=True, name="raft-notify")
+        self._notifier.start()
+
+        for p in peers or []:
+            self.add_peer(p)
+
+        rpc_server.register("Raft.RequestVote", self._handle_request_vote)
+        rpc_server.register("Raft.AppendEntries",
+                            self._handle_append_entries)
+        rpc_server.register("Raft.InstallSnapshot",
+                            self._handle_install_snapshot)
+
+        self._reset_election_timer()
+        self._ticker = threading.Thread(target=self._run, daemon=True,
+                                        name="raft-ticker")
+        self._ticker.start()
+
+    # -- persistence -------------------------------------------------------
+    def _load_meta(self) -> None:
+        try:
+            with open(self._meta_path) as fh:
+                meta = json.load(fh)
+            self._term = meta.get("term", 0)
+            voted = meta.get("voted_for")
+            self._voted_for = tuple(voted) if voted else None
+        except FileNotFoundError:
+            pass
+
+    def _save_meta(self) -> None:
+        if self._meta_path is None:
+            return
+        tmp = self._meta_path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"term": self._term,
+                       "voted_for": list(self._voted_for)
+                       if self._voted_for else None}, fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self._meta_path)
+
+    def _persist_entry(self, entry: dict) -> None:
+        if self._log_store is not None:
+            self._log_store.append(entry["index"],
+                                   {"t": entry["term"], "d": entry["data"]})
+
+    # -- public API (matches InmemRaft) -----------------------------------
+    def applied_index(self) -> int:
+        with self._lock:
+            return self._last_applied
+
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self._state == LEADER
+
+    def leader_address(self) -> Optional[tuple]:
+        with self._lock:
+            return self._leader
+
+    def peer_addresses(self) -> list:
+        with self._lock:
+            return [self.address] + list(self._peers)
+
+    def add_peer(self, address: tuple) -> None:
+        address = tuple(address)
+        with self._lock:
+            if address == self.address or address in self._peers:
+                return
+            self._peers.append(address)
+            self._next_index[address] = self._last_index() + 1
+            self._match_index[address] = 0
+            self._replicators[address] = _PeerReplicator(self, address)
+
+    def remove_peer(self, address: tuple) -> None:
+        address = tuple(address)
+        with self._lock:
+            if address in self._peers:
+                self._peers.remove(address)
+                self._next_index.pop(address, None)
+                self._match_index.pop(address, None)
+                repl = self._replicators.pop(address, None)
+            else:
+                repl = None
+        if repl is not None:
+            repl.stop.set()
+            repl.wake.set()
+
+    def notify_leadership(self, cb: Callable[[bool], None]) -> None:
+        self._notify.append(cb)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        with self._lock:
+            replicators = list(self._replicators.values())
+        for repl in replicators:
+            repl.stop.set()
+            repl.wake.set()
+        self._notify_queue.put(None)
+        if self._log_store is not None:
+            self._log_store.close()
+
+    def apply(self, entry: bytes) -> ApplyFuture:
+        future = ApplyFuture()
+        with self._lock:
+            if self._state != LEADER:
+                future.respond(0, None,
+                               RuntimeError("node is not the leader"))
+                return future
+            index = self._last_index() + 1
+            record = {"term": self._term, "index": index, "data": entry}
+            self._log.append(record)
+            self._persist_entry(record)
+            self._futures[index] = future
+            if not self._peers:
+                self._advance_commit()
+        self._signal_replicators()
+        return future
+
+    def barrier(self) -> int:
+        f = self.apply(NOOP_ENTRY)
+        index, _ = f.wait(5.0)
+        return index
+
+    # -- internals ---------------------------------------------------------
+    def _signal_replicators(self) -> None:
+        with self._lock:
+            replicators = list(self._replicators.values())
+        for repl in replicators:
+            repl.wake.set()
+
+    def _notify_loop(self) -> None:
+        while True:
+            item = self._notify_queue.get()
+            if item is None:
+                return
+            for cb in self._notify:
+                try:
+                    cb(item)
+                except Exception:
+                    logger.exception("leadership notify callback failed")
+
+    def _last_index(self) -> int:
+        return self._log[-1]["index"] if self._log else self._log_base_index
+
+    def _last_term(self) -> int:
+        return self._log[-1]["term"] if self._log else self._log_base_term
+
+    def _entry_at(self, index: int) -> Optional[dict]:
+        i = index - self._log_base_index - 1
+        if 0 <= i < len(self._log):
+            return self._log[i]
+        return None
+
+    def _term_at(self, index: int) -> Optional[int]:
+        if index == self._log_base_index:
+            return self._log_base_term
+        e = self._entry_at(index)
+        return e["term"] if e else None
+
+    def _reset_election_timer(self) -> None:
+        lo, hi = self.election_timeout
+        self._election_deadline = time.monotonic() + random.uniform(lo, hi)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                state = self._state
+            if state != LEADER and \
+                    time.monotonic() >= self._election_deadline:
+                self._start_election()
+            time.sleep(0.01)
+
+    # -- elections ---------------------------------------------------------
+    def _start_election(self) -> None:
+        with self._lock:
+            self._state = CANDIDATE
+            self._term += 1
+            term = self._term
+            self._voted_for = self.address
+            self._save_meta()
+            self._leader = None
+            self._reset_election_timer()
+            peers = list(self._peers)
+            last_index, last_term = self._last_index(), self._last_term()
+
+        votes = [1]  # self
+        needed = (len(peers) + 1) // 2 + 1
+        done = threading.Event()
+
+        def ask(peer) -> None:
+            try:
+                resp = self.pool.call(peer, "Raft.RequestVote", {
+                    "term": term, "candidate": list(self.address),
+                    "last_log_index": last_index,
+                    "last_log_term": last_term,
+                }, timeout=1.0)
+            except Exception:
+                return
+            with self._lock:
+                if resp["term"] > self._term:
+                    self._step_down(resp["term"])
+                    done.set()
+                    return
+                if resp.get("granted") and self._state == CANDIDATE and \
+                        self._term == term:
+                    votes[0] += 1
+                    if votes[0] >= needed:
+                        self._become_leader()
+                        done.set()
+
+        if not peers:
+            with self._lock:
+                if self._state == CANDIDATE and self._term == term:
+                    self._become_leader()
+            return
+        for peer in peers:
+            threading.Thread(target=ask, args=(peer,), daemon=True).start()
+        done.wait(self.election_timeout[0])
+
+    def _become_leader(self) -> None:
+        # Caller holds the lock.
+        logger.info("raft: %s becoming leader for term %d",
+                    self.address, self._term)
+        self._state = LEADER
+        self._leader = self.address
+        nxt = self._last_index() + 1
+        for p in self._peers:
+            self._next_index[p] = nxt
+            self._match_index[p] = 0
+        self._notify_queue.put(True)
+        # Commit a no-op so the new leader can advance commit_index
+        # (current-term entry requirement).
+        record = {"term": self._term, "index": nxt, "data": NOOP_ENTRY}
+        self._log.append(record)
+        self._persist_entry(record)
+        if not self._peers:
+            self._advance_commit()
+        self._signal_replicators()
+
+    def _step_down(self, term: int) -> None:
+        # Caller holds the lock.  voted_for only resets when the term
+        # moves forward — clearing it within the same term would allow a
+        # second vote in that term (split brain).
+        was_leader = self._state == LEADER
+        self._state = FOLLOWER
+        if term > self._term:
+            self._term = term
+            self._voted_for = None
+            self._save_meta()
+        self._reset_election_timer()
+        if was_leader:
+            self._notify_queue.put(False)
+            for future in self._futures.values():
+                future.respond(0, None, RuntimeError("leadership lost"))
+            self._futures.clear()
+
+    # -- replication (called from one _PeerReplicator thread per peer) -----
+    def _append_to_peer(self, peer: tuple) -> None:
+        with self._lock:
+            if self._state != LEADER:
+                return
+            term = self._term
+            next_idx = self._next_index.get(peer, self._last_index() + 1)
+            if next_idx <= self._log_base_index:
+                # Peer is behind our snapshot horizon: install it.
+                blob = self._snap_blob
+                snap_index, snap_term = self._snap_index, self._snap_term
+                if blob is None:
+                    blob = self.fsm.snapshot()
+                    snap_index = self._last_applied
+                    snap_term = self._term_at(snap_index) or self._term
+                args = {"term": term, "leader": list(self.address),
+                        "last_included_index": snap_index,
+                        "last_included_term": snap_term, "data": blob}
+                install = True
+            else:
+                prev_index = next_idx - 1
+                prev_term = self._term_at(prev_index)
+                if prev_term is None:
+                    return
+                entries = [e for e in self._log if e["index"] >= next_idx]
+                args = {"term": term, "leader": list(self.address),
+                        "prev_log_index": prev_index,
+                        "prev_log_term": prev_term,
+                        "entries": entries,
+                        "leader_commit": self._commit_index}
+                install = False
+
+        try:
+            method = "Raft.InstallSnapshot" if install else \
+                "Raft.AppendEntries"
+            resp = self.pool.call(peer, method, args, timeout=1.0)
+        except Exception:
+            return
+
+        with self._lock:
+            if resp["term"] > self._term:
+                self._step_down(resp["term"])
+                return
+            if self._state != LEADER or self._term != term:
+                return
+            if install:
+                self._next_index[peer] = args["last_included_index"] + 1
+                self._match_index[peer] = args["last_included_index"]
+                return
+            if resp.get("success"):
+                if args["entries"]:
+                    last = args["entries"][-1]["index"]
+                    self._next_index[peer] = last + 1
+                    self._match_index[peer] = last
+                self._advance_commit()
+            else:
+                hint = resp.get("conflict_index")
+                self._next_index[peer] = max(
+                    1, hint if hint else self._next_index.get(peer, 2) - 1)
+
+    def _advance_commit(self) -> None:
+        # Caller holds the lock.
+        for index in range(self._last_index(), self._commit_index, -1):
+            entry = self._entry_at(index)
+            if entry is None or entry["term"] != self._term:
+                continue
+            votes = 1 + sum(1 for p in self._peers
+                            if self._match_index.get(p, 0) >= index)
+            if votes >= (len(self._peers) + 1) // 2 + 1:
+                self._commit_index = index
+                break
+        self._apply_committed()
+
+    def _apply_committed(self) -> None:
+        # Caller holds the lock.
+        while self._last_applied < self._commit_index:
+            index = self._last_applied + 1
+            entry = self._entry_at(index)
+            if entry is None:
+                break
+            error = response = None
+            try:
+                response = self.fsm.apply(index, bytes(entry["data"]))
+            except Exception as e:
+                error = e
+            self._last_applied = index
+            future = self._futures.pop(index, None)
+            if future is not None:
+                future.respond(index, response, error)
+        self._maybe_compact()
+
+    def _maybe_compact(self) -> None:
+        if self._last_applied - self._log_base_index < \
+                self.snapshot_threshold:
+            return
+        blob = self.fsm.snapshot()
+        self._snap_blob = blob
+        self._snap_index = self._last_applied
+        self._snap_term = self._term_at(self._last_applied) or self._term
+        keep = [e for e in self._log if e["index"] > self._last_applied]
+        self._log_base_term = self._snap_term
+        self._log_base_index = self._snap_index
+        self._log = keep
+        if self._log_store is not None:
+            self._log_store.truncate()
+            for e in self._log:
+                self._persist_entry(e)
+
+    # -- RPC handlers ------------------------------------------------------
+    def _handle_request_vote(self, args: dict) -> dict:
+        with self._lock:
+            term = args["term"]
+            if term < self._term:
+                return {"term": self._term, "granted": False}
+            if term > self._term:
+                self._step_down(term)
+            candidate = tuple(args["candidate"])
+            up_to_date = (
+                args["last_log_term"] > self._last_term() or
+                (args["last_log_term"] == self._last_term() and
+                 args["last_log_index"] >= self._last_index()))
+            if up_to_date and self._voted_for in (None, candidate):
+                self._voted_for = candidate
+                self._save_meta()
+                self._reset_election_timer()
+                return {"term": self._term, "granted": True}
+            return {"term": self._term, "granted": False}
+
+    def _handle_append_entries(self, args: dict) -> dict:
+        with self._lock:
+            term = args["term"]
+            if term < self._term:
+                return {"term": self._term, "success": False}
+            if term > self._term or self._state != FOLLOWER:
+                self._step_down(term)
+            self._term = term
+            self._leader = tuple(args["leader"])
+            self._reset_election_timer()
+
+            prev_index = args["prev_log_index"]
+            prev_term = args["prev_log_term"]
+            local_term = self._term_at(prev_index)
+            if local_term is None:
+                return {"term": self._term, "success": False,
+                        "conflict_index": self._last_index() + 1}
+            if local_term != prev_term:
+                return {"term": self._term, "success": False,
+                        "conflict_index": max(1, prev_index)}
+
+            # Append/overwrite entries.
+            for e in args.get("entries") or []:
+                existing = self._entry_at(e["index"])
+                if existing is not None and existing["term"] != e["term"]:
+                    # Conflict: truncate from here.
+                    cut = e["index"] - self._log_base_index - 1
+                    self._log = self._log[:cut]
+                    existing = None
+                if existing is None and e["index"] == \
+                        self._last_index() + 1:
+                    record = dict(e)
+                    self._log.append(record)
+                    self._persist_entry(record)
+
+            leader_commit = args.get("leader_commit", 0)
+            if leader_commit > self._commit_index:
+                self._commit_index = min(leader_commit, self._last_index())
+                self._apply_committed()
+            return {"term": self._term, "success": True}
+
+    def _handle_install_snapshot(self, args: dict) -> dict:
+        with self._lock:
+            term = args["term"]
+            if term < self._term:
+                return {"term": self._term}
+            self._step_down(term)
+            self._term = term
+            self._leader = tuple(args["leader"])
+            self._reset_election_timer()
+            index = args["last_included_index"]
+            if index <= self._last_applied:
+                return {"term": self._term}
+            self.fsm.restore(bytes(args["data"]))
+            self._log = []
+            self._log_base_index = index
+            self._log_base_term = args["last_included_term"]
+            self._commit_index = index
+            self._last_applied = index
+            return {"term": self._term}

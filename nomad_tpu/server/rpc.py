@@ -1,0 +1,258 @@
+"""msgpack-RPC transport: TCP listener with 1-byte protocol demux.
+
+Capability parity with /root/reference/nomad/rpc.go:20-158 + nomad/pool.go:
+the server's single TCP port serves multiple planes, demuxed by the first
+byte of each connection (0x01 nomad RPC, 0x02 raft hand-off); RPC frames are
+length-prefixed msgpack maps; clients keep pooled connections.  TLS and
+yamux multiplexing are replaced by plain framed TCP (one in-flight request
+per pooled connection, pool grows on demand) — same contract, simpler
+substrate.
+
+Frame format (both directions): 4-byte big-endian length + msgpack body.
+Request body:  {"seq": int, "method": "Service.Method", "args": {...}}
+Response body: {"seq": int, "error": str|None, "result": {...}}
+"""
+from __future__ import annotations
+
+import logging
+import socket
+import socketserver
+import struct
+import threading
+from typing import Callable, Optional
+
+import msgpack
+
+logger = logging.getLogger("nomad_tpu.server.rpc")
+
+RPC_NOMAD = 0x01
+RPC_RAFT = 0x02
+
+MAX_FRAME = 128 * 1024 * 1024
+
+
+def send_frame(sock: socket.socket, obj) -> None:
+    body = msgpack.packb(obj, use_bin_type=True)
+    sock.sendall(struct.pack(">I", len(body)) + body)
+
+
+def recv_frame(sock: socket.socket):
+    head = _recv_exact(sock, 4)
+    if head is None:
+        return None
+    length = struct.unpack(">I", head)[0]
+    if length > MAX_FRAME:
+        raise ValueError(f"frame too large: {length}")
+    body = _recv_exact(sock, length)
+    if body is None:
+        return None
+    return msgpack.unpackb(body, raw=False, strict_map_key=False)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class RPCServer:
+    """Threaded TCP listener demuxing nomad-RPC and raft streams."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._handlers: dict = {}        # "Service.Method" -> callable
+        self._raft_handler: Optional[Callable] = None
+        self._lock = threading.Lock()
+
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                sock = self.request
+                try:
+                    first = sock.recv(1)
+                    if not first:
+                        return
+                    if first[0] == RPC_NOMAD:
+                        outer._serve_rpc(sock)
+                    elif first[0] == RPC_RAFT:
+                        if outer._raft_handler is not None:
+                            outer._raft_handler(sock)
+                    else:
+                        logger.warning("unrecognized RPC byte: %#x",
+                                       first[0])
+                except (ConnectionError, OSError):
+                    pass
+                finally:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+
+        class _Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = _Server((host, port), _Handler)
+        self.address = self._server.server_address  # (host, port)
+        self._thread: Optional[threading.Thread] = None
+
+    # -- registration -----------------------------------------------------
+    def register(self, method: str, handler: Callable) -> None:
+        self._handlers[method] = handler
+
+    def register_service(self, name: str, obj) -> None:
+        """Register every public method of obj as ``Name.method``."""
+        for attr in dir(obj):
+            if attr.startswith("_"):
+                continue
+            fn = getattr(obj, attr)
+            if callable(fn):
+                self._handlers[f"{name}.{attr}"] = fn
+
+    def set_raft_handler(self, handler: Callable) -> None:
+        self._raft_handler = handler
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="rpc-listener")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- serving ----------------------------------------------------------
+    def _serve_rpc(self, sock: socket.socket) -> None:
+        while True:
+            req = recv_frame(sock)
+            if req is None:
+                return
+            seq = req.get("seq", 0)
+            method = req.get("method", "")
+            handler = self._handlers.get(method)
+            if handler is None:
+                send_frame(sock, {"seq": seq,
+                                  "error": f"unknown method {method!r}",
+                                  "result": None})
+                continue
+            try:
+                result = handler(req.get("args") or {})
+                send_frame(sock, {"seq": seq, "error": None,
+                                  "result": result})
+            except Exception as e:  # error surface mirrors net/rpc
+                logger.debug("rpc %s failed: %s", method, e)
+                send_frame(sock, {"seq": seq, "error": str(e),
+                                  "result": None})
+
+
+class RPCError(Exception):
+    pass
+
+
+class _SendError(ConnectionError):
+    """The request never left this host (stale pooled conn) — safe to
+    retry on a fresh connection even for non-idempotent writes."""
+
+
+DEFAULT_CALL_TIMEOUT = 330.0  # > blocking-query max
+
+
+class _PooledConn:
+    def __init__(self, address: tuple) -> None:
+        self.sock = socket.create_connection(address, timeout=330)
+        self.sock.sendall(bytes([RPC_NOMAD]))
+        self.lock = threading.Lock()
+        self.seq = 0
+
+    def call(self, method: str, args: dict, timeout: Optional[float] = None):
+        with self.lock:
+            self.seq += 1
+            # Always (re)set: a previous caller's short timeout must not
+            # stick to the pooled connection.
+            self.sock.settimeout(timeout if timeout is not None
+                                 else DEFAULT_CALL_TIMEOUT)
+            try:
+                send_frame(self.sock, {"seq": self.seq, "method": method,
+                                       "args": args})
+            except (ConnectionError, OSError) as e:
+                raise _SendError(str(e)) from e
+            resp = recv_frame(self.sock)
+        if resp is None:
+            raise ConnectionError("connection closed by server")
+        if resp.get("error"):
+            raise RPCError(resp["error"])
+        return resp.get("result")
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class ConnPool:
+    """Pooled msgpack-RPC client connections per server address
+    (reference nomad/pool.go)."""
+
+    def __init__(self, max_per_host: int = 4) -> None:
+        self.max_per_host = max_per_host
+        self._lock = threading.Lock()
+        self._pools: dict = {}   # address -> [idle _PooledConn]
+
+    def call(self, address: tuple, method: str, args: dict,
+             timeout: Optional[float] = None):
+        address = (address[0], address[1])
+        conn = self._checkout(address)
+        try:
+            result = conn.call(method, args, timeout)
+        except RPCError:
+            # Application-level error: the connection is healthy.
+            self._checkin(address, conn)
+            raise
+        except _SendError:
+            # Request never reached the server: retry once on a fresh
+            # connection (safe even for writes).
+            conn.close()
+            conn = _PooledConn(address)
+            try:
+                result = conn.call(method, args, timeout)
+            except RPCError:
+                self._checkin(address, conn)
+                raise
+            except Exception:
+                conn.close()
+                raise
+        except (ConnectionError, OSError, TimeoutError):
+            # Failure after the request may have been processed: do NOT
+            # re-send (the call may not be idempotent); surface the error.
+            conn.close()
+            raise
+        self._checkin(address, conn)
+        return result
+
+    def _checkout(self, address: tuple) -> _PooledConn:
+        with self._lock:
+            pool = self._pools.get(address)
+            if pool:
+                return pool.pop()
+        return _PooledConn(address)
+
+    def _checkin(self, address: tuple, conn: _PooledConn) -> None:
+        with self._lock:
+            pool = self._pools.setdefault(address, [])
+            if len(pool) < self.max_per_host:
+                pool.append(conn)
+                return
+        conn.close()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            for pool in self._pools.values():
+                for conn in pool:
+                    conn.close()
+            self._pools.clear()

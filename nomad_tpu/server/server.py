@@ -55,6 +55,14 @@ class ServerConfig:
         self.node_gc_interval: float = 300.0
         self.node_gc_threshold: float = 24 * 3600.0
         self.region: str = "global"
+        self.enable_rpc: bool = False
+        self.bind_addr: str = "127.0.0.1"
+        self.rpc_port: int = 0      # 0 = ephemeral
+        self.raft_mode: str = "inmem"   # "inmem" | "net"
+        self.raft_peers: list = []      # [(host, port), ...]
+        self.raft_election_timeout: tuple = (0.15, 0.30)
+        self.raft_heartbeat_interval: float = 0.05
+        self.bootstrap_expect: int = 1
         for k, v in kw.items():
             if not hasattr(self, k):
                 raise TypeError(f"unknown config key {k!r}")
@@ -69,15 +77,6 @@ class Server:
         self.plan_queue = PlanQueue()
         self.fsm = NomadFSM(eval_broker=self.eval_broker)
 
-        log_store = snapshots = None
-        if self.config.data_dir:
-            log_store = FileLogStore(f"{self.config.data_dir}/raft/log.bin")
-            snapshots = SnapshotStore(f"{self.config.data_dir}/snapshots")
-        self.raft = InmemRaft(self.fsm, log_store, snapshots)
-
-        self.plan_applier = PlanApplier(
-            self.plan_queue, self.eval_broker, self.raft,
-            lambda: self.fsm.state)
         from .heartbeat import HeartbeatManager
         self.heartbeats = HeartbeatManager(self)
         self.workers: list = []
@@ -85,7 +84,72 @@ class Server:
         self._shutdown = threading.Event()
         self._leader_threads: list = []
 
+        # RPC plane first (reference nomad/server.go:348-363 setupRPC) —
+        # networked raft rides the same listener.
+        from .rpc import ConnPool
+        self.conn_pool = ConnPool()
+        self.rpc_server = None
+        if self.config.enable_rpc or self.config.raft_mode == "net":
+            from .endpoints import Endpoints
+            from .rpc import RPCServer
+            self.rpc_server = RPCServer(self.config.bind_addr,
+                                        self.config.rpc_port)
+            Endpoints(self).install(self.rpc_server)
+            self.rpc_server.start()
+
+        if self.config.raft_mode == "net":
+            from .raft_net import NetRaft
+            self.raft = NetRaft(
+                self.fsm, self.rpc_server, self.conn_pool,
+                peers=self.config.raft_peers,
+                election_timeout=self.config.raft_election_timeout,
+                heartbeat_interval=self.config.raft_heartbeat_interval,
+                data_dir=self.config.data_dir)
+            self.raft.notify_leadership(self._on_leadership_change)
+        else:
+            log_store = snapshots = None
+            if self.config.data_dir:
+                log_store = FileLogStore(
+                    f"{self.config.data_dir}/raft/log.bin")
+                snapshots = SnapshotStore(
+                    f"{self.config.data_dir}/snapshots")
+            self.raft = InmemRaft(self.fsm, log_store, snapshots)
+
+        self.plan_applier = PlanApplier(
+            self.plan_queue, self.eval_broker, self.raft,
+            lambda: self.fsm.state)
+
         self._setup_workers()
+
+    def _on_leadership_change(self, is_leader: bool) -> None:
+        """monitorLeadership parity (leader.go:16-50)."""
+        if is_leader:
+            self.establish_leadership()
+        else:
+            self.revoke_leadership()
+
+    # -- cluster views -----------------------------------------------------
+    def rpc_address(self) -> Optional[tuple]:
+        return self.rpc_server.address if self.rpc_server else None
+
+    def leader_rpc_address(self) -> Optional[tuple]:
+        """The leader's RPC address (self when leading; NetRaft supplies
+        the remote leader otherwise)."""
+        if self._leader:
+            return self.rpc_address()
+        leader = getattr(self.raft, "leader_address", None)
+        if callable(leader):
+            return leader()
+        return None
+
+    def has_leader(self) -> bool:
+        return self._leader or self.leader_rpc_address() is not None
+
+    def peers(self) -> list:
+        peer_fn = getattr(self.raft, "peer_addresses", None)
+        if callable(peer_fn):
+            return peer_fn()
+        return [self.rpc_address()] if self.rpc_server else []
 
     # -- setup ------------------------------------------------------------
     def _setup_workers(self) -> None:
@@ -117,6 +181,12 @@ class Server:
         self._leader = True
         if self.workers:
             self.workers[0].set_pause(True)
+        # Barrier: ensure our FSM has applied everything committed before
+        # rebuilding leader state from it (leader.go:52).
+        try:
+            self.raft.barrier()
+        except Exception:
+            logger.warning("leadership barrier failed", exc_info=True)
         self.plan_queue.set_enabled(True)
         self.eval_broker.set_enabled(True)
         self.plan_applier.start()
@@ -145,6 +215,12 @@ class Server:
         for w in self.workers:
             w.stop()
         self.revoke_leadership()
+        raft_shutdown = getattr(self.raft, "shutdown", None)
+        if callable(raft_shutdown):
+            raft_shutdown()
+        if self.rpc_server is not None:
+            self.rpc_server.shutdown()
+        self.conn_pool.shutdown()
 
     def _restore_eval_broker(self) -> None:
         """Broker is volatile; state is durable.  Re-enqueue all

@@ -1,0 +1,149 @@
+"""RPC plane tests: transport, endpoints, blocking queries, forwarding."""
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.server.rpc import ConnPool, RPCError, RPCServer
+
+
+@pytest.fixture
+def srv():
+    s = Server(ServerConfig(num_schedulers=2, enable_rpc=True))
+    s.establish_leadership()
+    yield s
+    s.shutdown()
+
+
+@pytest.fixture
+def pool():
+    p = ConnPool()
+    yield p
+    p.shutdown()
+
+
+class TestTransport:
+    def test_call_roundtrip(self, pool):
+        rs = RPCServer()
+        rs.register("Echo.Hello", lambda args: {"hi": args.get("name")})
+        rs.start()
+        try:
+            out = pool.call(rs.address, "Echo.Hello", {"name": "x"})
+            assert out == {"hi": "x"}
+            with pytest.raises(RPCError):
+                pool.call(rs.address, "No.Such", {})
+        finally:
+            rs.shutdown()
+
+    def test_conn_reuse_and_concurrency(self, pool):
+        rs = RPCServer()
+        rs.register("S.Slow", lambda args: (time.sleep(0.02), {"n": 1})[1])
+        rs.start()
+        try:
+            results = []
+
+            def worker():
+                results.append(pool.call(rs.address, "S.Slow", {}))
+
+            threads = [threading.Thread(target=worker) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert len(results) == 8
+        finally:
+            rs.shutdown()
+
+
+class TestEndpoints:
+    def test_job_lifecycle_over_rpc(self, srv, pool):
+        addr = srv.rpc_address()
+        for i in range(4):
+            pool.call(addr, "Node.Register",
+                      {"node": mock.node(i).to_dict()})
+        job = mock.job()
+        job.task_groups[0].count = 4
+        out = pool.call(addr, "Job.Register", {"job": job.to_dict()})
+        assert out["eval_id"]
+
+        # Poll eval until complete via blocking queries.
+        deadline = time.monotonic() + 15
+        index = 0
+        while time.monotonic() < deadline:
+            got = pool.call(addr, "Eval.GetEval",
+                            {"eval_id": out["eval_id"],
+                             "min_query_index": index,
+                             "max_query_time": 1.0})
+            index = got["index"]
+            if got["eval"] and got["eval"]["status"] == "complete":
+                break
+        else:
+            raise AssertionError("eval did not complete")
+
+        allocs = pool.call(addr, "Job.Allocations",
+                           {"job_id": job.id})["allocations"]
+        assert len(allocs) == 4
+        assert all(a["node_id"] for a in allocs)
+
+        nodes = pool.call(addr, "Node.List", {})["nodes"]
+        assert len(nodes) == 4
+        one = pool.call(addr, "Node.GetAllocs",
+                        {"node_id": allocs[0]["node_id"]})
+        assert one["allocs"]
+
+    def test_status_endpoints(self, srv, pool):
+        addr = srv.rpc_address()
+        assert pool.call(addr, "Status.Ping", {}) == {}
+        assert pool.call(addr, "Status.Version", {})["version"]
+        leader = pool.call(addr, "Status.Leader", {})["leader"]
+        assert leader.endswith(str(addr[1]))
+
+    def test_blocking_query_wakes_on_write(self, srv, pool):
+        addr = srv.rpc_address()
+        srv.node_register(mock.node(0))  # nonzero base index
+        base = pool.call(addr, "Node.List", {})
+        assert base["index"] > 0
+
+        got = {}
+
+        def blocked():
+            got.update(pool.call(addr, "Node.List",
+                                 {"min_query_index": base["index"],
+                                  "max_query_time": 10.0}))
+
+        t = threading.Thread(target=blocked)
+        start = time.monotonic()
+        t.start()
+        time.sleep(0.1)
+        srv.node_register(mock.node(1))
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert time.monotonic() - start < 5
+        assert got["index"] > base["index"]
+        assert len(got["nodes"]) == 2
+
+    def test_client_alloc_update_over_rpc(self, srv, pool):
+        addr = srv.rpc_address()
+        pool.call(addr, "Node.Register", {"node": mock.node().to_dict()})
+        job = mock.job()
+        job.task_groups[0].count = 1
+        out = pool.call(addr, "Job.Register", {"job": job.to_dict()})
+        srv.wait_for_evals([out["eval_id"]], timeout=15)
+        alloc = srv.fsm.state.allocs_by_job(job.id)[0]
+        up = alloc.copy()
+        up.client_status = "running"
+        pool.call(addr, "Node.UpdateAlloc", {"alloc": [up.to_dict()]})
+        assert srv.fsm.state.alloc_by_id(alloc.id).client_status == \
+            "running"
+
+    def test_heartbeat_over_rpc(self, srv, pool):
+        addr = srv.rpc_address()
+        node = mock.node()
+        out = pool.call(addr, "Node.Register", {"node": node.to_dict()})
+        assert out["heartbeat_ttl"] > 0
+        hb = pool.call(addr, "Node.Heartbeat", {"node_id": node.id})
+        assert hb["heartbeat_ttl"] >= 10.0
