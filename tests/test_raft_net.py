@@ -125,8 +125,11 @@ def test_leader_failover():
 
         new_leader = wait_for_leader(survivors, timeout=10)
         assert new_leader is not leader
-        # Replicated state survived the failover.
-        assert new_leader.fsm.state.node_by_id(node.id) is not None
+        # Replicated state survives the failover; prior-term entries apply
+        # once the new leader commits its own-term no-op.
+        wait_until(
+            lambda: new_leader.fsm.state.node_by_id(node.id) is not None,
+            msg="committed entry visible on new leader")
         # And the new leader can make progress.
         node2 = mock.node(2)
         new_leader.node_register(node2)
