@@ -1,0 +1,183 @@
+"""AllocRunner: one allocation's lifecycle on a client.
+
+Capability parity with /root/reference/client/alloc_runner.go: build the
+alloc dir, spawn a TaskRunner per task, aggregate task states into the
+alloc's client status, sync dirty status to the server with retry, and
+handle update/destroy.  State persists to ``state.json`` per alloc for
+restore on agent restart.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from typing import Callable, Optional
+
+from nomad_tpu.structs import (
+    ALLOC_CLIENT_STATUS_DEAD,
+    ALLOC_CLIENT_STATUS_FAILED,
+    ALLOC_CLIENT_STATUS_PENDING,
+    ALLOC_CLIENT_STATUS_RUNNING,
+    ALLOC_DESIRED_STATUS_RUN,
+    Allocation,
+)
+
+from .allocdir import AllocDir
+from .driver.base import ExecContext
+from .task_runner import TASK_STATE_DEAD, TASK_STATE_RUNNING, TaskRunner
+
+logger = logging.getLogger("nomad_tpu.client.alloc_runner")
+
+
+class AllocRunner:
+    def __init__(self, alloc: Allocation, alloc_root: str,
+                 state_dir: str = "",
+                 on_status: Optional[Callable] = None) -> None:
+        self.alloc = alloc
+        self.alloc_root = alloc_root
+        self.state_dir = state_dir
+        self.on_status = on_status or (lambda alloc: None)
+
+        self.alloc_dir = AllocDir(alloc_root)
+        self.ctx = ExecContext(self.alloc_dir, alloc.id)
+        self.task_runners: dict = {}
+        self.task_states: dict = {}
+        self._destroy = threading.Event()
+        self._lock = threading.Lock()
+
+    # -- state persistence -------------------------------------------------
+    def _state_path(self) -> str:
+        return os.path.join(self.state_dir, "state.json")
+
+    def save_state(self) -> None:
+        if not self.state_dir:
+            return
+        os.makedirs(self.state_dir, exist_ok=True)
+        tmp = self._state_path() + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"alloc": self.alloc.to_dict()}, fh)
+        os.replace(tmp, self._state_path())
+
+    @classmethod
+    def restore(cls, alloc_root: str, state_dir: str,
+                on_status: Optional[Callable] = None
+                ) -> Optional["AllocRunner"]:
+        try:
+            with open(os.path.join(state_dir, "state.json")) as fh:
+                data = json.load(fh)
+        except (OSError, ValueError):
+            return None
+        alloc = Allocation.from_dict(data["alloc"])
+        runner = cls(alloc, alloc_root, state_dir, on_status)
+        return runner
+
+    # -- lifecycle ---------------------------------------------------------
+    def tasks(self) -> list:
+        tg = self.alloc.job.lookup_task_group(self.alloc.task_group) \
+            if self.alloc.job else None
+        return list(tg.tasks) if tg else []
+
+    def run(self, restore: bool = False) -> None:
+        tasks = self.tasks()
+        if not tasks:
+            self._set_client_status(ALLOC_CLIENT_STATUS_FAILED,
+                                    "alloc has no tasks")
+            return
+        self.alloc_dir.build(tasks)
+        self.save_state()
+        for task in tasks:
+            # Use per-task resources from the scheduler when present.
+            task_resources = self.alloc.task_resources.get(task.name)
+            if task_resources is not None:
+                task = task.copy()
+                task.resources = task_resources
+            tr = TaskRunner(self.ctx, task, state_dir=self.state_dir,
+                            on_state=self._on_task_state)
+            self.task_runners[task.name] = tr
+            if restore and tr.restore_state():
+                # Re-attached to the live process: supervise it.
+                tr.start()
+                continue
+            tr.start()
+
+    def _on_task_state(self, task_name: str, state: str,
+                       description: str) -> None:
+        with self._lock:
+            self.task_states[task_name] = {"state": state,
+                                           "description": description}
+            status, desc = self._aggregate()
+        if status != self.alloc.client_status:
+            self._set_client_status(status, desc)
+
+    def _aggregate(self) -> tuple[str, str]:
+        """Task states -> alloc client status
+        (reference alloc_runner.go:150-196)."""
+        states = [s["state"] for s in self.task_states.values()]
+        failed = any(tr.failed for tr in self.task_runners.values())
+        if failed:
+            return ALLOC_CLIENT_STATUS_FAILED, "one or more tasks failed"
+        if states and all(s == TASK_STATE_DEAD for s in states) and \
+                len(states) == len(self.task_runners):
+            return ALLOC_CLIENT_STATUS_DEAD, "all tasks completed"
+        if any(s == TASK_STATE_RUNNING for s in states):
+            return ALLOC_CLIENT_STATUS_RUNNING, ""
+        return ALLOC_CLIENT_STATUS_PENDING, ""
+
+    def _set_client_status(self, status: str, description: str) -> None:
+        updated = self.alloc.copy()
+        updated.client_status = status
+        updated.client_description = description
+        updated.task_states = dict(self.task_states)
+        self.alloc = updated
+        self.save_state()
+        try:
+            self.on_status(updated)
+        except Exception:
+            logger.exception("alloc %s status sync failed", self.alloc.id)
+
+    def update(self, alloc: Allocation) -> None:
+        """Server pushed a new version of this alloc."""
+        # Keep client-authoritative fields; take the server's view of the
+        # rest (desired status, job version, modify index).
+        alloc = alloc.copy()
+        alloc.client_status = self.alloc.client_status
+        alloc.client_description = self.alloc.client_description
+        alloc.task_states = self.alloc.task_states
+        self.alloc = alloc
+        if alloc.desired_status != ALLOC_DESIRED_STATUS_RUN:
+            self.destroy_tasks()
+            return
+        tg = alloc.job.lookup_task_group(alloc.task_group) \
+            if alloc.job else None
+        if tg is None:
+            return
+        for task in tg.tasks:
+            tr = self.task_runners.get(task.name)
+            if tr is not None:
+                tr.update(task)
+
+    def destroy_tasks(self) -> None:
+        for tr in self.task_runners.values():
+            tr.destroy()
+
+    def destroy(self) -> None:
+        self._destroy.set()
+        self.destroy_tasks()
+        for tr in self.task_runners.values():
+            tr.join(10)
+        self.alloc_dir.destroy()
+        if self.state_dir:
+            import shutil
+
+            shutil.rmtree(self.state_dir, ignore_errors=True)
+
+    def wait_for_status(self, status: str, timeout: float = 10.0) -> bool:
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.alloc.client_status == status:
+                return True
+            time.sleep(0.02)
+        return False

@@ -1,0 +1,54 @@
+"""Allocation directory tree.
+
+Capability parity with /root/reference/client/allocdir/alloc_dir.go: per
+allocation a shared ``alloc/{logs,tmp,data}`` tree plus a private ``local/``
+dir per task; tasks see the shared dir via symlink (the portable analogue of
+the reference's bind-mount/copy; chroot embedding lives in the exec driver).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+
+SHARED_ALLOC_NAME = "alloc"
+SHARED_DIRS = ("logs", "tmp", "data")
+TASK_LOCAL = "local"
+
+
+class AllocDir:
+    def __init__(self, alloc_root: str) -> None:
+        self.alloc_dir = alloc_root
+        self.shared_dir = os.path.join(alloc_root, SHARED_ALLOC_NAME)
+        self.task_dirs: dict = {}
+
+    def build(self, tasks: list) -> None:
+        os.makedirs(self.shared_dir, exist_ok=True)
+        for sub in SHARED_DIRS:
+            os.makedirs(os.path.join(self.shared_dir, sub), exist_ok=True)
+        for task in tasks:
+            task_dir = os.path.join(self.alloc_dir, task.name)
+            os.makedirs(os.path.join(task_dir, TASK_LOCAL), exist_ok=True)
+            link = os.path.join(task_dir, SHARED_ALLOC_NAME)
+            if not os.path.islink(link) and not os.path.exists(link):
+                os.symlink(self.shared_dir, link)
+            self.task_dirs[task.name] = task_dir
+
+    def embed(self, task_name: str, entries: dict) -> None:
+        """Copy host paths into a task dir (chroot population,
+        reference alloc_dir.go Embed)."""
+        task_dir = self.task_dirs[task_name]
+        for host_path, rel_dest in entries.items():
+            dest = os.path.join(task_dir, rel_dest.lstrip("/"))
+            if os.path.isdir(host_path):
+                shutil.copytree(host_path, dest, dirs_exist_ok=True,
+                                symlinks=True)
+            elif os.path.isfile(host_path):
+                os.makedirs(os.path.dirname(dest), exist_ok=True)
+                shutil.copy2(host_path, dest)
+
+    def log_path(self, task_name: str, kind: str) -> str:
+        return os.path.join(self.shared_dir, "logs",
+                            f"{task_name}.{kind}")
+
+    def destroy(self) -> None:
+        shutil.rmtree(self.alloc_dir, ignore_errors=True)

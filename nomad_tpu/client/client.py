@@ -1,0 +1,285 @@
+"""Client: the node agent.
+
+Capability parity with /root/reference/client/client.go: fingerprint the
+host into a Node, register with servers, heartbeat at the server-given TTL,
+long-poll ``Node.GetAllocs`` for assigned allocations, diff added/removed/
+updated (reference client/util.go:34-70), and manage an AllocRunner per
+allocation.  Node ID and alloc state persist under state_dir so a restarted
+agent re-attaches to running tasks.
+
+Server transport is the ``rpc_handler`` seam: an in-proc object (the
+colocated server, reference agent.go:176-178) or a pooled network client.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+from nomad_tpu.structs import (
+    NODE_STATUS_INIT,
+    NODE_STATUS_READY,
+    Allocation,
+    Node,
+    generate_uuid,
+)
+
+from .alloc_runner import AllocRunner
+from .config import ClientConfig
+from .driver import BUILTIN_DRIVERS
+from .fingerprint import fingerprint_node
+
+logger = logging.getLogger("nomad_tpu.client")
+
+REGISTER_RETRY_INTERVAL = 1.0
+STATE_SNAPSHOT_INTERVAL = 60.0
+
+
+class NetRPCHandler:
+    """Network transport: calls a server over the conn pool."""
+
+    def __init__(self, servers: list) -> None:
+        from nomad_tpu.server.rpc import ConnPool
+
+        self.servers = [tuple(s) for s in servers]
+        self.pool = ConnPool()
+        self._i = 0
+
+    def call(self, method: str, args: dict, timeout=None):
+        last_err: Optional[Exception] = None
+        for _ in range(len(self.servers)):
+            address = self.servers[self._i % len(self.servers)]
+            try:
+                return self.pool.call(address, method, args,
+                                      timeout=timeout)
+            except Exception as e:
+                last_err = e
+                self._i += 1
+        raise last_err or RuntimeError("no servers configured")
+
+
+class Client:
+    def __init__(self, config: ClientConfig) -> None:
+        self.config = config
+        self.rpc = config.rpc_handler or NetRPCHandler(config.servers)
+
+        self.node = config.node or Node()
+        self._setup_node()
+        self._fingerprint()
+        self._setup_drivers()
+
+        self.alloc_runners: dict = {}
+        self._alloc_lock = threading.Lock()
+        self._shutdown = threading.Event()
+        self._heartbeat_ttl = 10.0
+        self._alloc_index = 0
+        self._threads: list = []
+
+        self._restore_state()
+
+    # -- setup -------------------------------------------------------------
+    def _setup_node(self) -> None:
+        node = self.node
+        if not node.id:
+            node.id = self._restore_or_create_node_id()
+        if not node.datacenter:
+            node.datacenter = "dc1"
+        node.status = NODE_STATUS_INIT
+
+    def _restore_or_create_node_id(self) -> str:
+        if self.config.state_dir:
+            path = os.path.join(self.config.state_dir, "client-id")
+            try:
+                with open(path) as fh:
+                    return fh.read().strip()
+            except OSError:
+                pass
+            node_id = generate_uuid()
+            os.makedirs(self.config.state_dir, exist_ok=True)
+            with open(path, "w") as fh:
+                fh.write(node_id)
+            return node_id
+        return generate_uuid()
+
+    def _fingerprint(self) -> None:
+        applied = fingerprint_node(self.config, self.node)
+        logger.info("client: fingerprints applied: %s",
+                    ",".join(applied))
+
+    def _setup_drivers(self) -> None:
+        found = []
+        for name, cls in BUILTIN_DRIVERS.items():
+            try:
+                if cls.fingerprint(self.config, self.node):
+                    found.append(name)
+            except Exception:
+                logger.exception("driver fingerprint %s failed", name)
+        logger.info("client: available drivers: %s", ",".join(found))
+
+    # -- state persistence --------------------------------------------------
+    def _alloc_state_dir(self, alloc_id: str) -> str:
+        return os.path.join(self.config.state_dir, "allocs", alloc_id) \
+            if self.config.state_dir else ""
+
+    def _alloc_root(self, alloc_id: str) -> str:
+        base = self.config.alloc_dir or \
+            os.path.join(self.config.state_dir or "/tmp/nomad-client",
+                         "alloc")
+        return os.path.join(base, alloc_id)
+
+    def _restore_state(self) -> None:
+        """Re-attach to allocs persisted by a previous agent process.
+        Terminal allocs are cleaned up, never re-run."""
+        import shutil
+
+        if not self.config.state_dir:
+            return
+        allocs_dir = os.path.join(self.config.state_dir, "allocs")
+        if not os.path.isdir(allocs_dir):
+            return
+        for alloc_id in os.listdir(allocs_dir):
+            state_dir = os.path.join(allocs_dir, alloc_id)
+            runner = AllocRunner.restore(
+                self._alloc_root(alloc_id), state_dir,
+                on_status=self._sync_alloc_status)
+            if runner is None:
+                continue
+            if runner.alloc.terminal_status() or \
+                    runner.alloc.client_status in ("dead", "failed"):
+                shutil.rmtree(state_dir, ignore_errors=True)
+                shutil.rmtree(self._alloc_root(alloc_id),
+                              ignore_errors=True)
+                continue
+            self.alloc_runners[alloc_id] = runner
+            runner.run(restore=True)
+            logger.info("client: restored alloc %s", alloc_id)
+
+    # -- main loop ----------------------------------------------------------
+    def start(self) -> None:
+        t = threading.Thread(target=self.run, daemon=True,
+                             name="client-run")
+        t.start()
+        self._threads.append(t)
+
+    def run(self) -> None:
+        self._register()
+        t = threading.Thread(target=self._heartbeat_loop, daemon=True,
+                             name="client-heartbeat")
+        t.start()
+        self._threads.append(t)
+        self._watch_allocations()
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        pool = getattr(self.rpc, "pool", None)
+        if pool is not None:
+            pool.shutdown()
+        for t in self._threads:
+            t.join(1.0)
+
+    def destroy_all(self) -> None:
+        with self._alloc_lock:
+            runners = list(self.alloc_runners.values())
+        for r in runners:
+            r.destroy_tasks()
+
+    # -- registration / heartbeat -------------------------------------------
+    def _register(self) -> None:
+        node = self.node.copy()
+        node.status = NODE_STATUS_READY
+        while not self._shutdown.is_set():
+            try:
+                resp = self.rpc.call("Node.Register",
+                                     {"node": node.to_dict()})
+                self.node = node
+                if resp.get("heartbeat_ttl"):
+                    self._heartbeat_ttl = resp["heartbeat_ttl"]
+                logger.info("client: registered node %s", node.id)
+                return
+            except Exception:
+                logger.exception("client: registration failed; retrying")
+                self._shutdown.wait(REGISTER_RETRY_INTERVAL)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._shutdown.is_set():
+            # Heartbeat at a fraction of the TTL so jitter can't expire us.
+            self._shutdown.wait(max(0.2, self._heartbeat_ttl / 2))
+            if self._shutdown.is_set():
+                return
+            try:
+                resp = self.rpc.call("Node.Heartbeat",
+                                     {"node_id": self.node.id})
+                if resp.get("heartbeat_ttl"):
+                    self._heartbeat_ttl = resp["heartbeat_ttl"]
+            except Exception:
+                logger.warning("client: heartbeat failed; re-registering")
+                self._register()
+
+    # -- alloc watching ------------------------------------------------------
+    def _watch_allocations(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                resp = self.rpc.call("Node.GetAllocs", {
+                    "node_id": self.node.id,
+                    "min_query_index": self._alloc_index,
+                    "max_query_time": 5.0,
+                })
+            except Exception:
+                logger.exception("client: alloc watch failed")
+                self._shutdown.wait(1.0)
+                continue
+            self._alloc_index = max(self._alloc_index,
+                                    resp.get("index", 0))
+            allocs = [Allocation.from_dict(a)
+                      for a in resp.get("allocs", [])]
+            self._run_allocs(allocs)
+
+    def _run_allocs(self, updated: list) -> None:
+        """Diff assigned allocs vs running runners
+        (reference client/util.go:34-70 + client.go:650-728)."""
+        assigned = {a.id: a for a in updated}
+        with self._alloc_lock:
+            existing = dict(self.alloc_runners)
+
+            # Removed: server no longer lists the alloc — stop it, drop
+            # the runner, and reclaim its directories in the background.
+            for alloc_id, runner in existing.items():
+                if alloc_id not in assigned:
+                    self.alloc_runners.pop(alloc_id, None)
+                    threading.Thread(target=runner.destroy,
+                                     daemon=True).start()
+
+            for alloc in assigned.values():
+                runner = existing.get(alloc.id)
+                if runner is None:
+                    if alloc.terminal_status():
+                        continue
+                    runner = AllocRunner(
+                        alloc, self._alloc_root(alloc.id),
+                        state_dir=self._alloc_state_dir(alloc.id),
+                        on_status=self._sync_alloc_status)
+                    self.alloc_runners[alloc.id] = runner
+                    runner.run()
+                elif alloc.modify_index > runner.alloc.modify_index:
+                    runner.update(alloc)
+
+    def _sync_alloc_status(self, alloc: Allocation) -> None:
+        """Dirty-sync client-authoritative fields to the server."""
+        update = {
+            "id": alloc.id,
+            "client_status": alloc.client_status,
+            "client_description": alloc.client_description,
+            "task_states": alloc.task_states,
+            "node_id": alloc.node_id,
+        }
+        for attempt in range(3):
+            try:
+                self.rpc.call("Node.UpdateAlloc", {"alloc": [update]})
+                return
+            except Exception:
+                if attempt == 2:
+                    logger.exception("client: alloc %s status sync failed",
+                                     alloc.id)
+                time.sleep(0.2 * (attempt + 1))

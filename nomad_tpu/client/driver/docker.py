@@ -1,0 +1,120 @@
+"""docker driver: containerized execution via the docker CLI.
+
+Capability parity with /root/reference/client/driver/docker.go: image
+pull/run with CPU shares + memory limits, port publishing from the task's
+network offer, the shared alloc dir bind-mounted at the reference's
+container paths, and handle = container id (re-attach by id after agent
+restart).  Uses the docker CLI rather than the API socket client.
+"""
+from __future__ import annotations
+
+import logging
+import shutil
+import subprocess
+from typing import Optional
+
+from .base import Driver, DriverHandle
+
+logger = logging.getLogger("nomad_tpu.client.driver.docker")
+
+
+class DockerHandle(DriverHandle):
+    def __init__(self, container_id: str) -> None:
+        self.container_id = container_id
+
+    def id(self) -> str:
+        return f"docker:{self.container_id}"
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[int]:
+        try:
+            out = subprocess.run(["docker", "wait", self.container_id],
+                                 capture_output=True, text=True,
+                                 timeout=timeout)
+        except subprocess.TimeoutExpired:
+            return None
+        try:
+            return int(out.stdout.strip())
+        except ValueError:
+            # `docker wait` failed (container removed out-of-band, daemon
+            # restart): a container that is not running is dead, not
+            # still-waiting.
+            return 125 if not self.is_running() else None
+
+    def is_running(self) -> bool:
+        out = subprocess.run(
+            ["docker", "inspect", "-f", "{{.State.Running}}",
+             self.container_id], capture_output=True, text=True)
+        return out.stdout.strip() == "true"
+
+    def update(self, task) -> None:
+        pass
+
+    def kill(self) -> None:
+        subprocess.run(["docker", "stop", "-t", "5", self.container_id],
+                       capture_output=True)
+        subprocess.run(["docker", "rm", "-f", self.container_id],
+                       capture_output=True)
+
+
+class DockerDriver(Driver):
+    name = "docker"
+
+    @classmethod
+    def fingerprint(cls, cfg, node) -> bool:
+        docker = shutil.which("docker")
+        if docker is None:
+            return False
+        try:
+            out = subprocess.run(["docker", "version", "--format",
+                                  "{{.Server.Version}}"],
+                                 capture_output=True, text=True, timeout=5)
+        except Exception:
+            return False
+        if out.returncode != 0:
+            return False
+        node.attributes["driver.docker"] = "1"
+        node.attributes["driver.docker.version"] = out.stdout.strip()
+        return True
+
+    def start(self, task):
+        image = task.config.get("image")
+        if not image:
+            raise ValueError("docker driver requires config.image")
+        argv = ["docker", "run", "-d",
+                "--name", f"nomad-{self.ctx.alloc_id[:8]}-{task.name}"]
+        res = task.resources
+        if res.cpu:
+            argv += ["--cpu-shares", str(res.cpu)]
+        if res.memory_mb:
+            argv += ["--memory", f"{res.memory_mb}m"]
+        # Shared alloc dir at the reference's mount points.
+        argv += ["-v", f"{self.ctx.alloc_dir.shared_dir}:/alloc"]
+        task_dir = self.ctx.alloc_dir.task_dirs.get(task.name)
+        if task_dir:
+            argv += ["-v", f"{task_dir}/local:/local"]
+        if res.networks:
+            net = res.networks[0]
+            for label, port in net.map_dynamic_ports().items():
+                argv += ["-p", f"{port}:{port}"]
+            for port in net.list_static_ports():
+                argv += ["-p", f"{port}:{port}"]
+        argv.append(image)
+        command = task.config.get("command")
+        if command:
+            argv.append(command)
+            args = task.config.get("args", [])
+            if isinstance(args, str):
+                args = args.split()
+            argv += list(args)
+        out = subprocess.run(argv, capture_output=True, text=True)
+        if out.returncode != 0:
+            raise RuntimeError(f"docker run failed: {out.stderr.strip()}")
+        return DockerHandle(out.stdout.strip())
+
+    def open(self, handle_id: str) -> DockerHandle:
+        kind, container_id = handle_id.split(":", 1)
+        handle = DockerHandle(container_id)
+        if not handle.is_running():
+            raise ProcessLookupError(
+                f"container {container_id} is not running")
+        return handle

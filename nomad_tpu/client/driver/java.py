@@ -1,0 +1,44 @@
+"""java driver: run a jar under the JVM.
+
+Capability parity with /root/reference/client/driver/java.go: fingerprints
+the JVM version; config carries jar_path/jvm_options/args.
+"""
+from __future__ import annotations
+
+import shutil
+import subprocess
+
+from .base import Driver
+
+
+class JavaDriver(Driver):
+    name = "java"
+
+    @classmethod
+    def fingerprint(cls, cfg, node) -> bool:
+        java = shutil.which("java")
+        if java is None:
+            return False
+        try:
+            out = subprocess.run([java, "-version"], capture_output=True,
+                                 text=True, timeout=5)
+            version_line = (out.stderr or out.stdout).splitlines()[0]
+        except Exception:
+            return False
+        node.attributes["driver.java"] = "1"
+        node.attributes["driver.java.version"] = \
+            version_line.split('"')[1] if '"' in version_line else "unknown"
+        return True
+
+    def start(self, task):
+        jar = task.config.get("jar_path") or task.config.get("jar_source")
+        if not jar:
+            raise ValueError("java driver requires config.jar_path")
+        jvm_options = task.config.get("jvm_options", [])
+        if isinstance(jvm_options, str):
+            jvm_options = jvm_options.split()
+        args = task.config.get("args", [])
+        if isinstance(args, str):
+            args = args.split()
+        argv = ["java"] + list(jvm_options) + ["-jar", jar] + list(args)
+        return self.spawn(task, argv, kind="java")

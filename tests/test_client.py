@@ -1,0 +1,318 @@
+"""Client agent tests: fingerprints, drivers, runners, full integration."""
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.client import Client, ClientConfig
+from nomad_tpu.client.allocdir import AllocDir
+from nomad_tpu.client.alloc_runner import AllocRunner
+from nomad_tpu.client.driver.base import ExecContext
+from nomad_tpu.client.fingerprint import fingerprint_node
+from nomad_tpu.client.task_env import task_environment
+from nomad_tpu.client.task_runner import TaskRunner
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.structs import (
+    NetworkResource,
+    Node,
+    Resources,
+    Task,
+    generate_uuid,
+)
+
+
+def raw_task(name="echo", command="/bin/sh",
+             args="-c 'echo hello-from-task'") -> Task:
+    return Task(name=name, driver="raw_exec",
+                config={"command": command, "args": args},
+                resources=Resources(cpu=100, memory_mb=64))
+
+
+def wait_until(fn, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# fingerprints
+# ---------------------------------------------------------------------------
+
+def test_fingerprint_populates_node():
+    cfg = ClientConfig(options={"fingerprint.skip_accel": "1"})
+    node = Node()
+    applied = fingerprint_node(cfg, node)
+    assert "arch" in applied and "cpu" in applied and "memory" in applied
+    assert node.attributes["kernel.name"]
+    assert node.resources.cpu > 0
+    assert node.resources.memory_mb > 0
+    assert node.resources.disk_mb > 0
+    assert node.attributes["cpu.numcores"]
+    assert node.resources.networks
+
+
+def test_driver_fingerprints():
+    from nomad_tpu.client.driver import BUILTIN_DRIVERS
+
+    cfg = ClientConfig(options={"driver.raw_exec.enable": "1"})
+    node = Node(attributes={"kernel.name": "linux"})
+    assert BUILTIN_DRIVERS["raw_exec"].fingerprint(cfg, node)
+    assert node.attributes["driver.raw_exec"] == "1"
+    assert BUILTIN_DRIVERS["exec"].fingerprint(cfg, node)
+    assert node.attributes["driver.exec"] == "1"
+    # raw_exec off by default
+    node2 = Node()
+    assert not BUILTIN_DRIVERS["raw_exec"].fingerprint(ClientConfig(),
+                                                       node2)
+
+
+# ---------------------------------------------------------------------------
+# alloc dir + env
+# ---------------------------------------------------------------------------
+
+def test_alloc_dir_tree(tmp_path):
+    ad = AllocDir(str(tmp_path / "a1"))
+    ad.build([raw_task("t1"), raw_task("t2")])
+    assert os.path.isdir(ad.shared_dir + "/logs")
+    assert os.path.isdir(os.path.join(ad.task_dirs["t1"], "local"))
+    # Shared dir visible from inside each task dir.
+    assert os.path.islink(os.path.join(ad.task_dirs["t2"], "alloc"))
+    ad.destroy()
+    assert not os.path.exists(ad.alloc_dir)
+
+
+def test_task_environment():
+    task = raw_task()
+    task.env = {"CUSTOM": "yes"}
+    task.meta = {"owner": "ops"}
+    res = Resources(cpu=250, memory_mb=128, networks=[NetworkResource(
+        ip="10.0.0.5", reserved_ports=[22, 8080],
+        dynamic_ports=["http"], mbits=10)])
+    env = task_environment(task, alloc_dir="/a", task_dir="/t",
+                          resources=res)
+    assert env["NOMAD_ALLOC_DIR"] == "/a"
+    assert env["NOMAD_MEMORY_LIMIT"] == "128"
+    assert env["NOMAD_CPU_LIMIT"] == "250"
+    assert env["NOMAD_IP"] == "10.0.0.5"
+    assert env["NOMAD_PORT_http"] == "8080"
+    assert env["NOMAD_META_OWNER"] == "ops"
+    assert env["CUSTOM"] == "yes"
+
+
+# ---------------------------------------------------------------------------
+# task runner
+# ---------------------------------------------------------------------------
+
+def test_task_runner_completes(tmp_path):
+    ad = AllocDir(str(tmp_path / "alloc"))
+    task = raw_task()
+    ad.build([task])
+    ctx = ExecContext(ad, "alloc-1")
+    states = []
+    tr = TaskRunner(ctx, task, state_dir=str(tmp_path / "state"),
+                    on_state=lambda n, s, d: states.append(s))
+    tr.start()
+    wait_until(lambda: tr.state == "dead", msg="task completion")
+    assert not tr.failed
+    with open(ad.log_path("echo", "stdout")) as fh:
+        assert "hello-from-task" in fh.read()
+
+
+def test_task_runner_failure(tmp_path):
+    ad = AllocDir(str(tmp_path / "alloc"))
+    task = raw_task(command="/bin/false", args="")
+    ad.build([task])
+    tr = TaskRunner(ExecContext(ad, "a"), task)
+    tr.start()
+    wait_until(lambda: tr.state == "dead", msg="task exit")
+    assert tr.failed
+
+
+def test_task_runner_destroy_kills(tmp_path):
+    ad = AllocDir(str(tmp_path / "alloc"))
+    task = raw_task(command="/bin/sleep", args="300")
+    ad.build([task])
+    tr = TaskRunner(ExecContext(ad, "a"), task)
+    tr.start()
+    wait_until(lambda: tr.state == "running", msg="task start")
+    tr.destroy()
+    wait_until(lambda: tr.state == "dead", msg="task killed")
+
+
+def test_task_runner_reattach(tmp_path):
+    """Agent restart: a new TaskRunner re-attaches to the live process via
+    the persisted handle id instead of restarting the task."""
+    ad = AllocDir(str(tmp_path / "alloc"))
+    task = raw_task(command="/bin/sleep", args="30")
+    ad.build([task])
+    state_dir = str(tmp_path / "state")
+    tr = TaskRunner(ExecContext(ad, "a"), task, state_dir=state_dir)
+    tr.start()
+    wait_until(lambda: tr.state == "running", msg="task start")
+    pid = tr.handle.pid
+
+    # "Restart": fresh runner from persisted state.
+    tr2 = TaskRunner(ExecContext(ad, "a"), task, state_dir=state_dir)
+    assert tr2.restore_state()
+    assert tr2.handle.pid == pid
+    tr2.start()
+    wait_until(lambda: tr2.state == "running", msg="re-attached running")
+    tr2.destroy()
+    wait_until(lambda: tr2.state == "dead", msg="killed after re-attach")
+    tr.destroy()
+
+
+# ---------------------------------------------------------------------------
+# alloc runner
+# ---------------------------------------------------------------------------
+
+def make_alloc(command="/bin/sh", args="-c 'echo done'"):
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.tasks = [raw_task(command=command, args=args)]
+    alloc = mock.alloc()
+    alloc.job = job
+    alloc.job_id = job.id
+    alloc.task_group = tg.name
+    alloc.task_resources = {}
+    return alloc
+
+
+def test_alloc_runner_lifecycle(tmp_path):
+    alloc = make_alloc()
+    statuses = []
+    runner = AllocRunner(alloc, str(tmp_path / "alloc"),
+                         state_dir=str(tmp_path / "state"),
+                         on_status=lambda a: statuses.append(
+                             a.client_status))
+    runner.run()
+    wait_until(lambda: runner.alloc.client_status == "dead",
+               msg="alloc completion")
+    assert "dead" in statuses
+
+
+def test_alloc_runner_failed_task(tmp_path):
+    alloc = make_alloc(command="/bin/false", args="")
+    runner = AllocRunner(alloc, str(tmp_path / "alloc"))
+    runner.run()
+    wait_until(lambda: runner.alloc.client_status == "failed",
+               msg="alloc failure")
+
+
+# ---------------------------------------------------------------------------
+# full integration: server + client over real RPC
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def cluster(tmp_path):
+    srv = Server(ServerConfig(num_schedulers=2, enable_rpc=True))
+    srv.establish_leadership()
+    cfg = ClientConfig(
+        state_dir=str(tmp_path / "client-state"),
+        alloc_dir=str(tmp_path / "allocs"),
+        servers=[srv.rpc_address()],
+        options={"driver.raw_exec.enable": "1",
+                 "fingerprint.skip_accel": "1"},
+    )
+    client = Client(cfg)
+    client.start()
+    yield srv, client
+    client.shutdown()
+    client.destroy_all()
+    srv.shutdown()
+
+
+def test_client_registers_and_runs_job(cluster):
+    srv, client = cluster
+    wait_until(lambda: srv.fsm.state.node_by_id(client.node.id)
+               is not None, msg="node registration")
+    node = srv.fsm.state.node_by_id(client.node.id)
+    assert node.status == "ready"
+    assert node.attributes.get("driver.raw_exec") == "1"
+
+    job = mock.job()
+    job.task_groups[0].count = 1
+    job.task_groups[0].tasks = [Task(
+        name="hello", driver="raw_exec",
+        config={"command": "/bin/sh", "args": "-c 'echo job-output'"},
+        resources=Resources(cpu=100, memory_mb=64))]
+    job.constraints = []
+    _, eval_id = srv.job_register(job)
+    srv.wait_for_evals([eval_id], timeout=15)
+
+    # The client picks up the alloc, runs it, and syncs terminal status.
+    def alloc_done():
+        allocs = srv.fsm.state.allocs_by_job(job.id)
+        return allocs and allocs[0].client_status == "dead"
+    wait_until(alloc_done, timeout=20, msg="alloc ran to completion")
+
+    alloc = srv.fsm.state.allocs_by_job(job.id)[0]
+    log = os.path.join(client._alloc_root(alloc.id), "alloc", "logs",
+                       "hello.stdout")
+    with open(log) as fh:
+        assert "job-output" in fh.read()
+
+
+def test_client_stops_alloc_on_deregister(cluster):
+    srv, client = cluster
+    wait_until(lambda: srv.fsm.state.node_by_id(client.node.id)
+               is not None, msg="node registration")
+    job = mock.job()
+    job.task_groups[0].count = 1
+    job.task_groups[0].tasks = [Task(
+        name="sleeper", driver="raw_exec",
+        config={"command": "/bin/sleep", "args": "300"},
+        resources=Resources(cpu=100, memory_mb=64))]
+    job.constraints = []
+    _, eval_id = srv.job_register(job)
+    srv.wait_for_evals([eval_id], timeout=15)
+
+    def running():
+        allocs = srv.fsm.state.allocs_by_job(job.id)
+        return allocs and allocs[0].client_status == "running"
+    wait_until(running, timeout=20, msg="task running")
+
+    _, e2 = srv.job_deregister(job.id)
+    srv.wait_for_evals([e2], timeout=15)
+
+    def stopped():
+        runner = client.alloc_runners.get(
+            srv.fsm.state.allocs_by_job(job.id)[0].id)
+        return runner is not None and \
+            runner.alloc.client_status in ("dead", "failed")
+    wait_until(stopped, timeout=20, msg="task stopped after deregister")
+
+
+def test_agent_restart_does_not_resurrect_completed_allocs(tmp_path):
+    """A finished alloc must not re-run its tasks when the agent restarts
+    (code-review regression)."""
+    alloc = make_alloc(command="/bin/sh",
+                       args=f"-c 'echo ran >> {tmp_path}/count'")
+    state_dir = str(tmp_path / "state")
+    runner = AllocRunner(alloc, str(tmp_path / "alloc"),
+                         state_dir=state_dir)
+    runner.run()
+    wait_until(lambda: runner.alloc.client_status == "dead",
+               msg="first run completes")
+
+    # Simulate agent restart via a fresh client restore pass.
+    cfg = ClientConfig(
+        state_dir=str(tmp_path),
+        alloc_dir=str(tmp_path / "alloc-root"),
+        rpc_handler=type("NoRPC", (), {
+            "call": lambda self, m, a, timeout=None: {}})(),
+        options={"fingerprint.skip_accel": "1"},
+    )
+    os.makedirs(os.path.join(str(tmp_path), "allocs"), exist_ok=True)
+    os.rename(state_dir, os.path.join(str(tmp_path), "allocs", alloc.id))
+    client = Client(cfg)
+    assert alloc.id not in client.alloc_runners
+    time.sleep(0.3)
+    with open(tmp_path / "count") as fh:
+        assert fh.read().count("ran") == 1
