@@ -1,0 +1,9 @@
+"""Agent: server and/or client in one process + the HTTP API.
+
+Capability parity with /root/reference/command/agent/: the deployable unit.
+A colocated client short-circuits RPC in-process (reference
+agent.go:176-178); the HTTP server exposes the /v1 REST surface with
+blocking-query support.
+"""
+from .agent import Agent, AgentConfig  # noqa: F401
+from .http_server import HTTPServer  # noqa: F401

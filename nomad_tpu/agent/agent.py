@@ -1,0 +1,205 @@
+"""Agent: embeds a Server and/or Client in one process.
+
+Capability parity with /root/reference/command/agent/agent.go: server and
+client modes can run together; a colocated client uses the server as an
+in-process RPC handler instead of the network.  ``dev_mode`` runs both with
+ephemeral state — the `nomad agent -dev` experience.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Optional
+
+from nomad_tpu.client import Client, ClientConfig
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.server.endpoints import Endpoints
+
+logger = logging.getLogger("nomad_tpu.agent")
+
+
+class InprocRPC:
+    """In-process RPC handler: calls endpoint handlers directly
+    (reference agent.go:264 + inmemCodec, nomad/server.go:616-661)."""
+
+    def __init__(self, server: Server) -> None:
+        self.endpoints = Endpoints(server)
+        self._methods: dict = {}
+        # Reuse the wire registry so method names match the network plane.
+
+        class _Reg:
+            def __init__(reg) -> None:
+                reg.table = {}
+
+            def register(reg, name, fn) -> None:
+                reg.table[name] = fn
+
+        reg = _Reg()
+        self.endpoints.install(reg)
+        self._methods = reg.table
+
+    def call(self, method: str, args: dict, timeout=None):
+        fn = self._methods.get(method)
+        if fn is None:
+            raise ValueError(f"unknown method {method!r}")
+        return fn(args)
+
+
+@dataclass
+class AgentConfig:
+    name: str = ""
+    region: str = "global"
+    datacenter: str = "dc1"
+    data_dir: str = ""
+    bind_addr: str = "127.0.0.1"
+    http_port: int = 4646
+    rpc_port: int = 4647
+    server_enabled: bool = False
+    client_enabled: bool = False
+    dev_mode: bool = False
+    bootstrap_expect: int = 1
+    num_schedulers: int = 2
+    enabled_schedulers: list = field(default_factory=list)
+    use_device_scheduler: bool = True
+    servers: list = field(default_factory=list)   # client: server addrs
+    raft_peers: list = field(default_factory=list)
+    client_options: dict = field(default_factory=dict)
+    node_class: str = ""
+    meta: dict = field(default_factory=dict)
+
+    @classmethod
+    def dev(cls) -> "AgentConfig":
+        return cls(server_enabled=True, client_enabled=True, dev_mode=True,
+                   http_port=0, rpc_port=0)
+
+
+class Agent:
+    def __init__(self, config: AgentConfig) -> None:
+        self.config = config
+        self.server: Optional[Server] = None
+        self.client: Optional[Client] = None
+        self.http = None
+
+        if config.dev_mode:
+            config.server_enabled = True
+            config.client_enabled = True
+            if not config.data_dir:
+                config.data_dir = tempfile.mkdtemp(prefix="nomad-dev-")
+            config.client_options.setdefault("driver.raw_exec.enable",
+                                             "true")
+
+        if not config.server_enabled and not config.client_enabled:
+            raise ValueError(
+                "must have at least client or server mode enabled")
+
+        self._inproc_rpc: Optional[InprocRPC] = None
+        if config.server_enabled:
+            self._setup_server()
+            self._inproc_rpc = InprocRPC(self.server)
+        if config.client_enabled:
+            self._setup_client()
+        self._setup_http()
+
+    # -- setup -------------------------------------------------------------
+    def _setup_server(self) -> None:
+        cfg = ServerConfig(
+            num_schedulers=self.config.num_schedulers,
+            use_device_scheduler=self.config.use_device_scheduler,
+            region=self.config.region,
+            bind_addr=self.config.bind_addr,
+            rpc_port=self.config.rpc_port,
+            enable_rpc=True,
+        )
+        if self.config.enabled_schedulers:
+            cfg.enabled_schedulers = list(self.config.enabled_schedulers)
+        if self.config.data_dir and not self.config.dev_mode:
+            cfg.data_dir = os.path.join(self.config.data_dir, "server")
+        if self.config.raft_peers:
+            cfg.raft_mode = "net"
+            cfg.raft_peers = list(self.config.raft_peers)
+        self.server = Server(cfg)
+        if not self.config.raft_peers:
+            # Single-server (or dev) mode: become leader immediately
+            # (reference StartAsLeader / bootstrap_expect=1).
+            self.server.establish_leadership()
+
+    def _setup_client(self) -> None:
+        from nomad_tpu.structs import Node
+
+        node = Node(datacenter=self.config.datacenter,
+                    name=self.config.name,
+                    node_class=self.config.node_class,
+                    meta=dict(self.config.meta))
+        cfg = ClientConfig(
+            state_dir=os.path.join(self.config.data_dir, "client")
+            if self.config.data_dir else "",
+            alloc_dir=os.path.join(self.config.data_dir, "alloc")
+            if self.config.data_dir else "",
+            node=node,
+            region=self.config.region,
+            options=dict(self.config.client_options),
+            servers=list(self.config.servers),
+            dev_mode=self.config.dev_mode,
+        )
+        if self.server is not None:
+            cfg.rpc_handler = self._inproc_rpc
+        elif not cfg.servers:
+            raise ValueError("client mode requires servers or a "
+                             "colocated server")
+        self.client = Client(cfg)
+        self.client.start()
+
+    def _setup_http(self) -> None:
+        from .http_server import HTTPServer
+
+        self.http = HTTPServer(self, self.config.bind_addr,
+                               self.config.http_port)
+        self.http.start()
+
+    # -- RPC from HTTP layer ------------------------------------------------
+    def rpc(self, method: str, args: dict):
+        if self._inproc_rpc is not None:
+            return self._inproc_rpc.call(method, args)
+        return self.client.rpc.call(method, args)
+
+    def join(self, address: tuple) -> int:
+        """Join another server (gossip when available, else raft peer)."""
+        if self.server is None:
+            return 0
+        gossip = getattr(self.server, "gossip", None)
+        if gossip is not None:
+            return gossip.join(address)
+        add_peer = getattr(self.server.raft, "add_peer", None)
+        if callable(add_peer):
+            add_peer(address)
+            return 1
+        return 0
+
+    # -- introspection -------------------------------------------------------
+    def stats(self) -> dict:
+        out: dict = {"agent": {"name": self.config.name or "agent"}}
+        if self.server is not None:
+            out["nomad"] = {
+                "leader": str(self.server.is_leader()).lower(),
+                "applied_index": self.server.raft.applied_index(),
+                "broker": self.server.eval_broker.stats(),
+                "plan_queue": self.server.plan_queue.stats(),
+                "heartbeats": self.server.heartbeats.active(),
+            }
+        if self.client is not None:
+            out["client"] = {
+                "node_id": self.client.node.id,
+                "allocs": len(self.client.alloc_runners),
+            }
+        return out
+
+    def shutdown(self) -> None:
+        if self.http is not None:
+            self.http.shutdown()
+        if self.client is not None:
+            self.client.shutdown()
+            self.client.destroy_all()
+        if self.server is not None:
+            self.server.shutdown()

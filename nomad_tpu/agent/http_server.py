@@ -1,0 +1,265 @@
+"""HTTP API: the /v1 REST surface.
+
+Capability parity with /root/reference/command/agent/http.go: JSON codec,
+the route table of http.go:93-121, blocking-query params
+(?wait=5s&index=N&stale&pretty), X-Nomad-Index response headers, and error
+coding (404 unknown route, 405 bad method, 500 with message body).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from nomad_tpu.utils.duration import parse_duration
+
+logger = logging.getLogger("nomad_tpu.agent.http")
+
+
+class BadRequest(Exception):
+    """Client error -> HTTP 400 (reference http.go CodedError)."""
+
+
+class HTTPServer:
+    def __init__(self, agent, host: str = "127.0.0.1",
+                 port: int = 4646) -> None:
+        self.agent = agent
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args) -> None:
+                logger.debug("http: " + fmt, *args)
+
+            def _respond(self, code: int, payload, pretty: bool = False,
+                         index: Optional[int] = None) -> None:
+                body = json.dumps(payload,
+                                  indent=4 if pretty else None
+                                  ).encode() + b"\n"
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                if index is not None:
+                    self.send_header("X-Nomad-Index", str(index))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _handle(self) -> None:
+                url = urlparse(self.path)
+                query = {k: v[0] for k, v in
+                         parse_qs(url.query, keep_blank_values=True
+                                  ).items()}
+                body = {}
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    try:
+                        body = json.loads(self.rfile.read(length))
+                    except ValueError:
+                        self._respond(400, {"error": "invalid JSON body"})
+                        return
+                try:
+                    code, payload, index = outer.route(
+                        self.command, url.path, query, body)
+                except KeyError as e:
+                    self._respond(404, {"error": str(e)})
+                    return
+                except BadRequest as e:
+                    self._respond(400, {"error": str(e)})
+                    return
+                except MethodNotAllowed:
+                    self._respond(405, {"error": "method not allowed"})
+                    return
+                except Exception as e:
+                    logger.debug("http request failed", exc_info=True)
+                    self._respond(500, {"error": str(e)})
+                    return
+                self._respond(code, payload, pretty="pretty" in query,
+                              index=index)
+
+            do_GET = do_PUT = do_POST = do_DELETE = _handle
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self.address = self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="http-listener")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- routing -----------------------------------------------------------
+    def route(self, method: str, path: str, query: dict, body):
+        agent = self.agent
+        rpc_args = {}
+        try:
+            if "index" in query:
+                rpc_args["min_query_index"] = int(query["index"])
+            if "wait" in query:
+                rpc_args["max_query_time"] = parse_duration(query["wait"])
+        except ValueError as e:
+            raise BadRequest(str(e)) from e
+        if "stale" in query:
+            rpc_args["stale"] = True
+
+        parts = [p for p in path.split("/") if p]
+        if not parts or parts[0] != "v1":
+            raise KeyError(f"unknown path {path}")
+        parts = parts[1:]
+
+        def out(resp: dict, key: Optional[str] = None, code: int = 200):
+            index = resp.get("index") if isinstance(resp, dict) else None
+            payload = resp.get(key) if key else resp
+            return code, payload, index
+
+        # ---- /v1/jobs ----------------------------------------------------
+        if parts == ["jobs"]:
+            if method == "GET":
+                return out(agent.rpc("Job.List", rpc_args), "jobs")
+            if method in ("PUT", "POST"):
+                return out(agent.rpc("Job.Register",
+                                     {"job": body.get("job", body)}))
+            raise MethodNotAllowed
+
+        if len(parts) >= 2 and parts[0] == "job":
+            job_id = parts[1]
+            rest = parts[2:]
+            if not rest:
+                if method == "GET":
+                    resp = agent.rpc("Job.GetJob",
+                                     dict(rpc_args, job_id=job_id))
+                    if resp.get("job") is None:
+                        raise KeyError(f"job not found: {job_id}")
+                    return out(resp, "job")
+                if method in ("PUT", "POST"):
+                    return out(agent.rpc("Job.Register",
+                                         {"job": body.get("job", body)}))
+                if method == "DELETE":
+                    return out(agent.rpc("Job.Deregister",
+                                         {"job_id": job_id}))
+                raise MethodNotAllowed
+            if rest == ["allocations"]:
+                return out(agent.rpc("Job.Allocations",
+                                     dict(rpc_args, job_id=job_id)),
+                           "allocations")
+            if rest == ["evaluations"]:
+                return out(agent.rpc("Job.Evaluations",
+                                     dict(rpc_args, job_id=job_id)),
+                           "evaluations")
+            if rest == ["evaluate"]:
+                return out(agent.rpc("Job.Evaluate", {"job_id": job_id}))
+            raise KeyError(f"unknown path {path}")
+
+        # ---- /v1/nodes ---------------------------------------------------
+        if parts == ["nodes"]:
+            return out(agent.rpc("Node.List", rpc_args), "nodes")
+        if len(parts) >= 2 and parts[0] == "node":
+            node_id = parts[1]
+            rest = parts[2:]
+            if not rest:
+                resp = agent.rpc("Node.GetNode",
+                                 dict(rpc_args, node_id=node_id))
+                if resp.get("node") is None:
+                    raise KeyError(f"node not found: {node_id}")
+                return out(resp, "node")
+            if rest == ["allocations"]:
+                return out(agent.rpc("Node.GetAllocs",
+                                     dict(rpc_args, node_id=node_id)),
+                           "allocs")
+            if rest == ["drain"]:
+                enable = str(query.get("enable", "")).lower() in \
+                    ("1", "true")
+                return out(agent.rpc("Node.UpdateDrain",
+                                     {"node_id": node_id,
+                                      "drain": enable}))
+            if rest == ["evaluate"]:
+                return out(agent.rpc("Node.Evaluate",
+                                     {"node_id": node_id}))
+            raise KeyError(f"unknown path {path}")
+
+        # ---- /v1/allocations --------------------------------------------
+        if parts == ["allocations"]:
+            return out(agent.rpc("Alloc.List", rpc_args), "allocations")
+        if len(parts) == 2 and parts[0] == "allocation":
+            resp = agent.rpc("Alloc.GetAlloc",
+                             dict(rpc_args, alloc_id=parts[1]))
+            if resp.get("alloc") is None:
+                raise KeyError(f"alloc not found: {parts[1]}")
+            return out(resp, "alloc")
+
+        # ---- /v1/evaluations --------------------------------------------
+        if parts == ["evaluations"]:
+            return out(agent.rpc("Eval.List", rpc_args), "evaluations")
+        if len(parts) >= 2 and parts[0] == "evaluation":
+            eval_id = parts[1]
+            rest = parts[2:]
+            if not rest:
+                resp = agent.rpc("Eval.GetEval",
+                                 dict(rpc_args, eval_id=eval_id))
+                if resp.get("eval") is None:
+                    raise KeyError(f"eval not found: {eval_id}")
+                return out(resp, "eval")
+            if rest == ["allocations"]:
+                return out(agent.rpc("Eval.Allocations",
+                                     dict(rpc_args, eval_id=eval_id)),
+                           "allocations")
+            raise KeyError(f"unknown path {path}")
+
+        # ---- /v1/agent + /v1/status -------------------------------------
+        if parts == ["agent", "self"]:
+            return 200, {"config": vars(agent.config),
+                         "stats": agent.stats()}, None
+        if parts == ["agent", "members"]:
+            members = []
+            if agent.server is not None:
+                gossip = getattr(agent.server, "gossip", None)
+                if gossip is not None:
+                    members = gossip.members()
+                else:
+                    members = [
+                        {"name": agent.config.name or "server",
+                         "addr": list(agent.server.rpc_address() or ())}]
+            return 200, {"members": members}, None
+        if parts == ["agent", "servers"]:
+            if agent.client is not None:
+                servers = [list(s) for s in agent.client.config.servers]
+            elif agent.server is not None:
+                servers = [list(p) for p in agent.server.peers()]
+            else:
+                servers = []
+            return 200, servers, None
+        if parts == ["agent", "join"]:
+            address = query.get("address", "")
+            try:
+                host, port = address.rsplit(":", 1)
+                target = (host, int(port))
+            except ValueError as e:
+                raise BadRequest(
+                    f"invalid join address {address!r}") from e
+            n = agent.join(target)
+            return 200, {"num_joined": n}, None
+        if parts == ["agent", "force-leave"]:
+            name = query.get("node", "")
+            if agent.server is not None and \
+                    getattr(agent.server, "gossip", None) is not None:
+                agent.server.gossip.force_leave(name)
+            return 200, {}, None
+
+        if parts == ["status", "leader"]:
+            return out(agent.rpc("Status.Leader", {}), "leader")
+        if parts == ["status", "peers"]:
+            return out(agent.rpc("Status.Peers", {}), "peers")
+
+        raise KeyError(f"unknown path {path}")
+
+
+class MethodNotAllowed(Exception):
+    pass

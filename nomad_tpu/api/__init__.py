@@ -1,0 +1,11 @@
+"""Typed HTTP API client.
+
+Capability parity with /root/reference/api/: query/write options, blocking
+queries, and wrappers for Jobs/Nodes/Evaluations/Allocations/Agent/Status.
+"""
+from .client import (  # noqa: F401
+    APIClient,
+    APIError,
+    QueryMeta,
+    QueryOptions,
+)

@@ -1,0 +1,2 @@
+"""Command-line interface (python -m nomad_tpu.cli)."""
+from .main import main  # noqa: F401

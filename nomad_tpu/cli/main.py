@@ -1,0 +1,402 @@
+"""CLI commands.
+
+Capability parity with /root/reference/command/ + commands.go registry:
+agent, run, stop, status, node-status, node-drain, eval-monitor,
+server-members, server-join, agent-info, validate, init, version.  All
+commands talk to the agent's HTTP API (reference: CLI -> api/ -> agent).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+from nomad_tpu import __version__
+from nomad_tpu.api import APIClient, APIError, QueryOptions
+
+DEFAULT_ADDRESS = os.environ.get("NOMAD_ADDR", "http://127.0.0.1:4646")
+
+EXAMPLE_JOB = """\
+# There can only be a single job definition per file.
+job "example" {
+    # Run the job in the global region, which is the default.
+    # region = "global"
+
+    # Specify the datacenters within the region this job can run in.
+    datacenters = ["dc1"]
+
+    # Service type jobs optimize for long-lived services.  Use "batch" for
+    # short-lived tasks, "system" to run on every node.
+    # type = "service"
+
+    # Priority controls access to resources and preemption, 1 to 100.
+    # priority = 50
+
+    # Restrict the job to linux nodes.
+    constraint {
+        attribute = "$attr.kernel.name"
+        value = "linux"
+    }
+
+    # Rolling updates: one task at a time, 10s apart.
+    update {
+        stagger = "10s"
+        max_parallel = 1
+    }
+
+    group "cache" {
+        # Number of instances of this group.
+        count = 1
+
+        task "redis" {
+            driver = "exec"
+
+            config {
+                command = "/bin/sleep"
+                args = "300"
+            }
+
+            resources {
+                cpu = 500     # MHz
+                memory = 256  # MB
+                network {
+                    mbits = 10
+                    dynamic_ports = ["redis"]
+                }
+            }
+        }
+    }
+}
+"""
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="nomad-tpu",
+        description="TPU-native cluster scheduler")
+    parser.add_argument("-address", default=DEFAULT_ADDRESS,
+                        help="agent HTTP address")
+    sub = parser.add_subparsers(dest="command")
+
+    p = sub.add_parser("agent", help="run an agent")
+    p.add_argument("-dev", action="store_true")
+    p.add_argument("-server", action="store_true")
+    p.add_argument("-client", action="store_true")
+    p.add_argument("-data-dir", default="")
+    p.add_argument("-bind", default="127.0.0.1")
+    p.add_argument("-http-port", type=int, default=4646)
+    p.add_argument("-rpc-port", type=int, default=4647)
+    p.add_argument("-servers", default="",
+                   help="comma-separated server RPC addrs (client mode)")
+    p.add_argument("-config", default="",
+                   help="JSON config file (merged over flags)")
+
+    p = sub.add_parser("init", help="create an example job file")
+
+    p = sub.add_parser("validate", help="validate a job file")
+    p.add_argument("file")
+
+    p = sub.add_parser("run", help="submit a job")
+    p.add_argument("file")
+    p.add_argument("-detach", action="store_true")
+
+    p = sub.add_parser("stop", help="stop a job")
+    p.add_argument("job_id")
+
+    p = sub.add_parser("status", help="job status")
+    p.add_argument("job_id", nargs="?")
+
+    p = sub.add_parser("node-status", help="node status")
+    p.add_argument("node_id", nargs="?")
+
+    p = sub.add_parser("node-drain", help="toggle node drain")
+    p.add_argument("node_id")
+    p.add_argument("-enable", action="store_true")
+    p.add_argument("-disable", action="store_true")
+
+    p = sub.add_parser("eval-monitor", help="monitor an evaluation")
+    p.add_argument("eval_id")
+
+    p = sub.add_parser("alloc-status", help="allocation status")
+    p.add_argument("alloc_id")
+
+    sub.add_parser("server-members", help="list cluster servers")
+    p = sub.add_parser("server-join", help="join a server")
+    p.add_argument("join_address")
+    sub.add_parser("agent-info", help="agent diagnostics")
+    sub.add_parser("version", help="print version")
+
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.print_help()
+        return 1
+    try:
+        return COMMANDS[args.command](args)
+    except APIError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    except (ConnectionError, OSError) as e:
+        print(f"Error connecting to {args.address}: {e}", file=sys.stderr)
+        return 1
+
+
+# ---------------------------------------------------------------------------
+# commands
+# ---------------------------------------------------------------------------
+
+def cmd_agent(args) -> int:
+    from nomad_tpu.agent import Agent, AgentConfig
+
+    if args.dev:
+        cfg = AgentConfig.dev()
+        cfg.http_port = args.http_port
+        cfg.rpc_port = args.rpc_port
+    else:
+        cfg = AgentConfig(
+            server_enabled=args.server,
+            client_enabled=args.client,
+            data_dir=args.data_dir,
+            bind_addr=args.bind,
+            http_port=args.http_port,
+            rpc_port=args.rpc_port,
+        )
+        if args.servers:
+            for part in args.servers.split(","):
+                host, port = part.rsplit(":", 1)
+                cfg.servers.append((host, int(port)))
+    if args.config:
+        with open(args.config) as fh:
+            for key, value in json.load(fh).items():
+                setattr(cfg, key, value)
+
+    agent = Agent(cfg)
+    http_host, http_port = agent.http.address
+    print(f"==> nomad-tpu agent started")
+    print(f"    HTTP: http://{http_host}:{http_port}")
+    if agent.server is not None and agent.server.rpc_address():
+        rh, rp = agent.server.rpc_address()
+        print(f"    RPC:  {rh}:{rp}")
+    if agent.client is not None:
+        print(f"    Node: {agent.client.node.id}")
+    stop = []
+    signal.signal(signal.SIGINT, lambda *_: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
+    while not stop:
+        time.sleep(0.2)
+    print("==> caught signal, shutting down")
+    agent.shutdown()
+    return 0
+
+
+def cmd_init(args) -> int:
+    if os.path.exists("example.nomad"):
+        print("Job 'example.nomad' already exists", file=sys.stderr)
+        return 1
+    with open("example.nomad", "w") as fh:
+        fh.write(EXAMPLE_JOB)
+    print("Example job file written to example.nomad")
+    return 0
+
+
+def cmd_validate(args) -> int:
+    from nomad_tpu.jobspec import ParseError, parse_file
+
+    try:
+        parse_file(args.file)
+    except ParseError as e:
+        print(f"Job validation failed: {e}", file=sys.stderr)
+        return 1
+    print("Job validation successful")
+    return 0
+
+
+def cmd_run(args) -> int:
+    from nomad_tpu.jobspec import ParseError, parse_file
+
+    try:
+        job = parse_file(args.file)
+    except ParseError as e:
+        print(f"Error parsing job: {e}", file=sys.stderr)
+        return 1
+    client = APIClient(args.address)
+    resp = client.job_register(job)
+    eval_id = resp.get("eval_id", "")
+    if args.detach or not eval_id:
+        print(f"Job registration successful\nEvaluation ID: {eval_id}")
+        return 0
+    return _monitor_eval(client, eval_id)
+
+
+def cmd_stop(args) -> int:
+    client = APIClient(args.address)
+    resp = client.job_deregister(args.job_id)
+    eval_id = resp.get("eval_id", "")
+    print(f"Job deregistered\nEvaluation ID: {eval_id}")
+    return 0
+
+
+def cmd_status(args) -> int:
+    client = APIClient(args.address)
+    if not args.job_id:
+        jobs, _ = client.jobs_list()
+        if not jobs:
+            print("No jobs registered")
+            return 0
+        print(f"{'ID':<28} {'Type':<8} {'Priority':<8} Status")
+        for job in jobs:
+            print(f"{job.id:<28} {job.type:<8} {job.priority:<8} "
+                  f"{job.status}")
+        return 0
+    job, _ = client.job_info(args.job_id)
+    print(f"ID       = {job.id}")
+    print(f"Name     = {job.name}")
+    print(f"Type     = {job.type}")
+    print(f"Priority = {job.priority}")
+    print(f"Status   = {job.status}")
+    allocs, _ = client.job_allocations(args.job_id)
+    if allocs:
+        print("\nAllocations:")
+        print(f"{'ID':<38} {'Node':<38} {'Group':<10} "
+              f"{'Desired':<8} Client")
+        for a in allocs:
+            print(f"{a.id:<38} {a.node_id:<38} {a.task_group:<10} "
+                  f"{a.desired_status:<8} {a.client_status}")
+    return 0
+
+
+def cmd_node_status(args) -> int:
+    client = APIClient(args.address)
+    if not args.node_id:
+        nodes, _ = client.nodes_list()
+        print(f"{'ID':<38} {'DC':<8} {'Name':<16} {'Class':<12} "
+              f"{'Drain':<6} Status")
+        for n in nodes:
+            print(f"{n.id:<38} {n.datacenter:<8} {n.name:<16} "
+                  f"{n.node_class:<12} {str(n.drain).lower():<6} "
+                  f"{n.status}")
+        return 0
+    node, _ = client.node_info(args.node_id)
+    print(f"ID     = {node.id}")
+    print(f"Name   = {node.name}")
+    print(f"Class  = {node.node_class}")
+    print(f"DC     = {node.datacenter}")
+    print(f"Drain  = {str(node.drain).lower()}")
+    print(f"Status = {node.status}")
+    print(f"Attributes = "
+          f"{', '.join(f'{k}={v}' for k, v in sorted(node.attributes.items()))}")
+    allocs, _ = client.node_allocations(args.node_id)
+    if allocs:
+        print("\nAllocations:")
+        for a in allocs:
+            print(f"{a.id}  job={a.job_id}  {a.desired_status}/"
+                  f"{a.client_status}")
+    return 0
+
+
+def cmd_node_drain(args) -> int:
+    if args.enable == args.disable:
+        print("Either -enable or -disable is required", file=sys.stderr)
+        return 1
+    client = APIClient(args.address)
+    client.node_drain(args.node_id, args.enable)
+    print(f"Node {args.node_id} drain = {args.enable}")
+    return 0
+
+
+def cmd_eval_monitor(args) -> int:
+    client = APIClient(args.address)
+    return _monitor_eval(client, args.eval_id)
+
+
+def _monitor_eval(client: APIClient, eval_id: str,
+                  timeout: float = 60.0) -> int:
+    """Poll an eval until terminal, then report its allocations
+    (reference command/monitor.go)."""
+    print(f"==> Monitoring evaluation \"{eval_id[:8]}\"")
+    deadline = time.monotonic() + timeout
+    index = 0
+    while time.monotonic() < deadline:
+        ev, meta = client.eval_info(eval_id, QueryOptions(
+            wait_index=index, wait_time=2.0))
+        index = meta.last_index
+        if ev.status in ("complete", "failed"):
+            print(f"    Evaluation status: {ev.status} "
+                  f"{ev.status_description}")
+            allocs, _ = client.eval_allocations(eval_id)
+            for a in allocs:
+                where = f"on node {a.node_id[:8]}" if a.node_id else \
+                    "unplaced"
+                print(f"    Allocation {a.id[:8]} {where} "
+                      f"({a.desired_status})")
+            if ev.next_eval:
+                print(f"    Followup eval: {ev.next_eval}")
+            return 0 if ev.status == "complete" else 2
+    print("    Monitor timed out", file=sys.stderr)
+    return 1
+
+
+def cmd_alloc_status(args) -> int:
+    client = APIClient(args.address)
+    alloc, _ = client.alloc_info(args.alloc_id)
+    print(f"ID         = {alloc.id}")
+    print(f"Eval       = {alloc.eval_id}")
+    print(f"Job        = {alloc.job_id}")
+    print(f"TaskGroup  = {alloc.task_group}")
+    print(f"Node       = {alloc.node_id}")
+    print(f"Desired    = {alloc.desired_status}")
+    print(f"Client     = {alloc.client_status}")
+    if alloc.metrics:
+        m = alloc.metrics
+        print(f"\nPlacement metrics:")
+        print(f"  Nodes evaluated = {m.nodes_evaluated}")
+        print(f"  Nodes filtered  = {m.nodes_filtered}")
+        print(f"  Nodes exhausted = {m.nodes_exhausted}")
+        for key, score in sorted(m.scores.items()):
+            print(f"  Score {key} = {score:.3f}")
+    return 0
+
+
+def cmd_server_members(args) -> int:
+    client = APIClient(args.address)
+    for member in client.agent_members():
+        print(member)
+    return 0
+
+
+def cmd_server_join(args) -> int:
+    client = APIClient(args.address)
+    resp = client.agent_join(args.join_address)
+    print(f"Joined {resp.get('num_joined', 0)} servers")
+    return 0
+
+
+def cmd_agent_info(args) -> int:
+    client = APIClient(args.address)
+    print(json.dumps(client.agent_self(), indent=2, default=str))
+    return 0
+
+
+def cmd_version(args) -> int:
+    print(f"nomad-tpu v{__version__}")
+    return 0
+
+
+COMMANDS = {
+    "agent": cmd_agent,
+    "init": cmd_init,
+    "validate": cmd_validate,
+    "run": cmd_run,
+    "stop": cmd_stop,
+    "status": cmd_status,
+    "node-status": cmd_node_status,
+    "node-drain": cmd_node_drain,
+    "eval-monitor": cmd_eval_monitor,
+    "alloc-status": cmd_alloc_status,
+    "server-members": cmd_server_members,
+    "server-join": cmd_server_join,
+    "agent-info": cmd_agent_info,
+    "version": cmd_version,
+}

@@ -1,0 +1,307 @@
+"""Agent + HTTP API + api client + jobspec + CLI tests."""
+from __future__ import annotations
+
+import io
+import os
+import sys
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.api import APIClient, APIError, QueryOptions
+from nomad_tpu.jobspec import ParseError, parse
+
+
+def wait_until(fn, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+JOBSPEC = """
+job "web" {
+    datacenters = ["dc1"]
+    type = "service"
+
+    constraint {
+        attribute = "$attr.kernel.name"
+        value = "linux"
+    }
+
+    update {
+        stagger = "10s"
+        max_parallel = 1
+    }
+
+    group "frontend" {
+        count = 2
+        task "server" {
+            driver = "raw_exec"
+            config {
+                command = "/bin/sleep"
+                args = "120"
+            }
+            env {
+                PORT = "8080"
+            }
+            resources {
+                cpu = 100
+                memory = 64
+                network {
+                    mbits = 5
+                    dynamic_ports = ["http"]
+                }
+            }
+        }
+        meta {
+            owner = "team-web"
+        }
+    }
+}
+"""
+
+
+# ---------------------------------------------------------------------------
+# jobspec parsing
+# ---------------------------------------------------------------------------
+
+class TestJobspec:
+    def test_parse_full_spec(self):
+        job = parse(JOBSPEC)
+        assert job.id == "web"
+        assert job.datacenters == ["dc1"]
+        assert job.constraints[0].l_target == "$attr.kernel.name"
+        assert job.update.stagger == 10.0
+        assert job.update.max_parallel == 1
+        tg = job.task_groups[0]
+        assert tg.name == "frontend" and tg.count == 2
+        task = tg.tasks[0]
+        assert task.driver == "raw_exec"
+        assert task.config["command"] == "/bin/sleep"
+        assert task.env["PORT"] == "8080"
+        assert task.resources.cpu == 100
+        assert task.resources.networks[0].dynamic_ports == ["http"]
+        assert tg.meta["owner"] == "team-web"
+
+    def test_parse_reference_example(self):
+        """The reference's `nomad init` example parses (docker variant)."""
+        spec = """
+job "example" {
+    datacenters = ["dc1"]
+    constraint {
+        attribute = "$attr.kernel.name"
+        value = "linux"
+    }
+    update {
+        stagger = "10s"
+        max_parallel = 1
+    }
+    group "cache" {
+        count = 1
+        task "redis" {
+            driver = "docker"
+            config {
+                image = "redis:latest"
+            }
+            resources {
+                cpu = 500
+                memory = 256
+                network {
+                    mbits = 10
+                    dynamic_ports = ["6379"]
+                }
+            }
+        }
+    }
+}
+"""
+        job = parse(spec)
+        assert job.task_groups[0].tasks[0].config["image"] == \
+            "redis:latest"
+
+    def test_job_level_task_wraps_group(self):
+        spec = """
+job "solo" {
+    datacenters = ["dc1"]
+    task "one" {
+        driver = "exec"
+        config { command = "/bin/true" }
+    }
+}
+"""
+        job = parse(spec)
+        assert len(job.task_groups) == 1
+        assert job.task_groups[0].name == "one"
+
+    def test_constraint_sugar(self):
+        spec = """
+job "sugar" {
+    datacenters = ["dc1"]
+    constraint {
+        attribute = "$attr.version"
+        version = ">= 0.1.0"
+    }
+    constraint {
+        attribute = "$node.name"
+        regexp = "web-.*"
+    }
+    group "g" {
+        task "t" { driver = "exec" config { command = "/bin/true" } }
+    }
+}
+"""
+        job = parse(spec)
+        assert job.constraints[0].operand == "version"
+        assert job.constraints[1].operand == "regexp"
+
+    def test_errors(self):
+        with pytest.raises(ParseError):
+            parse("not a job")
+        with pytest.raises(ParseError):
+            parse('job "x" {}')  # missing dc + groups
+        with pytest.raises(ParseError):
+            parse('job "x" { datacenters = ["dc1"] '
+                  'group "g" { task "t" { driver = "exec" '
+                  'resources { network { dynamic_ports = ["bad!port"] '
+                  '} } } } }')
+
+
+# ---------------------------------------------------------------------------
+# agent + HTTP + api client end to end
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def dev_agent(tmp_path_factory):
+    cfg = AgentConfig.dev()
+    cfg.data_dir = str(tmp_path_factory.mktemp("agent"))
+    cfg.client_options["fingerprint.skip_accel"] = "1"
+    agent = Agent(cfg)
+    client = APIClient(f"http://127.0.0.1:{agent.http.address[1]}")
+    wait_until(lambda: agent.server.fsm.state.nodes(),
+               msg="client node registration")
+    yield agent, client
+    agent.shutdown()
+
+
+class TestHTTPAPI:
+    def test_run_job_via_api(self, dev_agent):
+        agent, client = dev_agent
+        job = parse(JOBSPEC)
+        resp = client.job_register(job)
+        assert resp["eval_id"]
+
+        # Eval completes; allocations placed and eventually running.
+        def eval_done():
+            ev, _ = client.eval_info(resp["eval_id"])
+            return ev.status == "complete"
+        wait_until(eval_done, msg="eval completion")
+
+        allocs, meta = client.job_allocations("web")
+        assert len(allocs) == 2
+        assert meta.last_index > 0
+        wait_until(lambda: all(
+            a.client_status == "running"
+            for a, _m in [client.alloc_info(al.id) for al in allocs]
+            for a in [a]), timeout=20, msg="tasks running")
+
+        got, _ = client.job_info("web")
+        assert got.id == "web"
+        jobs, _ = client.jobs_list()
+        assert any(j.id == "web" for j in jobs)
+
+        evals, _ = client.job_evaluations("web")
+        assert evals
+
+        # Node surface.
+        nodes, _ = client.nodes_list()
+        assert len(nodes) == 1
+        node, _ = client.node_info(nodes[0].id)
+        assert node.status == "ready"
+        node_allocs, _ = client.node_allocations(node.id)
+        assert len(node_allocs) == 2
+
+        # Status surface.
+        assert client.status_leader()
+        assert client.agent_self()["stats"]["nomad"]["leader"] == "true"
+
+        # Stop the job.
+        client.job_deregister("web")
+
+        def stopped():
+            allocs, _ = client.job_allocations("web")
+            return all(a.desired_status == "stop" for a in allocs)
+        wait_until(stopped, msg="job stopped")
+
+    def test_blocking_query_via_api(self, dev_agent):
+        agent, client = dev_agent
+        _, meta = client.nodes_list()
+        start = time.monotonic()
+        _, meta2 = client.nodes_list(QueryOptions(
+            wait_index=meta.last_index, wait_time=0.5))
+        elapsed = time.monotonic() - start
+        assert elapsed >= 0.4  # blocked until the (jittered) wait expired
+
+    def test_404s(self, dev_agent):
+        _, client = dev_agent
+        with pytest.raises(APIError) as e:
+            client.job_info("no-such-job")
+        assert e.value.status == 404
+        with pytest.raises(APIError):
+            client.raw("GET", "/v1/bogus")
+
+
+# ---------------------------------------------------------------------------
+# CLI (in-process, pointed at the dev agent)
+# ---------------------------------------------------------------------------
+
+class TestCLI:
+    def run_cli(self, dev_agent, *argv) -> tuple[int, str]:
+        from nomad_tpu.cli import main
+
+        agent, _ = dev_agent
+        address = f"http://127.0.0.1:{agent.http.address[1]}"
+        stdout = io.StringIO()
+        old = sys.stdout
+        sys.stdout = stdout
+        try:
+            code = main(["-address", address] + list(argv))
+        finally:
+            sys.stdout = old
+        return code, stdout.getvalue()
+
+    def test_version(self, dev_agent):
+        code, out = self.run_cli(dev_agent, "version")
+        assert code == 0 and "nomad-tpu v" in out
+
+    def test_node_status(self, dev_agent):
+        code, out = self.run_cli(dev_agent, "node-status")
+        assert code == 0
+        assert "ready" in out
+
+    def test_run_status_stop(self, dev_agent, tmp_path):
+        spec = tmp_path / "cli-job.nomad"
+        spec.write_text(JOBSPEC.replace('job "web"', 'job "cli-job"')
+                        .replace('count = 2', 'count = 1'))
+        code, out = self.run_cli(dev_agent, "run", str(spec))
+        assert code == 0, out
+        assert "complete" in out
+
+        code, out = self.run_cli(dev_agent, "status", "cli-job")
+        assert code == 0
+        assert "cli-job" in out
+
+        code, out = self.run_cli(dev_agent, "stop", "cli-job")
+        assert code == 0
+
+    def test_validate_and_init(self, dev_agent, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        code, out = self.run_cli(dev_agent, "init")
+        assert code == 0
+        code, out = self.run_cli(dev_agent, "validate", "example.nomad")
+        assert code == 0, out
+        assert "successful" in out
