@@ -193,6 +193,9 @@ class Agent:
                 "node_id": self.client.node.id,
                 "allocs": len(self.client.alloc_runners),
             }
+        from nomad_tpu.utils.metrics import metrics
+
+        out["metrics"] = metrics.inmem.snapshot()
         return out
 
     def shutdown(self) -> None:

@@ -25,6 +25,8 @@ from nomad_tpu.structs import (
     remove_allocs,
 )
 
+from nomad_tpu.utils.metrics import metrics
+
 logger = logging.getLogger("nomad_tpu.server.plan_apply")
 
 
@@ -66,6 +68,8 @@ class OptimisticSnapshot:
 def evaluate_plan(snap, plan: Plan) -> PlanResult:
     """Determine the committable portion of a plan
     (plan_apply.go:171-233)."""
+    import time as _time
+    _start = _time.perf_counter()
     result = PlanResult(failed_allocs=list(plan.failed_allocs))
 
     node_ids = set(plan.node_update) | set(plan.node_allocation)
@@ -86,6 +90,7 @@ def evaluate_plan(snap, plan: Plan) -> PlanResult:
             result.node_allocation = {}
             return result
         # Partial acceptance: skip this node only.
+    metrics.measure_since("nomad.plan.evaluate", _start)
     return result
 
 

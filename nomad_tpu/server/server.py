@@ -60,6 +60,9 @@ class ServerConfig:
         self.rpc_port: int = 0      # 0 = ephemeral
         self.raft_mode: str = "inmem"   # "inmem" | "net"
         self.raft_peers: list = []      # [(host, port), ...]
+        self.enable_gossip: bool = False
+        self.gossip_port: int = 0
+        self.server_name: str = ""
         self.raft_election_timeout: tuple = (0.15, 0.30)
         self.raft_heartbeat_interval: float = 0.05
         self.bootstrap_expect: int = 1
@@ -119,7 +122,46 @@ class Server:
             self.plan_queue, self.eval_broker, self.raft,
             lambda: self.fsm.state)
 
+        # Gossip membership: servers discover one another and reconcile
+        # raft peers from alive/fail events (reference nomad/serf.go +
+        # leader.go:277-303 reconcileMember).
+        self.gossip = None
+        if self.config.enable_gossip:
+            from .gossip import Gossip
+            rpc_addr = self.rpc_address()
+            self.gossip = Gossip(
+                tags={"role": "nomad-server",
+                      "region": self.config.region,
+                      "name": self.config.server_name,
+                      "rpc": list(rpc_addr) if rpc_addr else None},
+                bind=self.config.bind_addr,
+                port=self.config.gossip_port,
+                on_join=self._gossip_join,
+                on_fail=self._gossip_fail,
+                on_leave=self._gossip_fail,
+            )
+
         self._setup_workers()
+
+    def _gossip_join(self, member) -> None:
+        """A server joined the gossip pool: add it as a raft peer
+        (reference serf.go nodeJoin + leader.go reconcileMember)."""
+        if member.tags.get("role") != "nomad-server":
+            return
+        if member.tags.get("region") != self.config.region:
+            return  # other regions federate, they don't share raft
+        rpc = member.tags.get("rpc")
+        add_peer = getattr(self.raft, "add_peer", None)
+        if rpc and callable(add_peer):
+            add_peer((rpc[0], rpc[1]))
+
+    def _gossip_fail(self, member) -> None:
+        if member.tags.get("role") != "nomad-server":
+            return
+        rpc = member.tags.get("rpc")
+        remove_peer = getattr(self.raft, "remove_peer", None)
+        if rpc and callable(remove_peer):
+            remove_peer((rpc[0], rpc[1]))
 
     def _on_leadership_change(self, is_leader: bool) -> None:
         """monitorLeadership parity (leader.go:16-50)."""
@@ -215,6 +257,8 @@ class Server:
         for w in self.workers:
             w.stop()
         self.revoke_leadership()
+        if self.gossip is not None:
+            self.gossip.shutdown()
         raft_shutdown = getattr(self.raft, "shutdown", None)
         if callable(raft_shutdown):
             raft_shutdown()

@@ -20,6 +20,7 @@ import time
 from typing import Optional
 
 from nomad_tpu.scheduler import new_scheduler
+from nomad_tpu.utils.metrics import metrics
 from nomad_tpu.structs import Evaluation, Plan, PlanResult, codec
 
 logger = logging.getLogger("nomad_tpu.server.worker")
@@ -107,6 +108,7 @@ class Worker:
             time.sleep(0.005)
 
     def _invoke_scheduler(self, ev: Evaluation) -> None:
+        start = time.perf_counter()
         state = self.server.fsm.state.snapshot()
         name = self.scheduler_override or ev.type
         if name == "_core":
@@ -115,6 +117,8 @@ class Worker:
             return
         sched = new_scheduler(name, state, self)
         sched.process(ev)
+        metrics.measure_since("nomad.worker.invoke_scheduler." + name,
+                              start)
 
     # -- Planner seam ------------------------------------------------------
     def submit_plan(self, plan: Plan) -> tuple[PlanResult, Optional[object]]:

@@ -1,0 +1,124 @@
+"""Telemetry: counters, gauges and timers with pluggable sinks.
+
+Capability parity with armon/go-metrics as the reference uses it
+(MeasureSince on every RPC/FSM/worker/plan step, SetGauge for queue depths,
+in-memory sink dumpable on demand, optional statsd/statsite UDP fanout —
+reference command/agent/command.go:487-533).
+"""
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from collections import defaultdict
+from typing import Optional
+
+
+class InmemSink:
+    """Aggregating in-memory sink (intervals collapsed to one window)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.counters: dict = defaultdict(float)
+        self.gauges: dict = {}
+        self.samples: dict = defaultdict(list)
+
+    def incr_counter(self, key: str, value: float) -> None:
+        with self._lock:
+            self.counters[key] += value
+
+    def set_gauge(self, key: str, value: float) -> None:
+        with self._lock:
+            self.gauges[key] = value
+
+    def add_sample(self, key: str, value: float) -> None:
+        with self._lock:
+            samples = self.samples[key]
+            samples.append(value)
+            if len(samples) > 4096:
+                del samples[: len(samples) - 4096]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {"counters": dict(self.counters),
+                   "gauges": dict(self.gauges), "samples": {}}
+            for key, values in self.samples.items():
+                if not values:
+                    continue
+                ordered = sorted(values)
+                out["samples"][key] = {
+                    "count": len(values),
+                    "mean": sum(values) / len(values),
+                    "max": ordered[-1],
+                    "p99": ordered[min(len(ordered) - 1,
+                                       int(len(ordered) * 0.99))],
+                }
+            return out
+
+
+class StatsdSink:
+    """Fire-and-forget statsd UDP fanout."""
+
+    def __init__(self, address: tuple) -> None:
+        self.address = tuple(address)
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    def _send(self, payload: str) -> None:
+        try:
+            self.sock.sendto(payload.encode(), self.address)
+        except OSError:
+            pass
+
+    def incr_counter(self, key: str, value: float) -> None:
+        self._send(f"{key}:{value}|c")
+
+    def set_gauge(self, key: str, value: float) -> None:
+        self._send(f"{key}:{value}|g")
+
+    def add_sample(self, key: str, value: float) -> None:
+        self._send(f"{key}:{value * 1000:.3f}|ms")
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self.sinks: list = [InmemSink()]
+
+    @property
+    def inmem(self) -> InmemSink:
+        return self.sinks[0]
+
+    def add_statsd(self, host: str, port: int) -> None:
+        self.sinks.append(StatsdSink((host, port)))
+
+    def incr_counter(self, key: str, value: float = 1.0) -> None:
+        for sink in self.sinks:
+            sink.incr_counter(key, value)
+
+    def set_gauge(self, key: str, value: float) -> None:
+        for sink in self.sinks:
+            sink.set_gauge(key, value)
+
+    def measure_since(self, key: str, start: float) -> None:
+        elapsed = time.perf_counter() - start
+        for sink in self.sinks:
+            sink.add_sample(key, elapsed)
+
+    def timer(self, key: str) -> "_Timer":
+        return _Timer(self, key)
+
+
+class _Timer:
+    def __init__(self, metrics: Metrics, key: str) -> None:
+        self.metrics = metrics
+        self.key = key
+
+    def __enter__(self):
+        self.start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.metrics.measure_since(self.key, self.start)
+
+
+# Global registry, mirroring go-metrics' package-level default.
+metrics = Metrics()

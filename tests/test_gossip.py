@@ -1,0 +1,97 @@
+"""Gossip membership tests: discovery, failure detection, raft reconcile."""
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.server.gossip import ALIVE, DEAD, Gossip
+
+
+def wait_until(fn, timeout=8.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+FAST_GOSSIP = dict(probe_interval=0.05, probe_timeout=0.05,
+                   suspect_timeout=0.3)
+
+
+def test_join_merges_membership():
+    g1 = Gossip({"name": "a"}, **FAST_GOSSIP)
+    g2 = Gossip({"name": "b"}, **FAST_GOSSIP)
+    g3 = Gossip({"name": "c"}, **FAST_GOSSIP)
+    try:
+        g2.join(g1.addr)
+        g3.join(g1.addr)  # learns about g2 transitively
+        wait_until(lambda: len(g1.alive_addrs()) == 3, msg="g1 sees 3")
+        wait_until(lambda: len(g3.alive_addrs()) == 3, msg="g3 sees 3")
+    finally:
+        for g in (g1, g2, g3):
+            g.shutdown()
+
+
+def test_failure_detection():
+    g1 = Gossip({"name": "a"}, **FAST_GOSSIP)
+    g2 = Gossip({"name": "b"}, **FAST_GOSSIP)
+    failed = []
+    g1.on_fail = lambda m: failed.append(m.addr)
+    try:
+        g2.join(g1.addr)
+        wait_until(lambda: len(g1.alive_addrs()) == 2, msg="join")
+        g2._stop.set()
+        g2.sock.close()
+        wait_until(lambda: g2.addr in failed, msg="failure detection")
+        members = {tuple(m["addr"]): m["status"]
+                   for m in g1.members(status=None)}
+        assert members[g2.addr] == DEAD
+    finally:
+        g1.shutdown()
+
+
+def test_join_events_fire():
+    joined = []
+    g1 = Gossip({"name": "a"}, on_join=lambda m: joined.append(
+        m.tags.get("name")), **FAST_GOSSIP)
+    g2 = Gossip({"name": "b"}, **FAST_GOSSIP)
+    try:
+        g2.join(g1.addr)
+        wait_until(lambda: "b" in joined, msg="join event")
+    finally:
+        g1.shutdown()
+        g2.shutdown()
+
+
+def test_gossip_reconciles_raft_peers():
+    """Servers discover each other via gossip and converge on one raft
+    cluster with a single leader."""
+    cfg = dict(raft_mode="net", raft_election_timeout=(0.05, 0.10),
+               raft_heartbeat_interval=0.02, num_schedulers=1,
+               enable_gossip=True)
+    servers = [Server(ServerConfig(**cfg)) for _ in range(3)]
+    try:
+        for s in servers[1:]:
+            s.gossip.join(servers[0].gossip.addr)
+        # Every server learns every peer via gossip -> raft peers.
+        wait_until(lambda: all(len(s.raft.peer_addresses()) == 3
+                               for s in servers),
+                   msg="raft peers from gossip")
+        wait_until(lambda: sum(1 for s in servers
+                               if s.raft.is_leader()) == 1,
+                   msg="single leader")
+        import nomad_tpu.mock as mock
+
+        leader = next(s for s in servers if s.raft.is_leader())
+        node = mock.node()
+        leader.node_register(node)
+        wait_until(lambda: all(
+            s.fsm.state.node_by_id(node.id) is not None
+            for s in servers), msg="replication")
+    finally:
+        for s in servers:
+            s.shutdown()
