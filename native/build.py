@@ -1,0 +1,45 @@
+"""Build the _nomad_native C++ extension in place.
+
+Usage: python native/build.py
+Produces _nomad_native.<abi>.so next to the nomad_tpu package; the package
+auto-detects it (nomad_tpu/utils/native.py) and falls back to pure Python
+when absent.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+
+def build() -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(here)
+    src = os.path.join(here, "port_alloc.cpp")
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    out = os.path.join(repo, f"_nomad_native{suffix}")
+    include = sysconfig.get_paths()["include"]
+    cmd = [
+        os.environ.get("CXX", "g++"), "-O2", "-std=c++17", "-shared",
+        "-fPIC", f"-I{include}", src, "-o", out,
+    ]
+    subprocess.run(cmd, check=True)
+    return out
+
+
+if __name__ == "__main__":
+    path = build()
+    print(f"built {path}")
+    sys.path.insert(0, os.path.dirname(path))
+    import _nomad_native
+
+    ports = _nomad_native.assign_ports({22, 80}, [8080], 2, 20000, 60000,
+                                       20)
+    assert ports is not None and ports[0] == 8080 and len(ports) == 3
+    assert _nomad_native.assign_ports({22}, [22], 0, 20000, 60000, 20) \
+        is None
+    used: set = set()
+    assert _nomad_native.add_all(used, [1, 2, 3]) is False
+    assert _nomad_native.add_all(used, [3]) is True
+    print("self-test ok")

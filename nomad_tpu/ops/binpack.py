@@ -119,6 +119,80 @@ def _place_sequence(capacity, reserved, usage0, job_counts0, feasible, asks,
 
 place_sequence = jax.jit(_place_sequence, static_argnames=("unroll",))
 
+
+def _place_rounds(capacity, reserved, usage0, jc0, feasible, asks, distinct,
+                  counts, penalty, k_cap: int, rounds: int):
+    """Round-based placement: many copies per device step.
+
+    For each task-group slot, one step scores the fleet once and places up
+    to ``min(remaining, k_cap)`` copies on the top-scoring DISTINCT nodes
+    (lax.top_k), then repeats for ``rounds`` rounds.  Equivalent to the
+    one-at-a-time greedy whenever the anti-affinity penalty exceeds the
+    bin-packing score gain of adding one copy (the host checks that
+    condition and falls back to ``place_sequence`` otherwise) — because
+    then the greedy never stacks a second copy on a node before using every
+    other feasible node, i.e. it spreads exactly like top-k.
+
+    Motivation: sequential scans pay a fixed per-iteration cost (severe on
+    remote-attached TPUs); this path needs S x rounds steps instead of one
+    step per placement — a 10k-placement eval with one deduped group runs
+    in ~1 device step.
+
+    Args mirror place_sequence except:
+      counts: i32[G] — copies to place per slot.
+      k_cap:  static — max copies placeable per round (>= max count).
+      rounds: static — rounds per slot (host sizes it so
+              rounds * feasible_count >= count).
+
+    Returns:
+      chosen: i32[G, rounds * k_cap] node indices in placement order per
+              slot (-1 = unplaced), scores alike, final usage.
+    """
+
+    def slot_step(carry, s):
+        usage, jc = carry
+        ask = asks[s]
+        feas = feasible[s]
+        dist = distinct[s]
+
+        def round_step(carry2, _r):
+            usage, jc, m = carry2
+            masked = score_all_nodes(capacity, reserved, usage, jc, ask,
+                                     feas, dist, penalty)
+            vals, idx = lax.top_k(masked, k_cap)
+            pos = lax.iota(jnp.int32, k_cap)
+            valid = (pos < m) & (vals > NEG_INF / 2)
+            usage = usage.at[idx].add(
+                jnp.where(valid[:, None], ask[None, :], 0.0))
+            jc = jc.at[idx].add(valid.astype(jc.dtype))
+            placed = valid.sum()
+            chosen_r = jnp.where(valid, idx.astype(jnp.int32), -1)
+            return (usage, jc, m - placed), (chosen_r, vals)
+
+        (usage, jc, _m), (chosen_rs, val_rs) = lax.scan(
+            round_step, (usage, jc, counts[s]), jnp.arange(rounds))
+        return (usage, jc), (chosen_rs.reshape(-1), val_rs.reshape(-1))
+
+    (usage, _jc), (chosen, scores) = lax.scan(
+        slot_step, (usage0, jc0), jnp.arange(feasible.shape[0]))
+    return chosen, scores, usage
+
+
+place_rounds = jax.jit(_place_rounds, static_argnames=("k_cap", "rounds"))
+
+
+def _place_rounds_batched(capacity, reserved, usage0, jc0, feasible, asks,
+                          distinct, counts, penalty, k_cap: int,
+                          rounds: int):
+    fn = jax.vmap(partial(_place_rounds, k_cap=k_cap, rounds=rounds),
+                  in_axes=(None, None, None, 0, 0, 0, 0, 0, 0))
+    return fn(capacity, reserved, usage0, jc0, feasible, asks, distinct,
+              counts, penalty)
+
+
+place_rounds_batch = jax.jit(_place_rounds_batched,
+                             static_argnames=("k_cap", "rounds"))
+
 # Batched over independent evaluations (axis 0 of per-eval args):
 # optimistic concurrency on device — every eval starts from the SAME
 # snapshot usage (broadcast on device, no per-eval upload) and evolves its

@@ -110,6 +110,7 @@ class BatchEvalRunner:
         group_idx = np.zeros((B, p_max), dtype=np.int32)
         valid = np.zeros((B, p_max), dtype=bool)
         job_counts = np.zeros((B, statics.n_pad), dtype=np.int32)
+        counts = np.zeros((B, g_max), dtype=np.int32)
         for b, (_s, _p, a) in enumerate(pending):
             feasible[b, :a.g_pad] = a.feasible_h
             asks[b, :a.g_pad] = a.asks
@@ -117,20 +118,41 @@ class BatchEvalRunner:
             group_idx[b, :a.p_pad] = a.group_idx
             valid[b, :a.p_pad] = a.valid
             job_counts[b] = a.view.job_counts
+            counts[b, :a.g_pad] = a.counts
 
         capacity_d, reserved_d = statics.device_capacity_reserved()
         base_usage = pending[0][2].view.usage
         penalty = np.asarray([a.penalty for _, _, a in pending],
                              dtype=np.float32)
-        chosen, scores, _usage = place_sequence_batch(
-            capacity_d, reserved_d, base_usage, job_counts, feasible, asks,
-            distinct, group_idx, valid, penalty)
-        chosen = np.asarray(chosen)
-        scores = np.asarray(scores)
 
-        for b, (sched, place, args) in enumerate(pending):
-            sched.finish_deferred(place, args, chosen[b], scores[b])
-            self._finish(sched)
+        if all(a.rounds_eligible for _, _, a in pending):
+            # Fast path: top-k rounds — device steps scale with unique
+            # groups x rounds, not with placements.
+            from nomad_tpu.ops.binpack import place_rounds_batch
+            from .jax_binpack import rounds_to_placements
+
+            k_cap = max(a.k_cap for _, _, a in pending)
+            rounds = max(a.rounds for _, _, a in pending)
+            chosen_s, score_s, _u = place_rounds_batch(
+                capacity_d, reserved_d, base_usage, job_counts, feasible,
+                asks, distinct, counts, penalty, k_cap=k_cap,
+                rounds=rounds)
+            chosen_s = np.asarray(chosen_s)
+            score_s = np.asarray(score_s)
+            for b, (sched, place, args) in enumerate(pending):
+                chosen, scores = rounds_to_placements(
+                    args, chosen_s[b], score_s[b])
+                sched.finish_deferred(place, args, chosen, scores)
+                self._finish(sched)
+        else:
+            chosen, scores, _usage = place_sequence_batch(
+                capacity_d, reserved_d, base_usage, job_counts, feasible,
+                asks, distinct, group_idx, valid, penalty)
+            chosen = np.asarray(chosen)
+            scores = np.asarray(scores)
+            for b, (sched, place, args) in enumerate(pending):
+                sched.finish_deferred(place, args, chosen[b], scores[b])
+                self._finish(sched)
 
         if leftovers:
             self._process_leftovers(leftovers)

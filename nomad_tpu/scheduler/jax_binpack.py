@@ -55,7 +55,10 @@ class DeviceArgs:
 
     __slots__ = ("statics", "view", "feasible_d", "feasible_h", "asks",
                  "distinct", "group_idx", "valid", "sizes", "slot_of_tg",
-                 "penalty", "g_pad", "p_pad", "start")
+                 "penalty", "g_pad", "p_pad", "start",
+                 # rounds-mode plan (see ops/binpack.py place_rounds):
+                 "counts", "slot_placements", "k_cap", "rounds",
+                 "rounds_eligible")
 
     def __init__(self, **kw) -> None:
         for k, v in kw.items():
@@ -95,12 +98,23 @@ class JaxBinPackScheduler(GenericScheduler):
             self.deferred = (place, args)
             return
         capacity_d, reserved_d = args.statics.device_capacity_reserved()
-        chosen, scores, _ = place_sequence(
-            capacity_d, reserved_d, args.view.usage, args.view.job_counts,
-            args.feasible_d, args.asks, args.distinct, args.group_idx,
-            args.valid, args.penalty)
-        self.finish_deferred(place, args, np.asarray(chosen),
-                             np.asarray(scores))
+        if args.rounds_eligible:
+            from nomad_tpu.ops.binpack import place_rounds
+
+            chosen_s, scores_s, _ = place_rounds(
+                capacity_d, reserved_d, args.view.usage,
+                args.view.job_counts, args.feasible_d, args.asks,
+                args.distinct, args.counts, args.penalty,
+                k_cap=args.k_cap, rounds=args.rounds)
+            chosen, scores = rounds_to_placements(
+                args, np.asarray(chosen_s), np.asarray(scores_s))
+        else:
+            chosen, scores, _ = place_sequence(
+                capacity_d, reserved_d, args.view.usage,
+                args.view.job_counts, args.feasible_d, args.asks,
+                args.distinct, args.group_idx, args.valid, args.penalty)
+            chosen, scores = np.asarray(chosen), np.asarray(scores)
+        self.finish_deferred(place, args, chosen, scores)
 
     def _prepare_device(self, place: list) -> DeviceArgs:
         start = time.perf_counter()
@@ -168,19 +182,54 @@ class JaxBinPackScheduler(GenericScheduler):
 
         group_idx = np.zeros(p_pad, dtype=np.int32)
         valid = np.zeros(p_pad, dtype=bool)
+        slot_placements: dict = {}
         for p, missing in enumerate(place):
-            group_idx[p] = slot_of_tg[id(missing.task_group)]
+            slot = slot_of_tg[id(missing.task_group)]
+            group_idx[p] = slot
             valid[p] = True
+            slot_placements.setdefault(slot, []).append(p)
 
         penalty = BATCH_JOB_ANTI_AFFINITY_PENALTY if self.batch else \
             SERVICE_JOB_ANTI_AFFINITY_PENALTY
+
+        # Rounds-mode plan: place a whole top-k batch of copies per device
+        # step instead of one-per-step (ops/binpack.py place_rounds).
+        # Greedy-equivalent when the anti-affinity penalty exceeds the
+        # worst-case packing-score gain of one extra copy.
+        counts = np.zeros(g_pad, dtype=np.int32)
+        for slot, ps in slot_placements.items():
+            counts[slot] = len(ps)
+        avail = statics.capacity[:statics.n_real] - \
+            statics.reserved[:statics.n_real]
+        min_cpu = float(avail[:, 0].min()) if statics.n_real else 1.0
+        min_mem = float(avail[:, 1].min()) if statics.n_real else 1.0
+        eligible = statics.n_real > 0
+        rounds = 1
+        for slot, ps in slot_placements.items():
+            frac_c = asks[slot, 0] / max(min_cpu, 1.0)
+            frac_m = asks[slot, 1] / max(min_mem, 1.0)
+            gain_bound = 10.0 * (1.0 - 10.0 ** (-frac_c)) + \
+                10.0 * (1.0 - 10.0 ** (-frac_m))
+            if gain_bound >= penalty * 0.95:
+                eligible = False
+                break
+            feas_count = int(feasible_h[slot, :statics.n_real].sum())
+            need = -(-len(ps) // max(feas_count, 1))  # ceil
+            if need > 4:
+                eligible = False
+                break
+            rounds = max(rounds, need)
+        k_cap = _pad_to(max((len(ps) for ps in slot_placements.values()),
+                            default=1))
 
         return DeviceArgs(
             statics=statics, view=view, feasible_d=feasible_d,
             feasible_h=feasible_h, asks=asks, distinct=distinct,
             group_idx=group_idx, valid=valid, sizes=sizes,
             slot_of_tg=slot_of_tg, penalty=penalty, g_pad=g_pad,
-            p_pad=p_pad, start=start)
+            p_pad=p_pad, start=start, counts=counts,
+            slot_placements=slot_placements, k_cap=k_cap, rounds=rounds,
+            rounds_eligible=eligible)
 
     def finish_deferred(self, place: list, args: DeviceArgs,
                         chosen: np.ndarray, scores: np.ndarray) -> None:
@@ -190,6 +239,11 @@ class JaxBinPackScheduler(GenericScheduler):
         sizes = args.sizes
         slot_of_tg = args.slot_of_tg
         device_time = time.perf_counter() - args.start
+        # Per-node NetworkIndex cache for this plan: built on first
+        # placement on a node, then updated incrementally with each offer
+        # (rebuilding from proposed allocs per placement dominated host
+        # time at 10k nodes).
+        self._net_cache: dict = {}
 
         failed_tg: dict = {}
         fallback_nodes = None
@@ -231,6 +285,9 @@ class JaxBinPackScheduler(GenericScheduler):
                 if ranked is not None:
                     option_node = ranked.node
                     task_resources = ranked.task_resources
+                    # The fallback assigned ports outside our per-node
+                    # index cache: rebuild that node's index on next use.
+                    self._net_cache.pop(option_node.id, None)
                 # stack.select populated fresh ctx metrics (incl. scores).
                 metrics = self.ctx.metrics()
             else:
@@ -278,9 +335,15 @@ class JaxBinPackScheduler(GenericScheduler):
         """Exact host-side port/bandwidth assignment on the device winner
         (BinPackIterator parity, reference scheduler/rank.go:180-205).
         Returns task name -> Resources, or None if the node can't take it."""
-        net_idx = NetworkIndex()
-        net_idx.set_node(node)
-        net_idx.add_allocs(self.ctx.proposed_allocs(node.id))
+        cache = getattr(self, "_net_cache", None)
+        net_idx = cache.get(node.id) if cache is not None else None
+        if net_idx is None:
+            net_idx = NetworkIndex()
+            net_idx.set_node(node)
+            net_idx.add_allocs(self.ctx.proposed_allocs(node.id))
+            if cache is not None:
+                cache[node.id] = net_idx
+        staged = []
         out = {}
         for task in tg.tasks:
             task_resources = task.resources.copy()
@@ -288,11 +351,36 @@ class JaxBinPackScheduler(GenericScheduler):
                 ask = task_resources.networks[0]
                 offer, _err = net_idx.assign_network(ask)
                 if offer is None:
+                    # Roll back offers staged for earlier tasks of this
+                    # group so the cached index stays consistent.
+                    for o in staged:
+                        net_idx.remove_reserved(o)
                     return None
                 net_idx.add_reserved(offer)
+                staged.append(offer)
                 task_resources.networks = [offer]
             out[task.name] = task_resources
         return out
+
+
+def rounds_to_placements(args: DeviceArgs, chosen_slots: np.ndarray,
+                         score_slots: np.ndarray
+                         ) -> tuple[np.ndarray, np.ndarray]:
+    """Map place_rounds output ([G, rounds*k_cap] per-slot streams) back to
+    per-placement arrays in the original placement order."""
+    chosen = np.full(args.p_pad, -1, dtype=np.int32)
+    scores = np.zeros(args.p_pad, dtype=np.float32)
+    for slot, ps in args.slot_placements.items():
+        stream = chosen_slots[slot]
+        vals = score_slots[slot]
+        taken = stream >= 0
+        nodes = stream[taken]
+        node_scores = vals[taken]
+        n = min(len(ps), len(nodes))
+        for j in range(n):
+            chosen[ps[j]] = nodes[j]
+            scores[ps[j]] = node_scores[j]
+    return chosen, scores
 
 
 def new_jax_binpack_scheduler(state, planner) -> JaxBinPackScheduler:

@@ -106,6 +106,15 @@ class NetworkIndex:
             self.used_bandwidth.get(n.device, 0) + n.mbits
         return collide
 
+    def remove_reserved(self, n: NetworkResource) -> None:
+        """Undo add_reserved (speculative offers rolled back)."""
+        used = self.used_ports.get(n.ip)
+        if used is not None:
+            for port in n.reserved_ports:
+                used.discard(port)
+        self.used_bandwidth[n.device] = \
+            self.used_bandwidth.get(n.device, 0) - n.mbits
+
     def _yield_ips(self):
         for n in self.avail_networks:
             for ip in _cidr_ips(n.cidr):
@@ -116,6 +125,9 @@ class NetworkIndex:
         rng: Optional[random.Random] = None,
     ) -> tuple[Optional[NetworkResource], str]:
         """Offer an IP + ports satisfying the ask, or (None, reason)."""
+        from nomad_tpu.utils.native import HAS_NATIVE, native
+
+        use_native = HAS_NATIVE and rng is None
         rng = rng or random
         err = "no networks available"
         for n, ip_str in self._yield_ips():
@@ -124,7 +136,25 @@ class NetworkIndex:
                 err = "bandwidth exceeded"
                 continue
 
-            used = self.used_ports.get(ip_str, set())
+            used = self.used_ports.get(ip_str)
+            if used is None:
+                used = self.used_ports.setdefault(ip_str, set())
+
+            if use_native:
+                # C++ fast path (native/port_alloc.cpp): same semantics,
+                # one call instead of a Python loop per port attempt.
+                ports = native.assign_ports(
+                    used, ask.reserved_ports, len(ask.dynamic_ports),
+                    MIN_DYNAMIC_PORT, MAX_DYNAMIC_PORT,
+                    MAX_RAND_PORT_ATTEMPTS)
+                if ports is None:
+                    err = "port selection failed"
+                    continue
+                return NetworkResource(
+                    device=n.device, ip=ip_str, mbits=ask.mbits,
+                    reserved_ports=ports,
+                    dynamic_ports=list(ask.dynamic_ports)), ""
+
             if any(port in used for port in ask.reserved_ports):
                 err = "reserved port collision"
                 continue

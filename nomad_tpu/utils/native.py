@@ -1,0 +1,16 @@
+"""Loader for the optional C++ extension (_nomad_native).
+
+The extension accelerates the host scheduling plane's hot loops (dynamic
+port assignment — see native/port_alloc.cpp).  Pure-Python fallbacks keep
+everything working when it hasn't been built; ``python native/build.py``
+produces it.
+"""
+from __future__ import annotations
+
+try:
+    import _nomad_native as native  # type: ignore
+
+    HAS_NATIVE = True
+except ImportError:  # pragma: no cover - exercised on unbuilt checkouts
+    native = None
+    HAS_NATIVE = False

@@ -252,3 +252,39 @@ def test_copy_round_trips_every_field():
                 continue
             assert getattr(copied, f.name) == getattr(obj, f.name), \
                 f"{cls.__name__}.copy() drops field {f.name!r}"
+
+
+def test_native_port_assignment_parity():
+    """When the C++ extension is built, its port assignment matches the
+    pure-Python path's semantics (collisions, dynamic picks, exhaustion)."""
+    from nomad_tpu.utils.native import HAS_NATIVE
+    import pytest as _pytest
+    if not HAS_NATIVE:
+        _pytest.skip("native extension not built")
+
+    from nomad_tpu.structs import NetworkIndex, NetworkResource, Node, Resources
+
+    node = Node(id="n", resources=Resources(networks=[NetworkResource(
+        device="eth0", cidr="10.0.0.1/32", mbits=1000)]))
+    idx = NetworkIndex()
+    idx.set_node(node)
+    idx.add_reserved(NetworkResource(device="eth0", ip="10.0.0.1",
+                                     reserved_ports=[8080]))
+
+    # Reserved-port collision -> rejected.
+    offer, err = idx.assign_network(NetworkResource(
+        mbits=10, reserved_ports=[8080]))
+    assert offer is None
+
+    # Dynamic ports avoid used + duplicates.
+    offer, err = idx.assign_network(NetworkResource(
+        mbits=10, reserved_ports=[9090], dynamic_ports=["a", "b"]))
+    assert offer is not None
+    assert offer.reserved_ports[0] == 9090
+    assert len(set(offer.reserved_ports)) == 3
+    assert all(20000 <= p < 60000 for p in offer.reserved_ports[1:])
+    assert offer.map_dynamic_ports().keys() == {"a", "b"}
+
+    # Bandwidth exceeded.
+    offer, err = idx.assign_network(NetworkResource(mbits=10_000))
+    assert offer is None and "bandwidth" in err
