@@ -140,9 +140,10 @@ def _place_rounds(capacity, reserved, usage0, jc0, feasible, asks, distinct,
 
     Args mirror place_sequence except:
       counts: i32[G] — copies to place per slot.
-      k_cap:  static — max copies placeable per round (>= max count).
+      k_cap:  static — max copies placeable per round (<= padded node
+              axis; may be below a slot's count, extra rounds cover it).
       rounds: static — rounds per slot (host sizes it so
-              rounds * feasible_count >= count).
+              rounds * min(feasible_count, k_cap) >= count).
 
     Returns:
       chosen: i32[G, rounds * k_cap] node indices in placement order per

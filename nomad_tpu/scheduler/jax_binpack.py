@@ -205,6 +205,12 @@ class JaxBinPackScheduler(GenericScheduler):
         min_mem = float(avail[:, 1].min()) if statics.n_real else 1.0
         eligible = statics.n_real > 0
         rounds = 1
+        # top_k's k may not exceed the node axis: clamp and let extra
+        # rounds make up the difference (a round places <= k_cap copies).
+        k_cap = min(
+            _pad_to(max((len(ps) for ps in slot_placements.values()),
+                        default=1)),
+            statics.n_pad)
         for slot, ps in slot_placements.items():
             frac_c = asks[slot, 0] / max(min_cpu, 1.0)
             frac_m = asks[slot, 1] / max(min_mem, 1.0)
@@ -214,13 +220,12 @@ class JaxBinPackScheduler(GenericScheduler):
                 eligible = False
                 break
             feas_count = int(feasible_h[slot, :statics.n_real].sum())
-            need = -(-len(ps) // max(feas_count, 1))  # ceil
+            per_round = max(min(feas_count, k_cap), 1)
+            need = -(-len(ps) // per_round)  # ceil
             if need > 4:
                 eligible = False
                 break
             rounds = max(rounds, need)
-        k_cap = _pad_to(max((len(ps) for ps in slot_placements.values()),
-                            default=1))
 
         return DeviceArgs(
             statics=statics, view=view, feasible_d=feasible_d,
