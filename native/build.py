@@ -20,11 +20,19 @@ def build() -> str:
     suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
     out = os.path.join(repo, f"_nomad_native{suffix}")
     include = sysconfig.get_paths()["include"]
+    # Compile to a per-process temp name, then atomically rename: a
+    # concurrent importer never sees a partially written .so.
+    tmp = f"{out}.{os.getpid()}.tmp"
     cmd = [
         os.environ.get("CXX", "g++"), "-O2", "-std=c++17", "-shared",
-        "-fPIC", f"-I{include}", src, "-o", out,
+        "-fPIC", f"-I{include}", src, "-o", tmp,
     ]
-    subprocess.run(cmd, check=True)
+    try:
+        subprocess.run(cmd, check=True)
+        os.replace(tmp, out)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
     return out
 
 

@@ -12,11 +12,14 @@ ApplyFuture``) mirroring how the reference submits type-prefixed log entries
 """
 from __future__ import annotations
 
+import logging
 import os
 import threading
 from typing import Optional
 
 import msgpack
+
+logger = logging.getLogger("nomad_tpu.server.raft")
 
 
 class ApplyFuture:
@@ -153,17 +156,26 @@ class InmemRaft:
         self._entries_since_snap = 0
 
         # Boot: restore newest snapshot, then replay the tail of the log.
+        # Snapshot files wrap (term, fsm_blob) — shared format with NetRaft
+        # so one data_dir moves between backends.
         if snapshots is not None:
             latest = snapshots.latest()
             if latest is not None:
-                index, blob = latest
-                fsm.restore(blob)
+                index, wrapped = latest
+                _term, blob = msgpack.unpackb(wrapped, raw=False)
+                fsm.restore(bytes(blob))
                 self._applied = index
         if log_store is not None:
             for index, entry in log_store.replay():
                 if index <= self._applied:
                     continue
-                fsm.apply(index, entry)
+                try:
+                    fsm.apply(index, entry)
+                except Exception:
+                    # A bad record must not crash-loop server boot; the
+                    # write it carried already failed when first applied.
+                    logger.exception("skipping unreplayable log entry %d",
+                                     index)
                 self._applied = index
 
     def applied_index(self) -> int:
@@ -174,15 +186,30 @@ class InmemRaft:
         future = ApplyFuture()
         with self._lock:
             index = self._applied + 1
-            if self.log_store is not None:
-                self.log_store.append(index, entry)
             try:
                 response = self.fsm.apply(index, entry)
             except Exception as e:  # surface apply errors to the caller
                 future.respond(index, None, e)
                 return future
+            # Persist only after a successful apply: a failing entry must
+            # not survive on disk (boot replay would re-raise) nor consume
+            # the index (the next apply reuses it).  If the DISK write
+            # fails after the FSM mutated, the index is still consumed
+            # (state advanced) and the caller sees the error — durability
+            # of this one entry is lost, consistency is not.
+            disk_error = None
+            if self.log_store is not None:
+                try:
+                    self.log_store.append(index, entry)
+                except Exception as e:
+                    logger.exception("raft log append failed at index %d",
+                                     index)
+                    disk_error = e
             self._applied = index
             self._entries_since_snap += 1
+        if disk_error is not None:
+            future.respond(index, response, disk_error)
+            return future
         future.respond(index, response)
         self._maybe_snapshot()
         return future
@@ -198,7 +225,10 @@ class InmemRaft:
             return
         with self._lock:
             blob = self.fsm.snapshot()
-            self.snapshots.save(self._applied, blob)
+            # Term 0: the single-node backend has no elections; NetRaft
+            # reading this snapshot starts with a base term of 0.
+            self.snapshots.save(
+                self._applied, msgpack.packb((0, blob), use_bin_type=True))
             if self.log_store is not None:
                 self.log_store.truncate()
             self._entries_since_snap = 0

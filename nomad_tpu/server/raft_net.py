@@ -31,9 +31,11 @@ import threading
 import time
 from typing import Callable, Optional
 
+import msgpack
+
 from nomad_tpu.structs import codec
 
-from .raft import ApplyFuture, FileLogStore
+from .raft import ApplyFuture, FileLogStore, SnapshotStore
 
 logger = logging.getLogger("nomad_tpu.server.raft_net")
 
@@ -111,16 +113,40 @@ class NetRaft:
         self._snap_index = 0
         self._snap_term = 0
 
-        # Durability (term/vote + log), reloaded on boot.
+        # Durability (term/vote + snapshots + log), reloaded on boot.
         self._meta_path = None
         self._log_store = None
+        self._snap_store = None
         if data_dir:
             os.makedirs(f"{data_dir}/raft", exist_ok=True)
             self._meta_path = f"{data_dir}/raft/meta.json"
             self._load_meta()
+            self._snap_store = SnapshotStore(f"{data_dir}/raft/snapshots")
+            latest = self._snap_store.latest()
+            if latest is not None:
+                # Snapshot files wrap (term, fsm_blob) so the log base term
+                # survives restarts (reference FileSnapshotStore metadata).
+                snap_index, wrapped = latest
+                snap_term, blob = msgpack.unpackb(wrapped, raw=False)
+                self.fsm.restore(bytes(blob))
+                self._snap_blob = bytes(blob)
+                self._snap_index = snap_index
+                self._snap_term = snap_term
+                self._log_base_index = snap_index
+                self._log_base_term = snap_term
+                self._commit_index = snap_index
+                self._last_applied = snap_index
             self._log_store = FileLogStore(f"{data_dir}/raft/log.bin")
             for index, record in self._log_store.replay():
                 term, data = record["t"], record["d"]
+                if index <= self._log_base_index:
+                    continue
+                if index <= self._last_index():
+                    # A re-appended record at an already-seen index marks a
+                    # conflict truncation (_handle_append_entries rewrites
+                    # from here): drop the stale suffix, last writer wins.
+                    cut = index - self._log_base_index - 1
+                    self._log = self._log[:cut]
                 if index == self._last_index() + 1:
                     self._log.append({"term": term, "index": index,
                                       "data": data})
@@ -483,6 +509,13 @@ class NetRaft:
         self._snap_blob = blob
         self._snap_index = self._last_applied
         self._snap_term = self._term_at(self._last_applied) or self._term
+        # Persist the snapshot BEFORE truncating the durable log: a crash
+        # between the two leaves either (old log, maybe-new snapshot) or
+        # (new snapshot, truncated log) — both restorable.
+        if self._snap_store is not None:
+            self._snap_store.save(
+                self._snap_index,
+                msgpack.packb((self._snap_term, blob), use_bin_type=True))
         keep = [e for e in self._log if e["index"] > self._last_applied]
         self._log_base_term = self._snap_term
         self._log_base_index = self._snap_index
@@ -571,4 +604,17 @@ class NetRaft:
             self._log_base_term = args["last_included_term"]
             self._commit_index = index
             self._last_applied = index
+            # Durably replace the local history: the pre-snapshot log is
+            # now incompatible with the installed state.
+            if self._snap_store is not None:
+                self._snap_store.save(
+                    index,
+                    msgpack.packb((args["last_included_term"],
+                                   bytes(args["data"])),
+                                  use_bin_type=True))
+            if self._log_store is not None:
+                self._log_store.truncate()
+            self._snap_blob = bytes(args["data"])
+            self._snap_index = index
+            self._snap_term = args["last_included_term"]
             return {"term": self._term}

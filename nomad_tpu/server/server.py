@@ -65,6 +65,7 @@ class ServerConfig:
         self.server_name: str = ""
         self.raft_election_timeout: tuple = (0.15, 0.30)
         self.raft_heartbeat_interval: float = 0.05
+        self.raft_snapshot_threshold: int = 8192
         self.bootstrap_expect: int = 1
         for k, v in kw.items():
             if not hasattr(self, k):
@@ -107,16 +108,21 @@ class Server:
                 peers=self.config.raft_peers,
                 election_timeout=self.config.raft_election_timeout,
                 heartbeat_interval=self.config.raft_heartbeat_interval,
+                snapshot_threshold=self.config.raft_snapshot_threshold,
                 data_dir=self.config.data_dir)
             self.raft.notify_leadership(self._on_leadership_change)
         else:
             log_store = snapshots = None
             if self.config.data_dir:
+                # Same layout + snapshot format as NetRaft so a data_dir
+                # written by one raft backend restores under the other.
                 log_store = FileLogStore(
                     f"{self.config.data_dir}/raft/log.bin")
                 snapshots = SnapshotStore(
-                    f"{self.config.data_dir}/snapshots")
-            self.raft = InmemRaft(self.fsm, log_store, snapshots)
+                    f"{self.config.data_dir}/raft/snapshots")
+            self.raft = InmemRaft(
+                self.fsm, log_store, snapshots,
+                snapshot_threshold=self.config.raft_snapshot_threshold)
 
         self.plan_applier = PlanApplier(
             self.plan_queue, self.eval_broker, self.raft,

@@ -1,16 +1,58 @@
 """Loader for the optional C++ extension (_nomad_native).
 
 The extension accelerates the host scheduling plane's hot loops (dynamic
-port assignment — see native/port_alloc.cpp).  Pure-Python fallbacks keep
-everything working when it hasn't been built; ``python native/build.py``
-produces it.
+port assignment — see native/port_alloc.cpp).  The .so is never committed
+(it is platform/ABI-specific): on first import we try to build it from
+source with ``native/build.py``; pure-Python fallbacks keep everything
+working when the toolchain is unavailable.
 """
 from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+
+logger = logging.getLogger("nomad_tpu.utils.native")
+
+
+def _try_build() -> None:
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    script = os.path.join(repo, "native", "build.py")
+    src = os.path.join(repo, "native", "port_alloc.cpp")
+    marker = os.path.join(repo, "native", ".build_failed")
+    if not os.path.exists(script):
+        raise ImportError("no native source tree")
+    # A failed build leaves a marker so every later interpreter start
+    # doesn't re-pay the compile attempt; editing the source retries.
+    if os.path.exists(marker) and \
+            os.path.getmtime(marker) >= os.path.getmtime(src):
+        raise ImportError("previous native build failed")
+    try:
+        subprocess.run([sys.executable, script], check=True,
+                       capture_output=True, timeout=120)
+    except Exception as e:
+        logger.warning("native extension build failed, using pure-Python "
+                       "fallback: %s", e)
+        try:
+            with open(marker, "w") as fh:
+                fh.write(str(e))
+        except OSError:
+            pass
+        raise
+
 
 try:
     import _nomad_native as native  # type: ignore
 
     HAS_NATIVE = True
-except ImportError:  # pragma: no cover - exercised on unbuilt checkouts
-    native = None
-    HAS_NATIVE = False
+except ImportError:
+    try:  # pragma: no cover - exercised on unbuilt checkouts
+        _try_build()
+        import _nomad_native as native  # type: ignore
+
+        HAS_NATIVE = True
+    except Exception:
+        native = None
+        HAS_NATIVE = False

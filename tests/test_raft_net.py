@@ -169,3 +169,111 @@ def test_net_raft_durability(tmp_path):
                    msg="log replay apply")
     finally:
         s2.shutdown()
+
+
+def test_net_raft_compaction_survives_restart(tmp_path):
+    """Log compaction persists the snapshot to disk: a full restart after
+    the durable log was truncated must restore the FSM from the snapshot
+    file, not silently come up empty (reference FileSnapshotStore role)."""
+    cfg = dict(FAST)
+    cfg["data_dir"] = str(tmp_path)
+    cfg["raft_snapshot_threshold"] = 8
+    s = Server(ServerConfig(**cfg))
+    nodes = [mock.node(i) for i in range(12)]
+    try:
+        wait_until(lambda: s.raft.is_leader(), msg="election")
+        for n in nodes:
+            s.node_register(n)
+        # Enough applies to cross the threshold and truncate the log.
+        wait_until(lambda: s.raft._log_base_index > 0, msg="compaction")
+    finally:
+        s.shutdown()
+
+    s2 = Server(ServerConfig(**cfg))
+    try:
+        # State is restored from the persisted snapshot immediately (the
+        # truncated log alone can no longer rebuild it).
+        assert s2.raft._last_applied >= 8
+        wait_until(lambda: s2.raft.is_leader(), msg="re-election")
+        wait_until(
+            lambda: all(s2.fsm.state.node_by_id(n.id) is not None
+                        for n in nodes),
+            msg="full state after snapshot restore + log tail replay")
+    finally:
+        s2.shutdown()
+
+
+class _StubRPC:
+    address = ("127.0.0.1", 0)
+
+    def register(self, name, fn):
+        pass
+
+
+class _RecordingFSM:
+    def __init__(self):
+        self.applied = []
+
+    def apply(self, index, data):
+        self.applied.append((index, bytes(data)))
+
+    def snapshot(self):
+        return b"snap"
+
+    def restore(self, blob):
+        pass
+
+
+def test_net_raft_replay_is_last_writer_wins(tmp_path):
+    """A record re-appended at an existing index marks a follower conflict
+    truncation; boot replay must take the LAST record per index or stale
+    (possibly uncommitted) entries resurrect under committed ones."""
+    from nomad_tpu.server.raft import FileLogStore
+    from nomad_tpu.server.raft_net import NetRaft
+
+    store = FileLogStore(str(tmp_path / "raft" / "log.bin"))
+    store.append(1, {"t": 1, "d": b"a"})
+    store.append(2, {"t": 1, "d": b"stale"})
+    store.append(3, {"t": 1, "d": b"stale2"})
+    # Conflict truncation at index 2: leader of term 2 rewrites the suffix.
+    store.append(2, {"t": 2, "d": b"B"})
+    store.append(3, {"t": 2, "d": b"C"})
+    store.append(4, {"t": 2, "d": b"D"})
+    store.close()
+
+    raft = NetRaft(_RecordingFSM(), _StubRPC(), None,
+                   election_timeout=(30.0, 60.0),
+                   data_dir=str(tmp_path))
+    try:
+        log = [(e["index"], e["term"], bytes(e["data"])) for e in raft._log]
+        assert log == [(1, 1, b"a"), (2, 2, b"B"), (3, 2, b"C"),
+                       (4, 2, b"D")]
+    finally:
+        raft.shutdown()
+
+
+def test_inmem_raft_failed_apply_not_persisted(tmp_path):
+    """A failing fsm.apply must not leave the entry in the durable log
+    (boot replay would crash-loop) nor consume its index."""
+    from nomad_tpu.server.raft import FileLogStore, InmemRaft
+
+    class FSM(_RecordingFSM):
+        def apply(self, index, data):
+            if data == b"boom":
+                raise RuntimeError("bad entry")
+            super().apply(index, data)
+
+    path = str(tmp_path / "log.bin")
+    raft = InmemRaft(FSM(), FileLogStore(path))
+    raft.apply(b"one").wait(1)
+    bad = raft.apply(b"boom")
+    assert bad.error is not None
+    raft.apply(b"two").wait(1)
+    assert raft.applied_index() == 2
+    raft.log_store.close()
+
+    fsm2 = FSM()
+    raft2 = InmemRaft(fsm2, FileLogStore(path))
+    assert [d for _, d in fsm2.applied] == [b"one", b"two"]
+    assert raft2.applied_index() == 2
+    raft2.log_store.close()
