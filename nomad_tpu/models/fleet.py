@@ -85,6 +85,10 @@ class FleetStatics:
     # Keeping these resident avoids re-uploading the fleet every eval —
     # at 10k nodes the feasibility matrix transfer dominates eval latency.
     device_cache: dict = field(default_factory=dict)
+    # node_index -> (frozen used_ports, bw_used, bw_avail, ip, device) or
+    # None: the node-static half of the fast network assigner
+    # (scheduler/jax_binpack.py _node_net_init).
+    net_base: dict = field(default_factory=dict)
 
     def device_capacity_reserved(self):
         hit = self.device_cache.get("capres")

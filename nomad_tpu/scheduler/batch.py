@@ -28,7 +28,7 @@ from nomad_tpu.structs import (
 
 from .generic import VALID_GENERIC_TRIGGERS
 from .interfaces import SetStatusError
-from .jax_binpack import JaxBinPackScheduler
+from .jax_binpack import JaxBinPackScheduler, fetch_results
 from .util import set_status
 
 
@@ -137,8 +137,7 @@ class BatchEvalRunner:
                 capacity_d, reserved_d, base_usage, job_counts, feasible,
                 asks, distinct, counts, penalty, k_cap=k_cap,
                 rounds=rounds)
-            chosen_s = np.asarray(chosen_s)
-            score_s = np.asarray(score_s)
+            chosen_s, score_s = fetch_results(chosen_s, score_s)
             for b, (sched, place, args) in enumerate(pending):
                 chosen, scores = rounds_to_placements(
                     args, chosen_s[b], score_s[b])
@@ -148,8 +147,7 @@ class BatchEvalRunner:
             chosen, scores, _usage = place_sequence_batch(
                 capacity_d, reserved_d, base_usage, job_counts, feasible,
                 asks, distinct, group_idx, valid, penalty)
-            chosen = np.asarray(chosen)
-            scores = np.asarray(scores)
+            chosen, scores = fetch_results(chosen, scores)
             for b, (sched, place, args) in enumerate(pending):
                 sched.finish_deferred(place, args, chosen[b], scores[b])
                 self._finish(sched)
@@ -175,8 +173,8 @@ class BatchEvalRunner:
             capacity_d, reserved_d, args.view.usage, args.view.job_counts,
             args.feasible_d, args.asks, args.distinct, args.group_idx,
             args.valid, args.penalty)
-        sched.finish_deferred(place, args, np.asarray(chosen),
-                              np.asarray(scores))
+        chosen, scores = fetch_results(chosen, scores)
+        sched.finish_deferred(place, args, chosen, scores)
         self._finish(sched)
 
     def _finish(self, sched) -> None:

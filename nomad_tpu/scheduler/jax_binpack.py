@@ -27,6 +27,8 @@ import time
 
 import numpy as np
 
+from random import randrange as _randrange
+
 from nomad_tpu.models.constraints import compile_group_mask, group_mask_key
 from nomad_tpu.models.fleet import NDIMS, _pad_to, build_usage, fleet_cache
 from nomad_tpu.ops.binpack import place_sequence
@@ -36,11 +38,16 @@ from nomad_tpu.structs import (
     ALLOC_DESIRED_STATUS_FAILED,
     ALLOC_DESIRED_STATUS_RUN,
     CONSTRAINT_DISTINCT_HOSTS,
+    AllocMetric,
     Allocation,
     NetworkIndex,
+    NetworkResource,
+    Resources,
     allocs_fit,
     generate_uuid,
 )
+from nomad_tpu.structs.model import MAX_DYNAMIC_PORT, MIN_DYNAMIC_PORT
+from nomad_tpu.structs.network import _cidr_ips
 
 from .generic import GenericScheduler
 from .stack import (
@@ -50,12 +57,44 @@ from .stack import (
 from .util import ready_nodes_in_dcs, task_group_constraints
 
 
+def _net_plan_for(tg):
+    """Per-slot network plan for the bulk finish path:
+    (fast_ok, [(task_name, base_resources, net_ask | None), ...]).
+    fast_ok means every ask is a single network with only dynamic ports —
+    the shape the O(1)-per-placement assigner handles; anything richer
+    routes through the exact NetworkIndex."""
+    plan_tasks = []
+    fast_ok = True
+    for task in tg.tasks:
+        r = task.resources
+        ask = None
+        if r is not None and r.networks:
+            if len(r.networks) != 1 or r.networks[0].reserved_ports:
+                fast_ok = False
+            ask = r.networks[0]
+        plan_tasks.append((task.name, r, ask))
+    return fast_ok, plan_tasks
+
+
+def fetch_results(*arrays) -> list:
+    """Fetch device outputs with overlapped copies: start every
+    device->host transfer asynchronously, then block once.  Two sequential
+    np.asarray calls cost two full round trips on remote-attached TPUs
+    (~100 ms each through the axon tunnel); this costs one."""
+    for a in arrays:
+        try:
+            a.copy_to_host_async()
+        except AttributeError:  # plain numpy already on host
+            pass
+    return [np.asarray(a) for a in arrays]
+
+
 class DeviceArgs:
     """Everything one eval contributes to a (possibly batched) dispatch."""
 
     __slots__ = ("statics", "view", "feasible_d", "feasible_h", "asks",
                  "distinct", "group_idx", "valid", "sizes", "slot_of_tg",
-                 "penalty", "g_pad", "p_pad", "start",
+                 "penalty", "g_pad", "p_pad", "start", "net_plans",
                  # rounds-mode plan (see ops/binpack.py place_rounds):
                  "counts", "slot_placements", "k_cap", "rounds",
                  "rounds_eligible")
@@ -106,14 +145,14 @@ class JaxBinPackScheduler(GenericScheduler):
                 args.view.job_counts, args.feasible_d, args.asks,
                 args.distinct, args.counts, args.penalty,
                 k_cap=args.k_cap, rounds=args.rounds)
-            chosen, scores = rounds_to_placements(
-                args, np.asarray(chosen_s), np.asarray(scores_s))
+            chosen, scores = fetch_results(chosen_s, scores_s)
+            chosen, scores = rounds_to_placements(args, chosen, scores)
         else:
-            chosen, scores, _ = place_sequence(
+            chosen_s, scores_s, _ = place_sequence(
                 capacity_d, reserved_d, args.view.usage,
                 args.view.job_counts, args.feasible_d, args.asks,
                 args.distinct, args.group_idx, args.valid, args.penalty)
-            chosen, scores = np.asarray(chosen), np.asarray(scores)
+            chosen, scores = fetch_results(chosen_s, scores_s)
         self.finish_deferred(place, args, chosen, scores)
 
     def _prepare_device(self, place: list) -> DeviceArgs:
@@ -124,32 +163,48 @@ class JaxBinPackScheduler(GenericScheduler):
 
         # Dedupe task groups by *semantic* key (constraints + drivers + dc +
         # ask): count-expanded groups collapse to one mask row, keeping the
-        # device feasibility matrix tiny and its upload cacheable.
+        # device feasibility matrix tiny and its upload cacheable.  The
+        # derived key/ask/net-plan is cached ON the TaskGroup object —
+        # store-resident objects are immutable by contract (state/store.py)
+        # and every store write copies, so identity is a sound cache key;
+        # re-deriving it per eval dominated prep at 1k groups/job.
         groups: list = []          # slot -> representative TaskGroup
         slot_keys: list = []       # slot -> semantic key
         sizes: list = []           # slot -> total Resources ask
+        net_plans: list = []       # slot -> (fast_ok, plan_tasks)
         dedupe: dict = {}          # semantic key -> slot
         slot_of_tg: dict = {}      # id(tg) -> slot
         asks_rows: list = []
         distinct_rows: list = []
+        job_sem_key = (id(self.job), self.job.modify_index)
         for missing in place:
             tg = missing.task_group
             if id(tg) in slot_of_tg:
                 continue
-            tg_constr = task_group_constraints(tg)
-            ask_vec = tuple(tg_constr.size.as_vector())
-            dist = any(c.hard and c.operand == CONSTRAINT_DISTINCT_HOSTS
-                       for c in self.job.constraints + tg_constr.constraints)
-            key = (group_mask_key(self.job.datacenters, self.job.constraints,
-                                  tg_constr.constraints, tg_constr.drivers),
-                   ask_vec, dist)
+            sem = tg.__dict__.get("_sem_cache")
+            if sem is None or sem[0] != job_sem_key:
+                tg_constr = task_group_constraints(tg)
+                ask_vec = tuple(tg_constr.size.as_vector())
+                dist = any(
+                    c.hard and c.operand == CONSTRAINT_DISTINCT_HOSTS
+                    for c in self.job.constraints + tg_constr.constraints)
+                key = (group_mask_key(self.job.datacenters,
+                                      self.job.constraints,
+                                      tg_constr.constraints,
+                                      tg_constr.drivers),
+                       ask_vec, dist)
+                sem = (job_sem_key, key, ask_vec, dist, tg_constr.size,
+                       _net_plan_for(tg))
+                tg.__dict__["_sem_cache"] = sem
+            _jk, key, ask_vec, dist, size, net_plan = sem
             slot = dedupe.get(key)
             if slot is None:
                 slot = len(groups)
                 dedupe[key] = slot
                 groups.append(tg)
                 slot_keys.append(key)
-                sizes.append(tg_constr.size)
+                sizes.append(size)
+                net_plans.append(net_plan)
                 asks_rows.append(ask_vec)
                 distinct_rows.append(dist)
             slot_of_tg[id(tg)] = slot
@@ -232,23 +287,41 @@ class JaxBinPackScheduler(GenericScheduler):
             feasible_h=feasible_h, asks=asks, distinct=distinct,
             group_idx=group_idx, valid=valid, sizes=sizes,
             slot_of_tg=slot_of_tg, penalty=penalty, g_pad=g_pad,
-            p_pad=p_pad, start=start, counts=counts,
+            p_pad=p_pad, start=start, net_plans=net_plans, counts=counts,
             slot_placements=slot_placements, k_cap=k_cap, rounds=rounds,
             rounds_eligible=eligible)
 
     def finish_deferred(self, place: list, args: DeviceArgs,
                         chosen: np.ndarray, scores: np.ndarray) -> None:
         """Consume device decisions into the plan (exact host re-checks +
-        network assignment + Allocation construction)."""
+        network assignment + Allocation construction).
+
+        This loop runs once per placement and is the host half of the
+        device dispatch, so the common shape (winner accepted, single
+        dynamic-port network ask) is O(1) object construction with no
+        NetworkIndex: per-node port/bandwidth state lives in a plain dict
+        (``_node_net``) shared with the exact path for coherence."""
         statics = args.statics
         sizes = args.sizes
         slot_of_tg = args.slot_of_tg
+        net_plans = args.net_plans
         device_time = time.perf_counter() - args.start
-        # Per-node NetworkIndex cache for this plan: built on first
-        # placement on a node, then updated incrementally with each offer
-        # (rebuilding from proposed allocs per placement dominated host
-        # time at 10k nodes).
+        per_time = device_time / max(1, len(place))
+        # Per-node NetworkIndex cache for this plan (exact path) and the
+        # fast per-node [used_ports, bw_used, bw_avail, ip, device] state.
         self._net_cache: dict = {}
+        self._node_net: dict = {}
+        self._statics = statics
+        self._port_lcg = _randrange(1 << 30)
+
+        chosen_l = chosen.tolist()
+        scores_l = scores.tolist()
+        n_real = statics.n_real
+        nodes_arr = statics.nodes
+        eval_id = self.eval.id
+        job = self.job
+        job_id = job.id
+        plan = self.plan
 
         failed_tg: dict = {}
         fallback_nodes = None
@@ -258,15 +331,16 @@ class JaxBinPackScheduler(GenericScheduler):
         # allocs_fit before being trusted.
         usage_diverged = False
         for p, missing in enumerate(place):
-            prior_fail = failed_tg.get(id(missing.task_group))
+            tg = missing.task_group
+            prior_fail = failed_tg.get(id(tg))
             if prior_fail is not None:
                 prior_fail.metrics.coalesced_failures += 1
                 continue
 
-            g = slot_of_tg[id(missing.task_group)]
+            g = slot_of_tg[id(tg)]
             size = sizes[g]
-            node_index = int(chosen[p])
-            option_node = statics.nodes[node_index] if node_index >= 0 else None
+            node_index = chosen_l[p]
+            option_node = nodes_arr[node_index] if node_index >= 0 else None
             from_device = option_node is not None
 
             task_resources = None
@@ -274,8 +348,12 @@ class JaxBinPackScheduler(GenericScheduler):
                     not self._still_fits(option_node, size):
                 option_node = None
             if option_node is not None:
-                task_resources = self._assign_networks(
-                    option_node, missing.task_group)
+                fast_ok, plan_tasks = net_plans[g]
+                if fast_ok:
+                    task_resources = self._assign_networks_fast(
+                        node_index, option_node, plan_tasks)
+                else:
+                    task_resources = self._assign_networks(option_node, tg)
                 if task_resources is None:
                     option_node = None
             if option_node is None and from_device:
@@ -286,31 +364,31 @@ class JaxBinPackScheduler(GenericScheduler):
                     fallback_nodes = ready_nodes_in_dcs(
                         self.state, self.job.datacenters)
                 self.stack.set_nodes(list(fallback_nodes))
-                ranked, size = self.stack.select(missing.task_group)
+                ranked, size = self.stack.select(tg)
                 if ranked is not None:
                     option_node = ranked.node
                     task_resources = ranked.task_resources
                     # The fallback assigned ports outside our per-node
-                    # index cache: rebuild that node's index on next use.
+                    # state: rebuild both on next use.
                     self._net_cache.pop(option_node.id, None)
+                    self._node_net.pop(
+                        statics.index_of.get(option_node.id), None)
                 # stack.select populated fresh ctx metrics (incl. scores).
                 metrics = self.ctx.metrics()
             else:
-                self.ctx.reset()
-                metrics = self.ctx.metrics()
-                metrics.nodes_evaluated = statics.n_real
-                metrics.allocation_time = device_time / max(1, len(place))
+                metrics = AllocMetric(nodes_evaluated=n_real,
+                                      allocation_time=per_time)
                 if option_node is not None:
-                    metrics.score_node(option_node, "binpack",
-                                       float(scores[p]))
+                    metrics.scores[f"{option_node.id}.binpack"] = \
+                        scores_l[p]
 
             alloc = Allocation(
                 id=generate_uuid(),
-                eval_id=self.eval.id,
+                eval_id=eval_id,
                 name=missing.name,
-                job_id=self.job.id,
-                job=self.job,
-                task_group=missing.task_group.name,
+                job_id=job_id,
+                job=job,
+                task_group=tg.name,
                 resources=size,
                 metrics=metrics,
             )
@@ -319,14 +397,121 @@ class JaxBinPackScheduler(GenericScheduler):
                 alloc.task_resources = task_resources
                 alloc.desired_status = ALLOC_DESIRED_STATUS_RUN
                 alloc.client_status = ALLOC_CLIENT_STATUS_PENDING
-                self.plan.append_alloc(alloc)
+                plan.append_alloc(alloc)
             else:
                 alloc.desired_status = ALLOC_DESIRED_STATUS_FAILED
                 alloc.desired_description = \
                     "failed to find a node for placement"
                 alloc.client_status = ALLOC_CLIENT_STATUS_FAILED
-                self.plan.append_failed(alloc)
-                failed_tg[id(missing.task_group)] = alloc
+                plan.append_failed(alloc)
+                failed_tg[id(tg)] = alloc
+
+    def _node_net_init(self, node_index: int, node):
+        """Fast per-node network state: [used_ports, bw_used, bw_avail,
+        ip, device], or None when the topology needs the exact path
+        (multi-network nodes).  The reserved-only base is node-static and
+        cached on the fleet statics; per-eval state adds proposed allocs'
+        offers on top."""
+        base_cache = self._statics.net_base
+        base = base_cache.get(node_index, False)
+        if base is False:
+            base = None
+            nets = [n for n in node.resources.networks if n.device] \
+                if node.resources is not None else []
+            if len(nets) == 1:
+                n0 = nets[0]
+                ip = n0.ip
+                if not ip:
+                    for ip in _cidr_ips(n0.cidr):
+                        break
+                if ip:
+                    used: set = set()
+                    bw_used = 0
+                    if node.reserved is not None:
+                        for rn in node.reserved.networks:
+                            used.update(rn.reserved_ports)
+                            bw_used += rn.mbits
+                    base = (frozenset(used), bw_used, n0.mbits, ip,
+                            n0.device)
+            base_cache[node_index] = base
+        if base is None:
+            return None
+        used = set(base[0])
+        bw_used = base[1]
+        for alloc in self.ctx.proposed_allocs(node.id):
+            for tr in alloc.task_resources.values():
+                for offer in tr.networks:
+                    used.update(offer.reserved_ports)
+                    bw_used += offer.mbits
+        return [used, bw_used, base[2], base[3], base[4]]
+
+    def _assign_networks_fast(self, node_index: int, node, plan_tasks):
+        """O(1) port/bandwidth assignment for single-network dynamic-port
+        asks.  Returns task name -> Resources, or None to trigger the
+        sequential fallback (exact semantics preserved: bandwidth bound +
+        port uniqueness per node IP, reference nomad/structs/network.go)."""
+        st = self._node_net.get(node_index)
+        if st is None:
+            st = self._node_net_init(node_index, node)
+            if st is None:
+                # Complex topology: exact path.
+                return self._assign_networks(
+                    node, None, plan_tasks=plan_tasks)
+            self._node_net[node_index] = st
+        used, bw_used, bw_avail, ip, device = st
+
+        out = {}
+        span = MAX_DYNAMIC_PORT - MIN_DYNAMIC_PORT
+        staged_bw = 0
+        for name, res, ask in plan_tasks:
+            if ask is None:
+                out[name] = Resources(
+                    cpu=res.cpu, memory_mb=res.memory_mb,
+                    disk_mb=res.disk_mb, iops=res.iops) \
+                    if res is not None else Resources()
+                continue
+            if bw_used + staged_bw + ask.mbits > bw_avail:
+                # Roll back staged ports; exact path would fail too.
+                for tr in out.values():
+                    for offer in tr.networks:
+                        used.difference_update(offer.reserved_ports)
+                return None
+            ports = []
+            lcg = self._port_lcg
+            for _label in ask.dynamic_ports:
+                # LCG instead of random.randrange: one multiply per port
+                # (the plan seed is random, spreading ports like the
+                # reference's random picks; exact value is untested API).
+                lcg = (lcg * 1103515245 + 12345) & 0x3FFFFFFF
+                port = MIN_DYNAMIC_PORT + lcg % span
+                while port in used:
+                    port = MIN_DYNAMIC_PORT + (port - MIN_DYNAMIC_PORT
+                                               + 1) % span
+                used.add(port)
+                ports.append(port)
+            self._port_lcg = lcg
+            offer = NetworkResource(
+                device=device, ip=ip, mbits=ask.mbits,
+                reserved_ports=ports,
+                dynamic_ports=list(ask.dynamic_ports))
+            staged_bw += ask.mbits
+            out[name] = Resources(
+                cpu=res.cpu, memory_mb=res.memory_mb, disk_mb=res.disk_mb,
+                iops=res.iops, networks=[offer])
+            # Keep an exact-path NetworkIndex for this node (if one was
+            # built for a non-fast slot) coherent with our offers.
+            if self._net_cache:
+                idx = self._net_cache.get(node.id)
+                if idx is not None:
+                    idx.add_reserved(offer)
+        st[1] = bw_used + staged_bw
+        return out
+
+    def _node_index_of(self, node) -> int:
+        statics = getattr(self, "_statics", None)
+        if statics is not None:
+            return statics.index_of.get(node.id, -1)
+        return -1
 
     def _still_fits(self, node, size) -> bool:
         """Exact host-side allocs_fit re-check, used after the plan has
@@ -336,7 +521,7 @@ class JaxBinPackScheduler(GenericScheduler):
             node, proposed + [Allocation(resources=size)])
         return fit
 
-    def _assign_networks(self, node, tg):
+    def _assign_networks(self, node, tg, plan_tasks=None):
         """Exact host-side port/bandwidth assignment on the device winner
         (BinPackIterator parity, reference scheduler/rank.go:180-205).
         Returns task name -> Resources, or None if the node can't take it."""
@@ -348,10 +533,14 @@ class JaxBinPackScheduler(GenericScheduler):
             net_idx.add_allocs(self.ctx.proposed_allocs(node.id))
             if cache is not None:
                 cache[node.id] = net_idx
+        if plan_tasks is not None:
+            items = [(name, res) for name, res, _ask in plan_tasks]
+        else:
+            items = [(t.name, t.resources) for t in tg.tasks]
         staged = []
         out = {}
-        for task in tg.tasks:
-            task_resources = task.resources.copy()
+        for task_name, res in items:
+            task_resources = res.copy() if res is not None else Resources()
             if task_resources.networks:
                 ask = task_resources.networks[0]
                 offer, _err = net_idx.assign_network(ask)
@@ -364,7 +553,16 @@ class JaxBinPackScheduler(GenericScheduler):
                 net_idx.add_reserved(offer)
                 staged.append(offer)
                 task_resources.networks = [offer]
-            out[task.name] = task_resources
+            out[task_name] = task_resources
+        # Keep the fast per-node state (if built) coherent with these
+        # exact-path offers.
+        node_net = getattr(self, "_node_net", None)
+        if node_net:
+            st = node_net.get(self._node_index_of(node))
+            if st is not None:
+                for o in staged:
+                    st[0].update(o.reserved_ports)
+                    st[1] += o.mbits
         return out
 
 
@@ -372,19 +570,19 @@ def rounds_to_placements(args: DeviceArgs, chosen_slots: np.ndarray,
                          score_slots: np.ndarray
                          ) -> tuple[np.ndarray, np.ndarray]:
     """Map place_rounds output ([G, rounds*k_cap] per-slot streams) back to
-    per-placement arrays in the original placement order."""
+    per-placement arrays in the original placement order (vectorized:
+    one fancy-index assignment per slot, no per-placement Python)."""
     chosen = np.full(args.p_pad, -1, dtype=np.int32)
     scores = np.zeros(args.p_pad, dtype=np.float32)
     for slot, ps in args.slot_placements.items():
         stream = chosen_slots[slot]
-        vals = score_slots[slot]
         taken = stream >= 0
         nodes = stream[taken]
-        node_scores = vals[taken]
+        node_scores = score_slots[slot][taken]
         n = min(len(ps), len(nodes))
-        for j in range(n):
-            chosen[ps[j]] = nodes[j]
-            scores[ps[j]] = node_scores[j]
+        idx = np.asarray(ps[:n], dtype=np.int64)
+        chosen[idx] = nodes[:n]
+        scores[idx] = node_scores[:n]
     return chosen, scores
 
 

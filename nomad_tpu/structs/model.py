@@ -14,6 +14,7 @@ fleet tensors (see nomad_tpu/models/fleet.py).
 from __future__ import annotations
 
 import time
+import os as _os
 import uuid as _uuid
 from dataclasses import dataclass, field, fields, replace
 from typing import Any, Optional
@@ -76,8 +77,11 @@ CONSTRAINT_DISTINCT_HOSTS = "distinct_hosts"
 
 
 def generate_uuid() -> str:
-    """Random UUID string (reference: nomad/structs/funcs.go:127-139)."""
-    return str(_uuid.uuid4())
+    """Random UUID-format string (reference: nomad/structs/funcs.go:127-139).
+    os.urandom + hex slicing: ~5x cheaper than uuid.uuid4() and the
+    scheduler mints one per placement (hot at 10k placements/eval)."""
+    h = _os.urandom(16).hex()
+    return f"{h[:8]}-{h[8:12]}-{h[12:16]}-{h[16:20]}-{h[20:]}"
 
 
 def msec_now() -> int:
