@@ -144,6 +144,13 @@ class FleetView:
     statics: FleetStatics
     usage: np.ndarray       # f32[n_pad, D] — sum of non-terminal alloc asks
     job_counts: np.ndarray  # i32[n_pad] — proposed allocs of the eval's job
+    # Set when the view came from a UsageMirror with no plan deltas:
+    # node_alloc_count lets the finish path skip per-node alloc scans on
+    # empty nodes; device_ok means `usage` is exactly the mirror state so
+    # the dispatch may use the device-resident copy (no upload).
+    node_alloc_count: Optional[np.ndarray] = None
+    mirror: Optional["UsageMirror"] = None
+    device_ok: bool = False
 
 
 def build_usage(statics: FleetStatics, allocs: list[Allocation],
@@ -170,6 +177,252 @@ def build_usage(statics: FleetStatics, allocs: list[Allocation],
             keep += 1
         np.add.at(usage, idx[:keep], vecs[:keep])
     return FleetView(statics=statics, usage=usage, job_counts=job_counts)
+
+
+class UsageMirror:
+    """Incremental state->HBM bridge for the dynamic half of the fleet.
+
+    Maintains per-node aggregate usage, per-job sparse alloc counts and a
+    device-resident usage copy, updated from the store's alloc changelog
+    (state/store.py ``alloc_log``) with a RefreshIndex-style fence: a sync
+    applies only the deltas between the mirror's allocs index and the
+    snapshot's, so the eval hot path does zero O(fleet)/O(allocs) work
+    when few rows changed (SURVEY.md section 7 "Incremental device
+    state"; replaces the per-eval rebuild the round-1 verdict flagged).
+
+    Concurrency: one mutator at a time (internal lock); readers take the
+    current arrays by reference — sync replaces arrays copy-on-write, so
+    a view handed to an in-flight eval never mutates under it.
+    """
+
+    # Re-upload the full usage tensor after this many incremental device
+    # scatters, bounding float drift between host and device mirrors.
+    DEVICE_REFRESH_EVERY = 2048
+    # Scatter at most this many changed rows per sync; beyond it a fresh
+    # upload is cheaper.
+    MAX_SCATTER_ROWS = 1024
+
+    def __init__(self, statics: FleetStatics) -> None:
+        self.statics = statics
+        self.usage = np.zeros((statics.n_pad, NDIMS), dtype=np.float32)
+        self.node_alloc_count = np.zeros(statics.n_pad, dtype=np.int32)
+        self.job_counts: dict = {}   # job_id -> {node_index: count}
+        self.alloc_rows: dict = {}   # alloc_id -> (ni, vec, job_id)
+        self.table_id: Optional[int] = None
+        self.index = -1
+        self._log_ref: Optional[list] = None
+        self._log_pos = 0
+        self._usage_d = None         # device mirror of self.usage
+        self._device_index = -1
+        self._scatters_since_upload = 0
+        self._lock = threading.Lock()
+
+    # -- sync --------------------------------------------------------------
+    def sync(self, state) -> None:
+        """Bring the mirror to ``state``'s allocs table (store or
+        snapshot).  O(changed allocs) when the changelog covers the gap;
+        full rebuild otherwise."""
+        t = state._t
+        table = t.tables["allocs"]
+        if self.table_id == id(table):
+            return
+        with self._lock:
+            if self.table_id == id(table):
+                return
+            target = t.indexes["allocs"]
+            log = t.alloc_log
+            if self.index < 0 or self.index < t.alloc_log_base or \
+                    self.index > target:
+                self._rebuild(table)
+            else:
+                changed = self._changed_ids(log, target)
+                if changed:
+                    self._apply_deltas(table, changed)
+            self.index = target
+            self.table_id = id(table)
+            self._log_ref = log
+            self._log_pos = self._position_after(log, target)
+
+    def _changed_ids(self, log: list, target: int) -> set:
+        start = self._log_pos if log is self._log_ref else 0
+        changed: set = set()
+        n = len(log)
+        for i in range(start, n):
+            idx, ids = log[i]
+            if idx <= self.index:
+                continue
+            if idx > target:
+                break
+            changed.update(ids)
+        return changed
+
+    @staticmethod
+    def _position_after(log: list, target: int) -> int:
+        n = len(log)
+        pos = n
+        while pos > 0 and log[pos - 1][0] > target:
+            pos -= 1
+        return pos
+
+    def _rebuild(self, table: dict) -> None:
+        statics = self.statics
+        index_of = statics.index_of
+        usage = np.zeros((statics.n_pad, NDIMS), dtype=np.float32)
+        nac = np.zeros(statics.n_pad, dtype=np.int32)
+        job_counts: dict = {}
+        rows: dict = {}
+        for alloc in table.values():
+            if alloc.terminal_status():
+                continue
+            ni = index_of.get(alloc.node_id, -1)
+            if ni < 0:
+                continue
+            vec = _res_vector(alloc.resources)
+            usage[ni] += vec
+            nac[ni] += 1
+            job_counts.setdefault(alloc.job_id, {})[ni] = \
+                job_counts.get(alloc.job_id, {}).get(ni, 0) + 1
+            rows[alloc.id] = (ni, vec, alloc.job_id)
+        self.usage = usage
+        self.node_alloc_count = nac
+        self.job_counts = job_counts
+        self.alloc_rows = rows
+        self._usage_d = None
+
+    def _apply_deltas(self, table: dict, changed: set) -> None:
+        statics = self.statics
+        index_of = statics.index_of
+        # Copy-on-write so views handed to in-flight evals stay frozen.
+        usage = self.usage.copy()
+        nac = self.node_alloc_count.copy()
+        touched_rows: set = set()
+        touched_jobs: dict = {}
+        for aid in changed:
+            old = self.alloc_rows.get(aid)
+            if old is not None:
+                ni, vec, jid = old
+                usage[ni] -= vec
+                nac[ni] -= 1
+                jc = touched_jobs.get(jid)
+                if jc is None:
+                    jc = touched_jobs[jid] = dict(
+                        self.job_counts.get(jid, ()))
+                jc[ni] = jc.get(ni, 0) - 1
+                del self.alloc_rows[aid]
+                touched_rows.add(ni)
+            new = table.get(aid)
+            if new is not None and not new.terminal_status():
+                ni = index_of.get(new.node_id, -1)
+                if ni < 0:
+                    continue
+                vec = _res_vector(new.resources)
+                usage[ni] += vec
+                nac[ni] += 1
+                jid = new.job_id
+                jc = touched_jobs.get(jid)
+                if jc is None:
+                    jc = touched_jobs[jid] = dict(
+                        self.job_counts.get(jid, ()))
+                jc[ni] = jc.get(ni, 0) + 1
+                self.alloc_rows[aid] = (ni, vec, jid)
+                touched_rows.add(ni)
+        for jid, jc in touched_jobs.items():
+            jc = {ni: c for ni, c in jc.items() if c > 0}
+            if jc:
+                self.job_counts[jid] = jc
+            else:
+                self.job_counts.pop(jid, None)
+        self._update_device(usage, touched_rows)
+        self.usage = usage
+        self.node_alloc_count = nac
+
+    # -- device mirror -----------------------------------------------------
+    def _update_device(self, new_usage: np.ndarray,
+                       touched_rows: set) -> None:
+        if self._usage_d is None or self._device_index != self.index:
+            return
+        if len(touched_rows) > self.MAX_SCATTER_ROWS or \
+                self._scatters_since_upload >= self.DEVICE_REFRESH_EVERY:
+            self._usage_d = None
+            return
+        idx = np.fromiter(touched_rows, dtype=np.int32,
+                          count=len(touched_rows))
+        self._usage_d = _scatter_rows(self._usage_d, idx, new_usage[idx])
+        self._scatters_since_upload += 1
+
+    def device_usage(self):
+        """Device-resident usage at the mirror's fence index (uploaded on
+        first use, then scatter-maintained)."""
+        import jax
+        with self._lock:
+            if self._usage_d is None or self._device_index != self.index:
+                self._usage_d = jax.device_put(self.usage)
+                self._scatters_since_upload = 0
+            self._device_index = self.index
+            return self._usage_d
+
+    # -- views -------------------------------------------------------------
+    def view(self, plan, job_id: str) -> FleetView:
+        """A FleetView for one eval: mirror base plus the eval's in-flight
+        plan deltas (EvalContext.ProposedAllocs semantics, reference
+        scheduler/context.go:96-126, fleet-wide)."""
+        statics = self.statics
+        jc_dense = np.zeros(statics.n_pad, dtype=np.int32)
+        sparse = self.job_counts.get(job_id)
+        if sparse:
+            for ni, c in sparse.items():
+                jc_dense[ni] = c
+        usage = self.usage
+        nac = self.node_alloc_count
+        deltas = plan is not None and \
+            (plan.node_update or plan.node_allocation)
+        if deltas:
+            usage = usage.copy()
+            nac = nac.copy()
+            index_of = statics.index_of
+            for updates in plan.node_update.values():
+                for alloc in updates:
+                    row = self.alloc_rows.get(alloc.id)
+                    if row is None:
+                        continue
+                    ni, vec, jid = row
+                    usage[ni] -= vec
+                    nac[ni] -= 1
+                    if jid == job_id:
+                        jc_dense[ni] -= 1
+            for placements in plan.node_allocation.values():
+                for alloc in placements:
+                    ni = index_of.get(alloc.node_id, -1)
+                    if ni < 0:
+                        continue
+                    usage[ni] += _res_vector(alloc.resources)
+                    nac[ni] += 1
+                    if alloc.job_id == job_id:
+                        jc_dense[ni] += 1
+        return FleetView(statics=statics, usage=usage,
+                         job_counts=jc_dense, node_alloc_count=nac,
+                         mirror=self, device_ok=not deltas)
+
+
+def _scatter_rows(usage_d, idx: np.ndarray, rows: np.ndarray):
+    """Asynchronous device scatter: overwrite the touched rows."""
+    return _scatter_rows_jit(usage_d, idx, rows)
+
+
+def _scatter_jit_impl(usage, idx, rows):
+    return usage.at[idx].set(rows)
+
+
+_scatter_rows_jit = None
+
+
+def _ensure_scatter_jit():
+    global _scatter_rows_jit
+    if _scatter_rows_jit is None:
+        import jax
+        _scatter_rows_jit = jax.jit(_scatter_jit_impl,
+                                    donate_argnums=(0,))
+    return _scatter_rows_jit
 
 
 class FleetCache:

@@ -80,7 +80,8 @@ class _Tables:
     """One immutable-once-shared generation of all table + index dicts."""
 
     __slots__ = ("tables", "indexes", "allocs_by_node", "allocs_by_job",
-                 "allocs_by_eval", "evals_by_job")
+                 "allocs_by_eval", "evals_by_job", "alloc_log",
+                 "alloc_log_base")
 
     def __init__(self) -> None:
         self.tables = {name: {} for name in TABLES}
@@ -89,6 +90,15 @@ class _Tables:
         self.allocs_by_job: dict = {}
         self.allocs_by_eval: dict = {}
         self.evals_by_job: dict = {}
+        # Alloc changelog: append-only [(index, (alloc_id, ...))], index
+        # ascending — the feed for the incremental state->HBM usage
+        # mirror (nomad_tpu/models/fleet.py UsageMirror).  Entries with
+        # index <= alloc_log_base have been compacted away; a mirror
+        # older than that must rebuild.  The list object is intentionally
+        # shared across generations (readers filter by their snapshot's
+        # allocs index; appends only ever add higher indexes).
+        self.alloc_log: list = []
+        self.alloc_log_base: int = 0
 
     def clone(self) -> "_Tables":
         new = _Tables.__new__(_Tables)
@@ -98,6 +108,8 @@ class _Tables:
         new.allocs_by_job = self.allocs_by_job
         new.allocs_by_eval = self.allocs_by_eval
         new.evals_by_job = self.evals_by_job
+        new.alloc_log = self.alloc_log
+        new.alloc_log_base = self.alloc_log_base
         return new
 
 
@@ -233,6 +245,19 @@ class StateStore(_ReadMixin):
     def _bump(self, table: str, index: int) -> None:
         self._t.indexes[table] = index
 
+    _ALLOC_LOG_MAX = 16384
+
+    def _log_alloc_change(self, index: int, alloc_ids) -> None:
+        """Record changed alloc ids for incremental mirror sync.  Called
+        under the store lock AFTER _writable_table (generation private)."""
+        log = self._t.alloc_log
+        log.append((index, tuple(alloc_ids)))
+        if len(log) > self._ALLOC_LOG_MAX:
+            keep = self._ALLOC_LOG_MAX // 2
+            # New list: older generations keep the one they saw.
+            self._t.alloc_log_base = log[-keep - 1][0]
+            self._t.alloc_log = log[-keep:]
+
     # -- nodes ------------------------------------------------------------
     def upsert_node(self, index: int, node: Node) -> None:
         with self._lock:
@@ -344,6 +369,7 @@ class StateStore(_ReadMixin):
             a_node = self._writable_index("allocs_by_node")
             a_job = self._writable_index("allocs_by_job")
             a_eval = self._writable_index("allocs_by_eval")
+            removed = []
             for aid in alloc_ids:
                 alloc = allocs.pop(aid, None)
                 if alloc is not None:
@@ -351,8 +377,11 @@ class StateStore(_ReadMixin):
                     self._index_remove(a_job, alloc.job_id, aid)
                     self._index_remove(a_eval, alloc.eval_id, aid)
                     touched_nodes.append(alloc.node_id)
+                    removed.append(aid)
             self._bump("evals", index)
             self._bump("allocs", index)
+            if removed:
+                self._log_alloc_change(index, removed)
         keys = [("evals",), ("allocs",)]
         keys += [("alloc-node", n) for n in set(touched_nodes)]
         self.watch.notify(*keys)
@@ -386,6 +415,8 @@ class StateStore(_ReadMixin):
                     self._index_add(a_eval, new.eval_id, new.id)
                 touched_nodes.append(new.node_id)
             self._bump("allocs", index)
+            if allocs:
+                self._log_alloc_change(index, [a.id for a in allocs])
         keys = [("allocs",)] + [("alloc-node", n) for n in set(touched_nodes)]
         self.watch.notify(*keys)
 
@@ -405,6 +436,7 @@ class StateStore(_ReadMixin):
             new.modify_index = index
             table[new.id] = new
             self._bump("allocs", index)
+            self._log_alloc_change(index, (alloc.id,))
         self.watch.notify(("allocs",), ("alloc-node", alloc.node_id))
 
 
@@ -449,6 +481,9 @@ class StateRestore:
         self._t.indexes[table] = index
 
     def commit(self) -> None:
+        # A restored generation has no changelog history: force mirrors
+        # older than the restored index to rebuild.
+        self._t.alloc_log_base = self._t.indexes["allocs"]
         with self._store._lock:
             self._store._t = self._t
             self._store._gen_shared = False
