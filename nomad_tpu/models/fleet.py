@@ -29,6 +29,8 @@ exact per-device/port assignment runs host-side after selection
 """
 from __future__ import annotations
 
+import threading
+
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -89,6 +91,8 @@ class FleetStatics:
     # None: the node-static half of the fast network assigner
     # (scheduler/jax_binpack.py _node_net_init).
     net_base: dict = field(default_factory=dict)
+    # Lazily attached incremental usage mirror (see mirror_for()).
+    mirror: Optional["UsageMirror"] = None
 
     def device_capacity_reserved(self):
         hit = self.device_cache.get("capres")
@@ -145,12 +149,16 @@ class FleetView:
     usage: np.ndarray       # f32[n_pad, D] — sum of non-terminal alloc asks
     job_counts: np.ndarray  # i32[n_pad] — proposed allocs of the eval's job
     # Set when the view came from a UsageMirror with no plan deltas:
-    # node_alloc_count lets the finish path skip per-node alloc scans on
-    # empty nodes; device_ok means `usage` is exactly the mirror state so
-    # the dispatch may use the device-resident copy (no upload).
-    node_alloc_count: Optional[np.ndarray] = None
-    mirror: Optional["UsageMirror"] = None
-    device_ok: bool = False
+    # usage_device is the mirror's device-resident copy of exactly `usage`,
+    # so the dispatch can skip the host->device upload entirely.
+    usage_device: Optional[object] = None
+
+    def dispatch_usage(self):
+        """The usage argument for a device dispatch: the resident device
+        copy when the mirror has one, else the host array (uploaded by
+        jit)."""
+        return self.usage_device if self.usage_device is not None \
+            else self.usage
 
 
 def build_usage(statics: FleetStatics, allocs: list[Allocation],
@@ -186,13 +194,19 @@ class UsageMirror:
     device-resident usage copy, updated from the store's alloc changelog
     (state/store.py ``alloc_log``) with a RefreshIndex-style fence: a sync
     applies only the deltas between the mirror's allocs index and the
-    snapshot's, so the eval hot path does zero O(fleet)/O(allocs) work
-    when few rows changed (SURVEY.md section 7 "Incremental device
-    state"; replaces the per-eval rebuild the round-1 verdict flagged).
+    snapshot's, so the eval hot path does O(changed) host work instead of
+    rebuilding usage from every alloc in the store (SURVEY.md section 7
+    "Incremental device state"; reference analogue: the alloc-watch feed
+    of nomad/state/state_store.go:115-156).
 
     Concurrency: one mutator at a time (internal lock); readers take the
     current arrays by reference — sync replaces arrays copy-on-write, so
-    a view handed to an in-flight eval never mutates under it.
+    a view handed to an in-flight eval never mutates under it.  The
+    device copy is likewise never donated: a scatter allocates a new
+    device buffer, so device arrays held by in-flight dispatches stay
+    valid.  The mirror only moves forward: ``sync`` against a snapshot
+    older than the mirror returns False and the caller falls back to a
+    from-scratch ``build_usage`` for that eval.
     """
 
     # Re-upload the full usage tensor after this many incremental device
@@ -208,40 +222,61 @@ class UsageMirror:
         self.node_alloc_count = np.zeros(statics.n_pad, dtype=np.int32)
         self.job_counts: dict = {}   # job_id -> {node_index: count}
         self.alloc_rows: dict = {}   # alloc_id -> (ni, vec, job_id)
-        self.table_id: Optional[int] = None
         self.index = -1
+        self.rebuilds = 0            # full O(allocs) rebuilds (observability)
+        self._lineage: object = None
         self._log_ref: Optional[list] = None
         self._log_pos = 0
-        self._usage_d = None         # device mirror of self.usage
-        self._device_index = -1
+        # Invariant: _usage_d is None or exactly equals self.usage.
+        self._usage_d = None
         self._scatters_since_upload = 0
         self._lock = threading.Lock()
 
     # -- sync --------------------------------------------------------------
-    def sync(self, state) -> None:
+    def _current(self, t) -> bool:
+        """True when the mirror already matches this generation.  The
+        fence is the monotonic allocs raft index plus the store lineage
+        token — NOT table-dict identity, because the store mutates tables
+        in place when no snapshot shares them.  The lineage token changes
+        on snapshot restore (which can replace the world without raising
+        the index); it survives clones and changelog compaction."""
+        return (self.index == t.indexes["allocs"]
+                and self._lineage is t.lineage)
+
+    def _sync_locked(self, t) -> bool:
+        if self._current(t):
+            return True
+        target = t.indexes["allocs"]
+        if self._lineage is t.lineage and self.index > target:
+            return False
+        table = t.tables["allocs"]
+        log = t.alloc_log
+        # A new log list under the SAME lineage can only be compaction
+        # (the kept tail retains every entry above alloc_log_base), so
+        # scanning it from position 0 is sound.
+        if self.index < 0 or self.index < t.alloc_log_base or \
+                self._lineage is not t.lineage:
+            self._rebuild(table)
+        else:
+            changed = self._changed_ids(log, target)
+            if changed:
+                self._apply_deltas(table, changed)
+        self.index = target
+        self._lineage = t.lineage
+        self._log_ref = log
+        self._log_pos = self._position_after(log, target)
+        return True
+
+    def sync(self, state) -> bool:
         """Bring the mirror to ``state``'s allocs table (store or
         snapshot).  O(changed allocs) when the changelog covers the gap;
-        full rebuild otherwise."""
+        full rebuild otherwise.  Returns False (mirror untouched) when the
+        snapshot is older than the mirror — the mirror is monotonic."""
         t = state._t
-        table = t.tables["allocs"]
-        if self.table_id == id(table):
-            return
+        if self._current(t):
+            return True
         with self._lock:
-            if self.table_id == id(table):
-                return
-            target = t.indexes["allocs"]
-            log = t.alloc_log
-            if self.index < 0 or self.index < t.alloc_log_base or \
-                    self.index > target:
-                self._rebuild(table)
-            else:
-                changed = self._changed_ids(log, target)
-                if changed:
-                    self._apply_deltas(table, changed)
-            self.index = target
-            self.table_id = id(table)
-            self._log_ref = log
-            self._log_pos = self._position_after(log, target)
+            return self._sync_locked(t)
 
     def _changed_ids(self, log: list, target: int) -> set:
         start = self._log_pos if log is self._log_ref else 0
@@ -287,6 +322,7 @@ class UsageMirror:
         self.node_alloc_count = nac
         self.job_counts = job_counts
         self.alloc_rows = rows
+        self.rebuilds += 1
         self._usage_d = None
 
     def _apply_deltas(self, table: dict, changed: set) -> None:
@@ -339,7 +375,10 @@ class UsageMirror:
     # -- device mirror -----------------------------------------------------
     def _update_device(self, new_usage: np.ndarray,
                        touched_rows: set) -> None:
-        if self._usage_d is None or self._device_index != self.index:
+        """Keep the device copy equal to the (about-to-be-installed) host
+        usage: scatter the touched rows, or drop the copy when a fresh
+        upload is cheaper.  Called under the lock from _apply_deltas."""
+        if self._usage_d is None:
             return
         if len(touched_rows) > self.MAX_SCATTER_ROWS or \
                 self._scatters_since_upload >= self.DEVICE_REFRESH_EVERY:
@@ -350,22 +389,21 @@ class UsageMirror:
         self._usage_d = _scatter_rows(self._usage_d, idx, new_usage[idx])
         self._scatters_since_upload += 1
 
+    def _device_usage_locked(self):
+        if self._usage_d is None:
+            import jax
+            self._usage_d = jax.device_put(self.usage)
+            self._scatters_since_upload = 0
+        return self._usage_d
+
     def device_usage(self):
-        """Device-resident usage at the mirror's fence index (uploaded on
-        first use, then scatter-maintained)."""
-        import jax
+        """Device-resident copy of the mirror's usage (uploaded on first
+        use, then scatter-maintained alongside every host delta)."""
         with self._lock:
-            if self._usage_d is None or self._device_index != self.index:
-                self._usage_d = jax.device_put(self.usage)
-                self._scatters_since_upload = 0
-            self._device_index = self.index
-            return self._usage_d
+            return self._device_usage_locked()
 
     # -- views -------------------------------------------------------------
-    def view(self, plan, job_id: str) -> FleetView:
-        """A FleetView for one eval: mirror base plus the eval's in-flight
-        plan deltas (EvalContext.ProposedAllocs semantics, reference
-        scheduler/context.go:96-126, fleet-wide)."""
+    def _view_locked(self, plan, job_id: str) -> FleetView:
         statics = self.statics
         jc_dense = np.zeros(statics.n_pad, dtype=np.int32)
         sparse = self.job_counts.get(job_id)
@@ -373,40 +411,73 @@ class UsageMirror:
             for ni, c in sparse.items():
                 jc_dense[ni] = c
         usage = self.usage
-        nac = self.node_alloc_count
         deltas = plan is not None and \
             (plan.node_update or plan.node_allocation)
-        if deltas:
-            usage = usage.copy()
-            nac = nac.copy()
-            index_of = statics.index_of
-            for updates in plan.node_update.values():
-                for alloc in updates:
-                    row = self.alloc_rows.get(alloc.id)
-                    if row is None:
-                        continue
-                    ni, vec, jid = row
-                    usage[ni] -= vec
-                    nac[ni] -= 1
-                    if jid == job_id:
-                        jc_dense[ni] -= 1
-            for placements in plan.node_allocation.values():
-                for alloc in placements:
-                    ni = index_of.get(alloc.node_id, -1)
-                    if ni < 0:
-                        continue
-                    usage[ni] += _res_vector(alloc.resources)
-                    nac[ni] += 1
-                    if alloc.job_id == job_id:
-                        jc_dense[ni] += 1
+        if not deltas:
+            return FleetView(statics=statics, usage=usage,
+                             job_counts=jc_dense,
+                             usage_device=self._device_usage_locked())
+        usage = usage.copy()
+        index_of = statics.index_of
+        for updates in plan.node_update.values():
+            for alloc in updates:
+                row = self.alloc_rows.get(alloc.id)
+                if row is None:
+                    continue
+                ni, vec, jid = row
+                usage[ni] -= vec
+                if jid == job_id:
+                    jc_dense[ni] -= 1
+        for placements in plan.node_allocation.values():
+            for alloc in placements:
+                ni = index_of.get(alloc.node_id, -1)
+                if ni < 0:
+                    continue
+                usage[ni] += _res_vector(alloc.resources)
+                if alloc.job_id == job_id:
+                    jc_dense[ni] += 1
         return FleetView(statics=statics, usage=usage,
-                         job_counts=jc_dense, node_alloc_count=nac,
-                         mirror=self, device_ok=not deltas)
+                         job_counts=jc_dense)
+
+    def view(self, plan, job_id: str) -> FleetView:
+        """A FleetView for one eval: mirror base plus the eval's in-flight
+        plan deltas (EvalContext.ProposedAllocs semantics, reference
+        scheduler/context.go:96-126, fleet-wide)."""
+        with self._lock:
+            return self._view_locked(plan, job_id)
+
+    def view_at(self, state, plan, job_id: str) -> Optional[FleetView]:
+        """Atomically sync to ``state`` and build a view under one lock
+        hold, so a concurrent worker cannot advance the mirror between
+        the sync and the view (the view must reflect exactly this eval's
+        snapshot).  Returns None when the snapshot is older than the
+        mirror — the caller falls back to a from-scratch build."""
+        t = state._t
+        with self._lock:
+            if not self._sync_locked(t):
+                return None
+            return self._view_locked(plan, job_id)
+
+
+_mirror_create_lock = threading.Lock()
+
+
+def mirror_for(statics: FleetStatics) -> UsageMirror:
+    """The one UsageMirror attached to a fleet generation (created on
+    first use; a new fleet generation starts a fresh mirror)."""
+    mirror = statics.mirror
+    if mirror is None:
+        with _mirror_create_lock:
+            mirror = statics.mirror
+            if mirror is None:
+                mirror = statics.mirror = UsageMirror(statics)
+    return mirror
 
 
 def _scatter_rows(usage_d, idx: np.ndarray, rows: np.ndarray):
-    """Asynchronous device scatter: overwrite the touched rows."""
-    return _scatter_rows_jit(usage_d, idx, rows)
+    """Asynchronous device scatter: overwrite the touched rows.  NOT
+    donating: in-flight dispatches may still hold the previous buffer."""
+    return _ensure_scatter_jit()(usage_d, idx, rows)
 
 
 def _scatter_jit_impl(usage, idx, rows):
@@ -420,8 +491,7 @@ def _ensure_scatter_jit():
     global _scatter_rows_jit
     if _scatter_rows_jit is None:
         import jax
-        _scatter_rows_jit = jax.jit(_scatter_jit_impl,
-                                    donate_argnums=(0,))
+        _scatter_rows_jit = jax.jit(_scatter_jit_impl)
     return _scatter_rows_jit
 
 

@@ -121,7 +121,10 @@ class BatchEvalRunner:
             counts[b, :a.g_pad] = a.counts
 
         capacity_d, reserved_d = statics.device_capacity_reserved()
-        base_usage = pending[0][2].view.usage
+        # All fused lanes share the same snapshot base usage (fast-path
+        # contract above); use the mirror's device-resident copy when the
+        # first lane's view carries one (no upload).
+        base_usage = pending[0][2].view.dispatch_usage()
         penalty = np.asarray([a.penalty for _, _, a in pending],
                              dtype=np.float32)
 
@@ -170,9 +173,9 @@ class BatchEvalRunner:
 
         capacity_d, reserved_d = args.statics.device_capacity_reserved()
         chosen, scores, _ = place_sequence(
-            capacity_d, reserved_d, args.view.usage, args.view.job_counts,
-            args.feasible_d, args.asks, args.distinct, args.group_idx,
-            args.valid, args.penalty)
+            capacity_d, reserved_d, args.view.dispatch_usage(),
+            args.view.job_counts, args.feasible_d, args.asks,
+            args.distinct, args.group_idx, args.valid, args.penalty)
         chosen, scores = fetch_results(chosen, scores)
         sched.finish_deferred(place, args, chosen, scores)
         self._finish(sched)

@@ -30,7 +30,13 @@ import numpy as np
 from random import randrange as _randrange
 
 from nomad_tpu.models.constraints import compile_group_mask, group_mask_key
-from nomad_tpu.models.fleet import NDIMS, _pad_to, build_usage, fleet_cache
+from nomad_tpu.models.fleet import (
+    NDIMS,
+    _pad_to,
+    build_usage,
+    fleet_cache,
+    mirror_for,
+)
 from nomad_tpu.ops.binpack import place_sequence
 from nomad_tpu.structs import (
     ALLOC_CLIENT_STATUS_FAILED,
@@ -141,7 +147,7 @@ class JaxBinPackScheduler(GenericScheduler):
             from nomad_tpu.ops.binpack import place_rounds
 
             chosen_s, scores_s, _ = place_rounds(
-                capacity_d, reserved_d, args.view.usage,
+                capacity_d, reserved_d, args.view.dispatch_usage(),
                 args.view.job_counts, args.feasible_d, args.asks,
                 args.distinct, args.counts, args.penalty,
                 k_cap=args.k_cap, rounds=args.rounds)
@@ -149,7 +155,7 @@ class JaxBinPackScheduler(GenericScheduler):
             chosen, scores = rounds_to_placements(args, chosen, scores)
         else:
             chosen_s, scores_s, _ = place_sequence(
-                capacity_d, reserved_d, args.view.usage,
+                capacity_d, reserved_d, args.view.dispatch_usage(),
                 args.view.job_counts, args.feasible_d, args.asks,
                 args.distinct, args.group_idx, args.valid, args.penalty)
             chosen, scores = fetch_results(chosen_s, scores_s)
@@ -158,8 +164,16 @@ class JaxBinPackScheduler(GenericScheduler):
     def _prepare_device(self, place: list) -> DeviceArgs:
         start = time.perf_counter()
         statics = fleet_cache.statics_for(self.state)
-        view = build_usage(statics, self._proposed_allocs_all(),
-                           job_id=self.job.id)
+        # Incremental usage: atomically sync the fleet's mirror to this
+        # eval's snapshot (O(changed allocs) via the store changelog) and
+        # take a view with this plan's in-flight deltas applied.  Falls
+        # back to the from-scratch O(allocs) build only when the snapshot
+        # is older than the mirror (another worker synced past us).
+        view = mirror_for(statics).view_at(self.state, self.plan,
+                                           self.job.id)
+        if view is None:
+            view = build_usage(statics, self._proposed_allocs_all(),
+                               job_id=self.job.id)
 
         # Dedupe task groups by *semantic* key (constraints + drivers + dc +
         # ask): count-expanded groups collapse to one mask row, keeping the
@@ -463,6 +477,7 @@ class JaxBinPackScheduler(GenericScheduler):
         out = {}
         span = MAX_DYNAMIC_PORT - MIN_DYNAMIC_PORT
         staged_bw = 0
+        mirrored = []   # offers mirrored into the cached exact-path index
         for name, res, ask in plan_tasks:
             if ask is None:
                 out[name] = Resources(
@@ -471,10 +486,15 @@ class JaxBinPackScheduler(GenericScheduler):
                     if res is not None else Resources()
                 continue
             if bw_used + staged_bw + ask.mbits > bw_avail:
-                # Roll back staged ports; exact path would fail too.
+                # Roll back staged ports — and the offers already mirrored
+                # into the cached exact-path NetworkIndex, which would
+                # otherwise carry phantom reservations into later
+                # exact-path assignments on this node.
                 for tr in out.values():
                     for offer in tr.networks:
                         used.difference_update(offer.reserved_ports)
+                for offer in mirrored:
+                    self._net_cache[node.id].remove_reserved(offer)
                 return None
             ports = []
             lcg = self._port_lcg
@@ -504,6 +524,7 @@ class JaxBinPackScheduler(GenericScheduler):
                 idx = self._net_cache.get(node.id)
                 if idx is not None:
                     idx.add_reserved(offer)
+                    mirrored.append(offer)
         st[1] = bw_used + staged_bw
         return out
 

@@ -81,7 +81,7 @@ class _Tables:
 
     __slots__ = ("tables", "indexes", "allocs_by_node", "allocs_by_job",
                  "allocs_by_eval", "evals_by_job", "alloc_log",
-                 "alloc_log_base")
+                 "alloc_log_base", "lineage")
 
     def __init__(self) -> None:
         self.tables = {name: {} for name in TABLES}
@@ -99,6 +99,11 @@ class _Tables:
         # allocs index; appends only ever add higher indexes).
         self.alloc_log: list = []
         self.alloc_log_base: int = 0
+        # Lineage token: identity preserved across clones and changelog
+        # compaction, REPLACED by snapshot restore — a mirror synced under
+        # a different lineage must rebuild even if the raft index matches
+        # (the world was swapped wholesale).
+        self.lineage: object = object()
 
     def clone(self) -> "_Tables":
         new = _Tables.__new__(_Tables)
@@ -110,6 +115,7 @@ class _Tables:
         new.evals_by_job = self.evals_by_job
         new.alloc_log = self.alloc_log
         new.alloc_log_base = self.alloc_log_base
+        new.lineage = self.lineage
         return new
 
 
@@ -481,9 +487,9 @@ class StateRestore:
         self._t.indexes[table] = index
 
     def commit(self) -> None:
-        # A restored generation has no changelog history: force mirrors
-        # older than the restored index to rebuild.
-        self._t.alloc_log_base = self._t.indexes["allocs"]
+        # A restored generation carries a fresh lineage token (set in
+        # _Tables.__init__), forcing every existing mirror to rebuild once
+        # — even one whose raft-index fence matches the restored index.
         with self._store._lock:
             self._store._t = self._t
             self._store._gen_shared = False

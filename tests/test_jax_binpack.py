@@ -391,3 +391,45 @@ def test_fallback_divergence_never_oversubscribes(monkeypatch):
     placed = sum(len(v) for v in plan.node_allocation.values())
     assert placed + len(plan.failed_allocs) >= 8 - 7  # coalescing allowed
     assert placed >= 4
+
+
+def test_fast_network_rollback_keeps_cached_index_coherent():
+    """A bandwidth failure in the fast network assigner must undo the
+    offers it already mirrored into the cached exact-path NetworkIndex —
+    otherwise later exact-path assignments on the node see phantom
+    port/bandwidth reservations (advisor regression)."""
+    from nomad_tpu.models.fleet import build_fleet
+    from nomad_tpu.scheduler.jax_binpack import JaxBinPackScheduler
+    from nomad_tpu.structs import NetworkIndex, NetworkResource, Resources
+
+    node = mock.node(0)  # eth0, 1000 mbits, 1 reserved
+    sched = JaxBinPackScheduler.__new__(JaxBinPackScheduler)
+    sched._statics = build_fleet([node])
+    sched._node_net = {}
+    sched._port_lcg = 12345
+
+    class _Ctx:
+        def proposed_allocs(self, node_id):
+            return []
+
+    sched.ctx = _Ctx()
+
+    idx = NetworkIndex()
+    idx.set_node(node)
+    sched._net_cache = {node.id: idx}
+    bw_before = dict(idx.used_bandwidth)
+    ports_before = {ip: set(p) for ip, p in idx.used_ports.items()}
+
+    ask_ok = NetworkResource(mbits=500, dynamic_ports=["a"])
+    ask_too_big = NetworkResource(mbits=10_000, dynamic_ports=["b"])
+    plan_tasks = [
+        ("t1", Resources(cpu=100, memory_mb=64, networks=[ask_ok]), ask_ok),
+        ("t2", Resources(cpu=100, memory_mb=64, networks=[ask_too_big]),
+         ask_too_big),
+    ]
+    assert sched._assign_networks_fast(0, node, plan_tasks) is None
+
+    # The cached exact-path index must be exactly as it was.
+    assert idx.used_bandwidth == bw_before
+    assert {ip: set(p) for ip, p in idx.used_ports.items()
+            if p} == {ip: set(p) for ip, p in ports_before.items() if p}
