@@ -219,7 +219,6 @@ class UsageMirror:
     def __init__(self, statics: FleetStatics) -> None:
         self.statics = statics
         self.usage = np.zeros((statics.n_pad, NDIMS), dtype=np.float32)
-        self.node_alloc_count = np.zeros(statics.n_pad, dtype=np.int32)
         self.job_counts: dict = {}   # job_id -> {node_index: count}
         self.alloc_rows: dict = {}   # alloc_id -> (ni, vec, job_id)
         self.index = -1
@@ -303,7 +302,6 @@ class UsageMirror:
         statics = self.statics
         index_of = statics.index_of
         usage = np.zeros((statics.n_pad, NDIMS), dtype=np.float32)
-        nac = np.zeros(statics.n_pad, dtype=np.int32)
         job_counts: dict = {}
         rows: dict = {}
         for alloc in table.values():
@@ -314,12 +312,10 @@ class UsageMirror:
                 continue
             vec = _res_vector(alloc.resources)
             usage[ni] += vec
-            nac[ni] += 1
             job_counts.setdefault(alloc.job_id, {})[ni] = \
                 job_counts.get(alloc.job_id, {}).get(ni, 0) + 1
             rows[alloc.id] = (ni, vec, alloc.job_id)
         self.usage = usage
-        self.node_alloc_count = nac
         self.job_counts = job_counts
         self.alloc_rows = rows
         self.rebuilds += 1
@@ -330,7 +326,6 @@ class UsageMirror:
         index_of = statics.index_of
         # Copy-on-write so views handed to in-flight evals stay frozen.
         usage = self.usage.copy()
-        nac = self.node_alloc_count.copy()
         touched_rows: set = set()
         touched_jobs: dict = {}
         for aid in changed:
@@ -338,7 +333,6 @@ class UsageMirror:
             if old is not None:
                 ni, vec, jid = old
                 usage[ni] -= vec
-                nac[ni] -= 1
                 jc = touched_jobs.get(jid)
                 if jc is None:
                     jc = touched_jobs[jid] = dict(
@@ -353,7 +347,6 @@ class UsageMirror:
                     continue
                 vec = _res_vector(new.resources)
                 usage[ni] += vec
-                nac[ni] += 1
                 jid = new.job_id
                 jc = touched_jobs.get(jid)
                 if jc is None:
@@ -370,7 +363,6 @@ class UsageMirror:
                 self.job_counts.pop(jid, None)
         self._update_device(usage, touched_rows)
         self.usage = usage
-        self.node_alloc_count = nac
 
     # -- device mirror -----------------------------------------------------
     def _update_device(self, new_usage: np.ndarray,

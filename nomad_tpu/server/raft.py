@@ -65,13 +65,28 @@ class FileLogStore:
     def append(self, index: int, entry: bytes) -> None:
         record = msgpack.packb((index, entry), use_bin_type=True)
         with self._lock:
-            self._fh.write(len(record).to_bytes(4, "big"))
-            self._fh.write(record)
-            self._fh.flush()
-            os.fsync(self._fh.fileno())
+            pos = self._fh.tell()
+            try:
+                self._fh.write(len(record).to_bytes(4, "big"))
+                self._fh.write(record)
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+            except Exception:
+                # Roll partial bytes back so the framing stays intact for
+                # subsequent appends; a failed fsync may still have landed
+                # the full record — replay's last-writer-wins handling in
+                # InmemRaft covers the index being re-appended.
+                try:
+                    self._fh.seek(pos)
+                    self._fh.truncate()
+                except OSError:
+                    pass
+                raise
 
     def replay(self):
-        """Yield (index, entry) pairs from disk."""
+        """Yield (index, entry) pairs from disk.  A torn or corrupt tail
+        record (crash mid-append) ends the replay cleanly rather than
+        corrupting the stream."""
         if not os.path.exists(self.path):
             return
         with open(self.path, "rb") as fh:
@@ -79,10 +94,14 @@ class FileLogStore:
                 head = fh.read(4)
                 if len(head) < 4:
                     return
-                record = fh.read(int.from_bytes(head, "big"))
-                if not record:
+                size = int.from_bytes(head, "big")
+                record = fh.read(size)
+                if len(record) < size:
                     return
-                index, entry = msgpack.unpackb(record, raw=False)
+                try:
+                    index, entry = msgpack.unpackb(record, raw=False)
+                except Exception:
+                    return
                 yield index, entry
 
     def truncate(self) -> None:
@@ -91,13 +110,52 @@ class FileLogStore:
             self._fh.close()
             self._fh = open(self.path, "wb")
 
+    def rewrite(self, entries) -> None:
+        """Atomically replace the log with ``entries`` [(index, entry)...]:
+        tmp file + rename, so a crash mid-compaction leaves either the
+        full old log or the full kept tail — never a torn log (same
+        pattern as SnapshotStore.save)."""
+        tmp = self.path + ".tmp"
+        with self._lock:
+            with open(tmp, "wb") as fh:
+                for index, entry in entries:
+                    record = msgpack.packb((index, entry),
+                                           use_bin_type=True)
+                    fh.write(len(record).to_bytes(4, "big"))
+                    fh.write(record)
+                fh.flush()
+                os.fsync(fh.fileno())
+            self._fh.close()
+            os.rename(tmp, self.path)
+            self._fh = open(self.path, "ab")
+
     def close(self) -> None:
         with self._lock:
             self._fh.close()
 
 
+def unwrap_snapshot(wrapped: bytes) -> tuple[int, bytes]:
+    """Decode a snapshot file: (term, fsm_blob).
+
+    Current format is msgpack (term, blob); a blob that doesn't unpack as
+    a 2-tuple is treated as a legacy bare term-0 FSM blob, so data_dirs
+    written before the wrapped format restore instead of crashing."""
+    try:
+        unpacked = msgpack.unpackb(wrapped, raw=False)
+        if isinstance(unpacked, (tuple, list)) and len(unpacked) == 2 \
+                and isinstance(unpacked[0], int):
+            return unpacked[0], bytes(unpacked[1])
+    except Exception:
+        pass
+    return 0, bytes(wrapped)
+
+
 class SnapshotStore:
-    """Retains the N most recent FSM snapshots on disk."""
+    """Retains the N most recent FSM snapshots on disk.
+
+    Lives at ``<data_dir>/raft/snapshots``; ``resolve_snapshot_dir`` falls
+    back to the legacy ``<data_dir>/snapshots`` location when only it has
+    content, so pre-layout-change data_dirs keep restoring."""
 
     def __init__(self, directory: str, retain: int = 2) -> None:
         self.directory = directory
@@ -137,6 +195,24 @@ class SnapshotStore:
             os.unlink(path)
 
 
+def resolve_snapshot_dir(data_dir: str) -> str:
+    """The snapshot directory for a data_dir: ``<data_dir>/raft/snapshots``
+    unless only the legacy ``<data_dir>/snapshots`` holds snapshots."""
+    current = os.path.join(data_dir, "raft", "snapshots")
+    legacy = os.path.join(data_dir, "snapshots")
+
+    def _has_snaps(d: str) -> bool:
+        try:
+            return any(n.startswith("snapshot-") and n.endswith(".bin")
+                       for n in os.listdir(d))
+        except OSError:
+            return False
+
+    if not _has_snaps(current) and _has_snaps(legacy):
+        return legacy
+    return current
+
+
 class InmemRaft:
     """Single-node raft: every apply commits immediately.
 
@@ -162,15 +238,21 @@ class InmemRaft:
             latest = snapshots.latest()
             if latest is not None:
                 index, wrapped = latest
-                _term, blob = msgpack.unpackb(wrapped, raw=False)
-                fsm.restore(bytes(blob))
+                _term, blob = unwrap_snapshot(wrapped)
+                fsm.restore(blob)
                 self._applied = index
         if log_store is not None:
+            # Last-writer-wins on duplicate indexes: a failed append whose
+            # record nonetheless landed is superseded by the caller's
+            # retry under the same index (NetRaft replay parity).
+            tail: dict = {}
             for index, entry in log_store.replay():
                 if index <= self._applied:
                     continue
+                tail[index] = entry
+            for index in sorted(tail):
                 try:
-                    fsm.apply(index, entry)
+                    fsm.apply(index, tail[index])
                 except Exception:
                     # A bad record must not crash-loop server boot; the
                     # write it carried already failed when first applied.
@@ -186,32 +268,32 @@ class InmemRaft:
         future = ApplyFuture()
         with self._lock:
             index = self._applied + 1
-            try:
-                response = self.fsm.apply(index, entry)
-            except Exception as e:  # surface apply errors to the caller
-                future.respond(index, None, e)
-                return future
-            # Persist only after a successful apply: a failing entry must
-            # not survive on disk (boot replay would re-raise) nor consume
-            # the index (the next apply reuses it).  If the DISK write
-            # fails after the FSM mutated, the index is still consumed
-            # (state advanced) and the caller sees the error — durability
-            # of this one entry is lost, consistency is not.
-            disk_error = None
+            # Persist BEFORE applying (raft discipline, reference
+            # raft-boltdb ordering): a disk failure rejects the entry with
+            # no state moved, so the in-memory FSM can never run ahead of
+            # the durable log.  An entry whose apply then fails stays on
+            # disk but is harmless — boot replay tolerates unreplayable
+            # entries (see replay try/except above), mirroring that the
+            # write it carried failed when first applied.
             if self.log_store is not None:
                 try:
                     self.log_store.append(index, entry)
                 except Exception as e:
                     logger.exception("raft log append failed at index %d",
                                      index)
-                    disk_error = e
+                    future.respond(index, None, e)
+                    return future
+            apply_error = None
+            response = None
+            try:
+                response = self.fsm.apply(index, entry)
+            except Exception as e:  # surface apply errors to the caller
+                apply_error = e
             self._applied = index
             self._entries_since_snap += 1
-        if disk_error is not None:
-            future.respond(index, response, disk_error)
-            return future
-        future.respond(index, response)
-        self._maybe_snapshot()
+        future.respond(index, response, apply_error)
+        if apply_error is None:
+            self._maybe_snapshot()
         return future
 
     def barrier(self) -> int:

@@ -35,7 +35,13 @@ import msgpack
 
 from nomad_tpu.structs import codec
 
-from .raft import ApplyFuture, FileLogStore, SnapshotStore
+from .raft import (
+    ApplyFuture,
+    FileLogStore,
+    SnapshotStore,
+    resolve_snapshot_dir,
+    unwrap_snapshot,
+)
 
 logger = logging.getLogger("nomad_tpu.server.raft_net")
 
@@ -121,13 +127,14 @@ class NetRaft:
             os.makedirs(f"{data_dir}/raft", exist_ok=True)
             self._meta_path = f"{data_dir}/raft/meta.json"
             self._load_meta()
-            self._snap_store = SnapshotStore(f"{data_dir}/raft/snapshots")
+            self._snap_store = SnapshotStore(resolve_snapshot_dir(data_dir))
             latest = self._snap_store.latest()
             if latest is not None:
                 # Snapshot files wrap (term, fsm_blob) so the log base term
-                # survives restarts (reference FileSnapshotStore metadata).
+                # survives restarts (reference FileSnapshotStore metadata);
+                # unwrap tolerates legacy bare blobs.
                 snap_index, wrapped = latest
-                snap_term, blob = msgpack.unpackb(wrapped, raw=False)
+                snap_term, blob = unwrap_snapshot(wrapped)
                 self.fsm.restore(bytes(blob))
                 self._snap_blob = bytes(blob)
                 self._snap_index = snap_index
@@ -521,9 +528,12 @@ class NetRaft:
         self._log_base_index = self._snap_index
         self._log = keep
         if self._log_store is not None:
-            self._log_store.truncate()
-            for e in self._log:
-                self._persist_entry(e)
+            # Atomic tmp+rename rewrite: a crash mid-compaction must not
+            # lose entries above the snapshot that this node already
+            # persisted (and may have counted toward commitment quorum).
+            self._log_store.rewrite(
+                (e["index"], {"t": e["term"], "d": e["data"]})
+                for e in self._log)
 
     # -- RPC handlers ------------------------------------------------------
     def _handle_request_vote(self, args: dict) -> dict:

@@ -30,7 +30,12 @@ from .eval_broker import FAILED_QUEUE, EvalBroker
 from .fsm import NomadFSM
 from .plan_apply import PlanApplier
 from .plan_queue import PlanQueue
-from .raft import FileLogStore, InmemRaft, SnapshotStore
+from .raft import (
+    FileLogStore,
+    InmemRaft,
+    SnapshotStore,
+    resolve_snapshot_dir,
+)
 from .worker import BatchWorker, Worker
 
 logger = logging.getLogger("nomad_tpu.server")
@@ -119,7 +124,7 @@ class Server:
                 log_store = FileLogStore(
                     f"{self.config.data_dir}/raft/log.bin")
                 snapshots = SnapshotStore(
-                    f"{self.config.data_dir}/raft/snapshots")
+                    resolve_snapshot_dir(self.config.data_dir))
             self.raft = InmemRaft(
                 self.fsm, log_store, snapshots,
                 snapshot_threshold=self.config.raft_snapshot_threshold)

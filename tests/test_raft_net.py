@@ -252,9 +252,11 @@ def test_net_raft_replay_is_last_writer_wins(tmp_path):
         raft.shutdown()
 
 
-def test_inmem_raft_failed_apply_not_persisted(tmp_path):
-    """A failing fsm.apply must not leave the entry in the durable log
-    (boot replay would crash-loop) nor consume its index."""
+def test_inmem_raft_append_before_apply(tmp_path):
+    """Entries are persisted BEFORE the FSM applies them (raft
+    discipline, reference raft-boltdb ordering): a failing apply consumes
+    its index and leaves a poisoned entry that boot replay skips; the
+    in-memory FSM can never run ahead of the durable log."""
     from nomad_tpu.server.raft import FileLogStore, InmemRaft
 
     class FSM(_RecordingFSM):
@@ -269,11 +271,125 @@ def test_inmem_raft_failed_apply_not_persisted(tmp_path):
     bad = raft.apply(b"boom")
     assert bad.error is not None
     raft.apply(b"two").wait(1)
-    assert raft.applied_index() == 2
+    assert raft.applied_index() == 3
     raft.log_store.close()
 
     fsm2 = FSM()
     raft2 = InmemRaft(fsm2, FileLogStore(path))
     assert [d for _, d in fsm2.applied] == [b"one", b"two"]
-    assert raft2.applied_index() == 2
+    assert raft2.applied_index() == 3
     raft2.log_store.close()
+
+
+def test_inmem_raft_disk_failure_rejects_before_apply(tmp_path):
+    """A failing durable append rejects the entry with NO state moved:
+    the FSM is untouched and the index is not consumed."""
+    from nomad_tpu.server.raft import FileLogStore, InmemRaft
+
+    class FlakyLog(FileLogStore):
+        fail = False
+
+        def append(self, index, entry):
+            if self.fail:
+                raise OSError("disk full")
+            super().append(index, entry)
+
+    fsm = _RecordingFSM()
+    log = FlakyLog(str(tmp_path / "log.bin"))
+    raft = InmemRaft(fsm, log)
+    raft.apply(b"one").wait(1)
+    log.fail = True
+    fut = raft.apply(b"lost")
+    assert isinstance(fut.error, OSError)
+    assert raft.applied_index() == 1
+    assert [d for _, d in fsm.applied] == [b"one"]
+    log.fail = False
+    raft.apply(b"two").wait(1)
+    assert [d for _, d in fsm.applied] == [b"one", b"two"]
+    log.close()
+
+
+def test_log_rewrite_is_atomic_replacement(tmp_path):
+    """FileLogStore.rewrite replaces the log via tmp+rename and appends
+    keep working afterwards."""
+    import os
+
+    from nomad_tpu.server.raft import FileLogStore
+
+    path = str(tmp_path / "log.bin")
+    log = FileLogStore(path)
+    for i in range(1, 6):
+        log.append(i, f"e{i}".encode())
+    log.rewrite((i, f"e{i}".encode()) for i in (4, 5))
+    log.append(6, b"e6")
+    log.close()
+    assert not os.path.exists(path + ".tmp")
+    replayed = list(FileLogStore(path).replay())
+    assert [(i, bytes(d)) for i, d in replayed] == \
+        [(4, b"e4"), (5, b"e5"), (6, b"e6")]
+
+
+def test_snapshot_legacy_format_and_location(tmp_path):
+    """Pre-layout data_dirs restore: bare (unwrapped) snapshot blobs in
+    the legacy <data_dir>/snapshots location are found and decoded."""
+    from nomad_tpu.server.raft import (
+        InmemRaft,
+        SnapshotStore,
+        resolve_snapshot_dir,
+        unwrap_snapshot,
+    )
+
+    data_dir = str(tmp_path)
+    legacy = SnapshotStore(f"{data_dir}/snapshots")
+    legacy.save(7, b"raw-fsm-blob")  # old format: bare blob, no wrapper
+
+    resolved = resolve_snapshot_dir(data_dir)
+    assert resolved == f"{data_dir}/snapshots"
+
+    term, blob = unwrap_snapshot(b"raw-fsm-blob")
+    assert (term, blob) == (0, b"raw-fsm-blob")
+
+    class FSM(_RecordingFSM):
+        restored = None
+
+        def restore(self, blob):
+            self.restored = blob
+
+    fsm = FSM()
+    raft = InmemRaft(fsm, None, SnapshotStore(resolved))
+    assert fsm.restored == b"raw-fsm-blob"
+    assert raft.applied_index() == 7
+
+    # Once the current layout has snapshots, it wins.
+    import msgpack
+    cur = SnapshotStore(f"{data_dir}/raft/snapshots")
+    cur.save(9, msgpack.packb((3, b"new-blob"), use_bin_type=True))
+    assert resolve_snapshot_dir(data_dir) == f"{data_dir}/raft/snapshots"
+    assert unwrap_snapshot(
+        msgpack.packb((3, b"new-blob"), use_bin_type=True)) == \
+        (3, b"new-blob")
+
+
+def test_inmem_replay_last_writer_wins_and_torn_tail(tmp_path):
+    """Duplicate indexes in the durable log (re-append after a reported
+    disk failure whose record nonetheless landed) replay last-writer-wins;
+    a torn tail record ends replay cleanly (code-review regression)."""
+    from nomad_tpu.server.raft import FileLogStore, InmemRaft
+
+    path = str(tmp_path / "log.bin")
+    log = FileLogStore(path)
+    log.append(1, b"one")
+    log.append(2, b"lost-but-landed")
+    log.append(2, b"two-retry")
+    log.close()
+    # Torn tail: a length prefix promising more bytes than exist.
+    with open(path, "ab") as fh:
+        fh.write((999).to_bytes(4, "big"))
+        fh.write(b"partial")
+
+    fsm = _RecordingFSM()
+    raft = InmemRaft(fsm, FileLogStore(path))
+    assert [(i, bytes(d)) for i, d in fsm.applied] == \
+        [(1, b"one"), (2, b"two-retry")]
+    assert raft.applied_index() == 2
+    raft.log_store.close()

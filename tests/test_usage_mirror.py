@@ -60,13 +60,10 @@ def _assert_mirror_matches(mirror: UsageMirror, store, job_id: str = "j1"):
     for ni, c in mirror.job_counts.get(job_id, {}).items():
         dense[ni] = c
     np.testing.assert_array_equal(dense, scratch.job_counts)
-    # node_alloc_count: count live allocs per node.
-    nac = np.zeros(mirror.statics.n_pad, dtype=np.int32)
-    for a in live:
-        ni = mirror.statics.index_of.get(a.node_id, -1)
-        if ni >= 0:
-            nac[ni] += 1
-    np.testing.assert_array_equal(mirror.node_alloc_count, nac)
+    # alloc_rows tracks exactly the live allocs on known nodes.
+    expect_rows = {a.id for a in live
+                   if a.node_id in mirror.statics.index_of}
+    assert set(mirror.alloc_rows) == expect_rows
 
 
 def test_sync_through_upsert_update_delete():
