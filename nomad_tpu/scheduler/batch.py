@@ -50,10 +50,10 @@ class BatchEvalRunner:
         self.planner = planner
         self.state_refresh = state_refresh
 
-    def process(self, evals: list[Evaluation]) -> None:
-        from nomad_tpu.ops.binpack import place_sequence_batch
-
-        # Serialize by job: one eval per job per round.
+    def _split_rounds(self, evals: list[Evaluation]
+                      ) -> tuple[list, list]:
+        """Serialize by job: one eval per job per round; the rest run in
+        follow-up rounds against a refreshed snapshot."""
         seen_jobs: set = set()
         this_round, leftovers = [], []
         for ev in evals:
@@ -62,28 +62,43 @@ class BatchEvalRunner:
             else:
                 seen_jobs.add(ev.job_id)
                 this_round.append(ev)
+        return this_round, leftovers
+
+    def _begin_eval(self, ev: Evaluation):
+        """Instantiate and reconcile one eval up to its deferred device
+        args.  Returns the scheduler ready to dispatch, or None when the
+        eval finished without needing a device dispatch (bad trigger,
+        status error, or a plan with no placements)."""
+        sched = JaxBinPackScheduler(self.state, self.planner,
+                                    batch=(ev.type == "batch"))
+        sched.eval = ev
+        if ev.triggered_by not in VALID_GENERIC_TRIGGERS:
+            set_status(self.planner, ev, None, EVAL_STATUS_FAILED,
+                       f"scheduler cannot handle '{ev.triggered_by}' "
+                       "evaluation reason")
+            return None
+        sched.defer_device = True
+        try:
+            sched._begin()
+        except SetStatusError as e:
+            set_status(self.planner, ev, None, e.eval_status, str(e))
+            return None
+        sched.defer_device = False
+        if sched.deferred is None:
+            # No placements needed: submit stops/updates directly.
+            self._finish(sched)
+            return None
+        return sched
+
+    def process(self, evals: list[Evaluation]) -> None:
+        from nomad_tpu.ops.binpack import place_sequence_batch
+
+        this_round, leftovers = self._split_rounds(evals)
 
         pending = []  # (scheduler, place, DeviceArgs)
         for ev in this_round:
-            sched = JaxBinPackScheduler(self.state, self.planner,
-                                        batch=(ev.type == "batch"))
-            sched.eval = ev
-            if ev.triggered_by not in VALID_GENERIC_TRIGGERS:
-                set_status(self.planner, ev, None, EVAL_STATUS_FAILED,
-                           f"scheduler cannot handle '{ev.triggered_by}' "
-                           "evaluation reason")
-                continue
-            sched.defer_device = True
-            try:
-                sched._begin()
-            except SetStatusError as e:
-                set_status(self.planner, ev, None, e.eval_status, str(e))
-                continue
-            sched.defer_device = False
-
-            if sched.deferred is None:
-                # No placements needed: submit stops/updates directly.
-                self._finish(sched)
+            sched = self._begin_eval(ev)
+            if sched is None:
                 continue
             place, args = sched.deferred
             if sched.plan.node_update or sched.plan.node_allocation:

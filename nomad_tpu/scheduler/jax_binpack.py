@@ -50,7 +50,7 @@ from nomad_tpu.structs import (
     NetworkResource,
     Resources,
     allocs_fit,
-    generate_uuid,
+    generate_uuids,
 )
 from nomad_tpu.structs.model import MAX_DYNAMIC_PORT, MIN_DYNAMIC_PORT
 from nomad_tpu.structs.network import _cidr_ips
@@ -142,6 +142,18 @@ class JaxBinPackScheduler(GenericScheduler):
         if self.defer_device:
             self.deferred = (place, args)
             return
+        handles = self.dispatch_device(args)
+        chosen, scores = self.collect_device(args, handles)
+        self.finish_deferred(place, args, chosen, scores)
+
+    def dispatch_device(self, args: "DeviceArgs") -> tuple:
+        """Start the device dispatch for prepared args WITHOUT blocking:
+        the computation and its device->host result copies are left in
+        flight, so a pipelined caller (scheduler/pipeline.py) can prep
+        and dispatch the next eval while this one crosses the wire —
+        on remote-attached TPUs a synchronous dispatch costs a full
+        network round trip (~100 ms through the axon tunnel) no matter
+        how small the compute."""
         capacity_d, reserved_d = args.statics.device_capacity_reserved()
         if args.rounds_eligible:
             from nomad_tpu.ops.binpack import place_rounds
@@ -151,15 +163,79 @@ class JaxBinPackScheduler(GenericScheduler):
                 args.view.job_counts, args.feasible_d, args.asks,
                 args.distinct, args.counts, args.penalty,
                 k_cap=args.k_cap, rounds=args.rounds)
-            chosen, scores = fetch_results(chosen_s, scores_s)
-            chosen, scores = rounds_to_placements(args, chosen, scores)
         else:
             chosen_s, scores_s, _ = place_sequence(
                 capacity_d, reserved_d, args.view.dispatch_usage(),
                 args.view.job_counts, args.feasible_d, args.asks,
                 args.distinct, args.group_idx, args.valid, args.penalty)
-            chosen, scores = fetch_results(chosen_s, scores_s)
-        self.finish_deferred(place, args, chosen, scores)
+        for a in (chosen_s, scores_s):
+            try:
+                a.copy_to_host_async()
+            except AttributeError:  # pragma: no cover - non-array backend
+                pass
+        return chosen_s, scores_s
+
+    def collect_device(self, args: "DeviceArgs", handles: tuple
+                       ) -> tuple[np.ndarray, np.ndarray]:
+        """Block on a dispatch's results and map them to per-placement
+        (chosen, scores) arrays."""
+        chosen, scores = (np.asarray(h) for h in handles)
+        if args.rounds_eligible:
+            chosen, scores = rounds_to_placements(args, chosen, scores)
+        return chosen, scores
+
+    def _derive_sem(self, job_sem_key, tg, job_triples, job_dist,
+                    dcs_sorted):
+        """One TG's semantic tuple: (job_key, dedupe key, ask vector,
+        distinct_hosts, total Resources, net plan).  The single-task
+        unconstrained shape (count expansion's output, and the dominant
+        shape at 1k-group scale) takes a fused fast path with no
+        intermediate object churn; its key exactly matches what the
+        general path (group_mask_key) would produce for the same
+        content, so fast- and general-path groups dedupe together."""
+        tasks = tg.tasks
+        if len(tasks) == 1 and not tg.constraints \
+                and not tasks[0].constraints:
+            task = tasks[0]
+            r = task.resources
+            ask = None
+            mbits = ports = 0
+            fast_ok = True
+            if r is not None and r.networks:
+                nets = r.networks
+                if len(nets) != 1 or nets[0].reserved_ports:
+                    fast_ok = False
+                ask = nets[0]
+                for n in nets:
+                    mbits += n.mbits
+                    ports += len(n.reserved_ports) + len(n.dynamic_ports)
+            if r is None:
+                size = Resources()
+                ask_vec = (0, 0, 0, 0, 0, 0)
+            else:
+                # Networks are shared, not copied: `size` is only ever
+                # read (as_vector/allocs_fit accumulate into their own
+                # temporaries), same aliasing as the one-size-per-slot
+                # sharing finish_deferred already does.
+                size = Resources(cpu=r.cpu, memory_mb=r.memory_mb,
+                                 disk_mb=r.disk_mb, iops=r.iops,
+                                 networks=list(r.networks))
+                ask_vec = (r.cpu, r.memory_mb, r.disk_mb, r.iops,
+                           mbits, ports)
+            key = ((dcs_sorted, job_triples, (task.driver,)), ask_vec,
+                   job_dist)
+            return (job_sem_key, key, ask_vec, job_dist, size,
+                    (fast_ok, [(task.name, r, ask)]))
+        tg_constr = task_group_constraints(tg)
+        ask_vec = tuple(tg_constr.size.as_vector())
+        dist = job_dist or any(
+            c.hard and c.operand == CONSTRAINT_DISTINCT_HOSTS
+            for c in tg_constr.constraints)
+        key = (group_mask_key(self.job.datacenters, self.job.constraints,
+                              tg_constr.constraints, tg_constr.drivers),
+               ask_vec, dist)
+        return (job_sem_key, key, ask_vec, dist, tg_constr.size,
+                _net_plan_for(tg))
 
     def _prepare_device(self, place: list) -> DeviceArgs:
         start = time.perf_counter()
@@ -190,25 +266,25 @@ class JaxBinPackScheduler(GenericScheduler):
         slot_of_tg: dict = {}      # id(tg) -> slot
         asks_rows: list = []
         distinct_rows: list = []
-        job_sem_key = (id(self.job), self.job.modify_index)
+        job = self.job
+        job_sem_key = (id(job), job.modify_index)
+        # Job-level pieces of the semantic key, derived once per eval (the
+        # per-TG loop below is the host hot path at 1k groups/job).
+        jc = job.constraints
+        job_triples = tuple(sorted(
+            (c.l_target, c.operand, c.r_target) for c in jc
+            if c.hard and c.operand != CONSTRAINT_DISTINCT_HOSTS))
+        job_dist = any(c.hard and c.operand == CONSTRAINT_DISTINCT_HOSTS
+                       for c in jc)
+        dcs_sorted = tuple(sorted(job.datacenters))
         for missing in place:
             tg = missing.task_group
             if id(tg) in slot_of_tg:
                 continue
             sem = tg.__dict__.get("_sem_cache")
             if sem is None or sem[0] != job_sem_key:
-                tg_constr = task_group_constraints(tg)
-                ask_vec = tuple(tg_constr.size.as_vector())
-                dist = any(
-                    c.hard and c.operand == CONSTRAINT_DISTINCT_HOSTS
-                    for c in self.job.constraints + tg_constr.constraints)
-                key = (group_mask_key(self.job.datacenters,
-                                      self.job.constraints,
-                                      tg_constr.constraints,
-                                      tg_constr.drivers),
-                       ask_vec, dist)
-                sem = (job_sem_key, key, ask_vec, dist, tg_constr.size,
-                       _net_plan_for(tg))
+                sem = self._derive_sem(job_sem_key, tg, job_triples,
+                                       job_dist, dcs_sorted)
                 tg.__dict__["_sem_cache"] = sem
             _jk, key, ask_vec, dist, size, net_plan = sem
             slot = dedupe.get(key)
@@ -291,7 +367,11 @@ class JaxBinPackScheduler(GenericScheduler):
             feas_count = int(feasible_h[slot, :statics.n_real].sum())
             per_round = max(min(feas_count, k_cap), 1)
             need = -(-len(ps) // per_round)  # ceil
-            if need > 4:
+            # A round costs one top_k over the fleet (~sub-ms); 16 rounds
+            # still beats a multi-thousand-step sequential scan, so only
+            # truly scan-shaped evals (huge count on a tiny feasible set)
+            # fall back to place_sequence.
+            if need > 16:
                 eligible = False
                 break
             rounds = max(rounds, need)
@@ -336,6 +416,7 @@ class JaxBinPackScheduler(GenericScheduler):
         job = self.job
         job_id = job.id
         plan = self.plan
+        uuids = generate_uuids(len(place))
 
         failed_tg: dict = {}
         fallback_nodes = None
@@ -397,7 +478,7 @@ class JaxBinPackScheduler(GenericScheduler):
                         scores_l[p]
 
             alloc = Allocation(
-                id=generate_uuid(),
+                id=uuids[p],
                 eval_id=eval_id,
                 name=missing.name,
                 job_id=job_id,
@@ -452,11 +533,19 @@ class JaxBinPackScheduler(GenericScheduler):
             return None
         used = set(base[0])
         bw_used = base[1]
-        for alloc in self.ctx.proposed_allocs(node.id):
-            for tr in alloc.task_resources.values():
-                for offer in tr.networks:
-                    used.update(offer.reserved_ports)
-                    bw_used += offer.mbits
+        # O(1) emptiness probes (live, not precomputed: the plan grows
+        # during the finish loop): only nodes with store allocs or plan
+        # deltas need the exact proposed-alloc walk.
+        node_id = node.id
+        plan = self.plan
+        if self.state.has_allocs_on_node(node_id) or \
+                node_id in plan.node_update or \
+                node_id in plan.node_allocation:
+            for alloc in self.ctx.proposed_allocs(node_id):
+                for tr in alloc.task_resources.values():
+                    for offer in tr.networks:
+                        used.update(offer.reserved_ports)
+                        bw_used += offer.mbits
         return [used, bw_used, base[2], base[3], base[4]]
 
     def _assign_networks_fast(self, node_index: int, node, plan_tasks):

@@ -164,6 +164,12 @@ class _ReadMixin:
         table = self._t.tables["allocs"]
         return [table[i] for i in self._t.allocs_by_node.get(node_id, ())]
 
+    def has_allocs_on_node(self, node_id: str) -> bool:
+        """O(1) emptiness probe — the scheduler finish path calls this
+        once per placed node to skip proposed-alloc scans on fresh
+        nodes."""
+        return bool(self._t.allocs_by_node.get(node_id))
+
     def allocs_by_job(self, job_id: str) -> list:
         table = self._t.tables["allocs"]
         return [table[i] for i in self._t.allocs_by_job.get(job_id, ())]

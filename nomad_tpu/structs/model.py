@@ -84,6 +84,17 @@ def generate_uuid() -> str:
     return f"{h[:8]}-{h[8:12]}-{h[12:16]}-{h[16:20]}-{h[20:]}"
 
 
+def generate_uuids(n: int) -> list:
+    """n random UUID strings from ONE urandom read (bulk minting for the
+    scheduler finish path)."""
+    h = _os.urandom(16 * n).hex()
+    out = []
+    for i in range(0, 32 * n, 32):
+        s = h[i:i + 32]
+        out.append(f"{s[:8]}-{s[8:12]}-{s[12:16]}-{s[16:20]}-{s[20:]}")
+    return out
+
+
 def msec_now() -> int:
     return int(time.time() * 1000)
 

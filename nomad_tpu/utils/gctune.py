@@ -1,0 +1,33 @@
+"""Server-process GC tuning.
+
+The scheduler hot path churns short-lived acyclic objects (Allocations,
+AllocMetrics, Resources offers) at ~100k/sec under load, while the state
+store keeps hundreds of thousands of long-lived objects alive.  Python's
+default generational thresholds (700, 10, 10) then trigger frequent full
+collections that scan the entire live store — measured 100-200 ms pauses
+on a 10k-node fleet, halving eval throughput.
+
+The standard server fix (as popularized by Instagram's gc.freeze work):
+move boot-time state to the permanent generation so collections never
+scan it, and raise the gen-0 threshold so collection frequency matches
+the actual cycle rate (the domain objects are reference-acyclic; cycles
+come only from incidental plumbing).  GC stays ENABLED — true cycles are
+still reclaimed, just far less often.
+
+Called from Server startup and from bench.py (applied to both the device
+and sequential paths, so benchmarks stay honest).
+"""
+from __future__ import annotations
+
+import gc
+
+
+def tune_gc(gen0: int = 50_000, gen1: int = 50, gen2: int = 50,
+            freeze: bool = True) -> None:
+    """Raise collection thresholds and freeze current live objects into
+    the permanent generation.  Idempotent; call again after building
+    large long-lived structures to freeze them too."""
+    if freeze:
+        gc.collect()
+        gc.freeze()
+    gc.set_threshold(gen0, gen1, gen2)

@@ -402,11 +402,16 @@ def test_fast_network_rollback_keeps_cached_index_coherent():
     from nomad_tpu.scheduler.jax_binpack import JaxBinPackScheduler
     from nomad_tpu.structs import NetworkIndex, NetworkResource, Resources
 
+    from nomad_tpu.state import StateStore
+    from nomad_tpu.structs import Plan
+
     node = mock.node(0)  # eth0, 1000 mbits, 1 reserved
     sched = JaxBinPackScheduler.__new__(JaxBinPackScheduler)
     sched._statics = build_fleet([node])
     sched._node_net = {}
     sched._port_lcg = 12345
+    sched.state = StateStore()
+    sched.plan = Plan()
 
     class _Ctx:
         def proposed_allocs(self, node_id):

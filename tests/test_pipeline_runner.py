@@ -1,0 +1,142 @@
+"""Pipelined eval processing: window of in-flight device dispatches.
+
+The pipelined runner must be semantically identical to processing the
+same evals one at a time — it only changes WHEN results are collected,
+never what is planned.
+"""
+from __future__ import annotations
+
+import nomad_tpu.mock as mock
+from nomad_tpu.scheduler import Harness
+from nomad_tpu.scheduler.pipeline import PipelinedEvalRunner
+from nomad_tpu.structs import (
+    EVAL_TRIGGER_JOB_REGISTER,
+    Evaluation,
+    allocs_fit,
+    generate_uuid,
+)
+
+
+def make_eval(job):
+    return Evaluation(
+        id=generate_uuid(), priority=job.priority, type=job.type,
+        triggered_by=EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+    )
+
+
+def _cluster(n_nodes: int, n_jobs: int, count: int = 3):
+    h = Harness()
+    nodes = [mock.node(i) for i in range(n_nodes)]
+    for n in nodes:
+        h.state.upsert_node(h.next_index(), n)
+    jobs = []
+    for _ in range(n_jobs):
+        j = mock.job()
+        j.task_groups[0].count = count
+        h.state.upsert_job(h.next_index(), j)
+        jobs.append(j)
+    return h, nodes, jobs
+
+
+def test_pipeline_matches_sequential_processing():
+    """Same snapshot, same evals: the pipelined runner's plans must equal
+    one-at-a-time processing (placement counts and per-job spread)."""
+    h, nodes, jobs = _cluster(16, 6)
+    snap = h.state.snapshot()
+
+    runner = PipelinedEvalRunner(snap, h, depth=3)
+    runner.process([make_eval(j) for j in jobs])
+    piped = {p.node_allocation and sorted(
+        a.job_id for v in p.node_allocation.values() for a in v)[0]:
+        sum(len(v) for v in p.node_allocation.values())
+        for p in h.plans}
+
+    h2, _, _ = _cluster(16, 0)
+    for j in jobs:
+        h2.state.upsert_job(h2.next_index(), j)
+    for j in jobs:
+        h2.process("jax-binpack", make_eval(j))
+    solo = {p.node_allocation and sorted(
+        a.job_id for v in p.node_allocation.values() for a in v)[0]:
+        sum(len(v) for v in p.node_allocation.values())
+        for p in h2.plans}
+
+    assert len(h.plans) == len(jobs)
+    assert piped == solo
+    assert all(e.status == "complete" for e in h.evals)
+    assert len(runner.latencies) == len(jobs)
+
+
+def test_pipeline_depth_one_equals_depth_many():
+    h1, _, jobs = _cluster(12, 5)
+    snap1 = h1.state.snapshot()
+    PipelinedEvalRunner(snap1, h1, depth=1).process(
+        [make_eval(j) for j in jobs])
+
+    h2 = Harness()
+    for i in range(12):
+        h2.state.upsert_node(h2.next_index(), mock.node(i))
+    for j in jobs:
+        h2.state.upsert_job(h2.next_index(), j)
+    PipelinedEvalRunner(h2.state.snapshot(), h2, depth=8).process(
+        [make_eval(j) for j in jobs])
+
+    def shape(plans):
+        return sorted(
+            (sum(len(v) for v in p.node_allocation.values()),
+             len(p.failed_allocs)) for p in plans)
+
+    assert shape(h1.plans) == shape(h2.plans)
+
+
+def test_pipeline_plans_fit():
+    h, nodes, jobs = _cluster(4, 3, count=2)
+    for j in jobs:
+        j.task_groups[0].tasks[0].resources.cpu = 1000
+    runner = PipelinedEvalRunner(h.state.snapshot(), h, depth=4)
+    runner.process([make_eval(j) for j in jobs])
+    by_node = {n.id: n for n in nodes}
+    for plan in h.plans:
+        for node_id, allocs in plan.node_allocation.items():
+            fit, dim, _ = allocs_fit(by_node[node_id], allocs)
+            assert fit, dim
+
+
+def test_pipeline_serializes_same_job_evals():
+    h, _, jobs = _cluster(8, 1, count=4)
+    job = jobs[0]
+    runner = PipelinedEvalRunner(
+        h.state.snapshot(), h, depth=4,
+        state_refresh=lambda: h.state.snapshot())
+    runner.process([make_eval(job), make_eval(job)])
+    live = [a for a in h.state.allocs_by_job(job.id)
+            if not a.terminal_status()]
+    assert len(live) == 4
+
+
+def test_pipeline_handles_migrations_and_noops():
+    """Evals whose plans carry deltas (node drain -> migrate) and no-op
+    evals pipeline like any other."""
+    from nomad_tpu.structs import EVAL_TRIGGER_NODE_UPDATE
+
+    h, nodes, jobs = _cluster(8, 2)
+    for j in jobs:
+        h.process("jax-binpack", make_eval(j))
+    for p in list(h.plans):
+        allocs = [a for v in p.node_allocation.values() for a in v]
+        h.state.upsert_allocs(h.next_index(), allocs)
+    h.plans.clear()
+
+    # Drain one node: its allocs must migrate.
+    h.state.update_node_drain(h.next_index(), nodes[0].id, True)
+    evs = []
+    for j in jobs:
+        ev = make_eval(j)
+        ev.triggered_by = EVAL_TRIGGER_NODE_UPDATE
+        evs.append(ev)
+    runner = PipelinedEvalRunner(h.state.snapshot(), h, depth=2)
+    runner.process(evs)
+    assert all(e.status == "complete" for e in h.evals)
+    for plan in h.plans:
+        for node_id in plan.node_allocation:
+            assert node_id != nodes[0].id, "placed onto draining node"
