@@ -22,7 +22,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from nomad_tpu.ops.binpack import _place_sequence
+from nomad_tpu.ops.binpack import _place_rounds, _place_sequence
 
 FLEET_AXIS = "fleet"
 
@@ -80,3 +80,105 @@ def place_sequence_sharded(mesh: Mesh, capacity, reserved, usage0,
     valid = jax.device_put(valid, repl)
     return _place_sharded(capacity, reserved, usage0, job_counts0, feasible,
                           asks, distinct, group_idx, valid, penalty)
+
+
+# -- sharded throughput kernels ------------------------------------------
+# The single-eval scan above is the latency path; the carriers of bench
+# throughput are place_rounds (top-k round placement) and the vmapped
+# batch variants (ops/binpack.py).  Their sharded forms keep the SAME
+# node-axis sharding: per-shard score math, with the top_k / argmax
+# winner selection resolved by XLA-inserted cross-shard collectives.
+
+
+@partial(jax.jit, static_argnames=("k_cap", "rounds"))
+def _place_rounds_sharded_jit(capacity, reserved, usage0, jc0, feasible,
+                              asks, distinct, counts, penalty,
+                              k_cap: int, rounds: int):
+    return _place_rounds(capacity, reserved, usage0, jc0, feasible, asks,
+                         distinct, counts, penalty, k_cap=k_cap,
+                         rounds=rounds)
+
+
+def place_rounds_sharded(mesh: Mesh, capacity, reserved, usage0, jc0,
+                         feasible, asks, distinct, counts, penalty, *,
+                         k_cap: int, rounds: int):
+    """place_rounds with the node axis sharded over ``mesh``: each shard
+    scores its slice of the fleet; lax.top_k over the sharded axis becomes
+    a per-shard top-k + cross-shard merge (XLA GSPMD)."""
+    capacity, reserved, usage0, jc0, feasible = shard_fleet_arrays(
+        mesh, capacity, reserved, usage0, jc0, feasible)
+    _, _, repl = _shardings(mesh)
+    asks = jax.device_put(asks, repl)
+    distinct = jax.device_put(distinct, repl)
+    counts = jax.device_put(counts, repl)
+    return _place_rounds_sharded_jit(capacity, reserved, usage0, jc0,
+                                     feasible, asks, distinct, counts,
+                                     penalty, k_cap=k_cap, rounds=rounds)
+
+
+@partial(jax.jit, static_argnames=("k_cap", "rounds"))
+def _place_rounds_batch_sharded_jit(capacity, reserved, usage0, jc0,
+                                    feasible, asks, distinct, counts,
+                                    penalty, k_cap: int, rounds: int):
+    fn = jax.vmap(partial(_place_rounds, k_cap=k_cap, rounds=rounds),
+                  in_axes=(None, None, None, 0, 0, 0, 0, 0, 0))
+    return fn(capacity, reserved, usage0, jc0, feasible, asks, distinct,
+              counts, penalty)
+
+
+def place_rounds_batch_sharded(mesh: Mesh, capacity, reserved, usage0, jc0,
+                               feasible, asks, distinct, counts, penalty, *,
+                               k_cap: int, rounds: int):
+    """Batched (one lane per eval) rounds placement, node axis sharded:
+    lanes are replicated work descriptors; the fleet slice each device
+    holds serves every lane (the eval-storm layout — B x G x N feasibility
+    sharded on N, base usage shared across lanes)."""
+    node, _, repl = _shardings(mesh)
+    lane_node = NamedSharding(mesh, P(None, None, FLEET_AXIS))  # [B, G, N]
+    lane_n = NamedSharding(mesh, P(None, FLEET_AXIS))           # [B, N]
+    lane = NamedSharding(mesh, P(None))
+    capacity = jax.device_put(capacity, node)
+    reserved = jax.device_put(reserved, node)
+    usage0 = jax.device_put(usage0, node)
+    jc0 = jax.device_put(jc0, lane_n)
+    feasible = jax.device_put(feasible, lane_node)
+    asks = jax.device_put(asks, lane)
+    distinct = jax.device_put(distinct, lane)
+    counts = jax.device_put(counts, lane)
+    penalty = jax.device_put(penalty, repl)
+    return _place_rounds_batch_sharded_jit(
+        capacity, reserved, usage0, jc0, feasible, asks, distinct, counts,
+        penalty, k_cap=k_cap, rounds=rounds)
+
+
+@jax.jit
+def _place_sequence_batch_sharded_jit(capacity, reserved, usage0, jc0,
+                                      feasible, asks, distinct, group_idx,
+                                      valid, penalty):
+    fn = jax.vmap(partial(_place_sequence, unroll=1),
+                  in_axes=(None, None, None, 0, 0, 0, 0, 0, 0, 0))
+    return fn(capacity, reserved, usage0, jc0, feasible, asks, distinct,
+              group_idx, valid, penalty)
+
+
+def place_sequence_batch_sharded(mesh: Mesh, capacity, reserved, usage0,
+                                 jc0, feasible, asks, distinct, group_idx,
+                                 valid, penalty):
+    """Batched placement scan (one lane per eval), node axis sharded."""
+    node, _, repl = _shardings(mesh)
+    lane_node = NamedSharding(mesh, P(None, None, FLEET_AXIS))
+    lane_n = NamedSharding(mesh, P(None, FLEET_AXIS))
+    lane = NamedSharding(mesh, P(None))
+    capacity = jax.device_put(capacity, node)
+    reserved = jax.device_put(reserved, node)
+    usage0 = jax.device_put(usage0, node)
+    jc0 = jax.device_put(jc0, lane_n)
+    feasible = jax.device_put(feasible, lane_node)
+    asks = jax.device_put(asks, lane)
+    distinct = jax.device_put(distinct, lane)
+    group_idx = jax.device_put(group_idx, lane)
+    valid = jax.device_put(valid, lane)
+    penalty = jax.device_put(penalty, repl)
+    return _place_sequence_batch_sharded_jit(
+        capacity, reserved, usage0, jc0, feasible, asks, distinct,
+        group_idx, valid, penalty)

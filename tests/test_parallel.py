@@ -77,3 +77,93 @@ def test_batched_evals_are_independent():
         assert chosen[b].tolist() == chosen[0].tolist()
     # Each eval spread its 8 placements over all 8 nodes.
     assert sorted(chosen[0].tolist()) == list(range(8))
+
+
+def _rounds_problem(n_nodes=64, count=24):
+    fleet, view, feasible, asks, distinct, _gi, _v = _problem(n_nodes)
+    counts = np.asarray([count], dtype=np.int32)
+    return fleet, view, feasible, asks, distinct, counts
+
+
+def test_place_rounds_sharded_parity():
+    """place_rounds on the 8-device mesh == single-device result."""
+    from nomad_tpu.ops.binpack import place_rounds
+    from nomad_tpu.parallel.mesh import place_rounds_sharded
+
+    fleet, view, feasible, asks, distinct, counts = _rounds_problem()
+    kw = dict(k_cap=32, rounds=1)
+    ref_c, ref_s, ref_u = place_rounds(
+        fleet.capacity, fleet.reserved, view.usage, view.job_counts,
+        feasible, asks, distinct, counts, 10.0, **kw)
+
+    mesh = fleet_mesh(jax.devices("cpu"))
+    c, s, u = place_rounds_sharded(
+        mesh, fleet.capacity, fleet.reserved, view.usage, view.job_counts,
+        feasible, asks, distinct, counts, 10.0, **kw)
+
+    # Scores and usage must match exactly; chosen node ids may permute
+    # within equal-score ties (top_k tie order is shard-dependent), so
+    # compare as multisets plus exact usage.
+    np.testing.assert_allclose(np.asarray(u), np.asarray(ref_u))
+    assert sorted(np.asarray(c).ravel().tolist()) == \
+        sorted(np.asarray(ref_c).ravel().tolist())
+    np.testing.assert_allclose(np.sort(np.asarray(s).ravel()),
+                               np.sort(np.asarray(ref_s).ravel()),
+                               rtol=1e-6)
+
+
+def test_place_rounds_batch_sharded_parity():
+    from nomad_tpu.ops.binpack import place_rounds_batch
+    from nomad_tpu.parallel.mesh import place_rounds_batch_sharded
+
+    fleet, view, feasible, asks, distinct, counts = _rounds_problem()
+    B = 3
+    jc = np.broadcast_to(view.job_counts,
+                         (B,) + view.job_counts.shape).copy()
+    feas = np.broadcast_to(feasible, (B,) + feasible.shape).copy()
+    asks_b = np.broadcast_to(asks, (B,) + asks.shape).copy()
+    dist_b = np.broadcast_to(distinct, (B,) + distinct.shape).copy()
+    counts_b = np.broadcast_to(counts, (B,) + counts.shape).copy()
+    pen = np.full(B, 10.0, dtype=np.float32)
+    kw = dict(k_cap=32, rounds=1)
+
+    ref_c, ref_s, _ = place_rounds_batch(
+        fleet.capacity, fleet.reserved, view.usage, jc, feas, asks_b,
+        dist_b, counts_b, pen, **kw)
+    mesh = fleet_mesh(jax.devices("cpu"))
+    c, s, _ = place_rounds_batch_sharded(
+        mesh, fleet.capacity, fleet.reserved, view.usage, jc, feas,
+        asks_b, dist_b, counts_b, pen, **kw)
+
+    for b in range(B):
+        assert sorted(np.asarray(c)[b].ravel().tolist()) == \
+            sorted(np.asarray(ref_c)[b].ravel().tolist())
+    np.testing.assert_allclose(np.sort(np.asarray(s).ravel()),
+                               np.sort(np.asarray(ref_s).ravel()),
+                               rtol=1e-6)
+
+
+def test_place_sequence_batch_sharded_parity():
+    from nomad_tpu.parallel.mesh import place_sequence_batch_sharded
+
+    fleet, view, feasible, asks, distinct, group_idx, valid = _problem()
+    B = 3
+    jc = np.broadcast_to(view.job_counts,
+                         (B,) + view.job_counts.shape).copy()
+    feas = np.broadcast_to(feasible, (B,) + feasible.shape).copy()
+    asks_b = np.broadcast_to(asks, (B,) + asks.shape).copy()
+    dist_b = np.broadcast_to(distinct, (B,) + distinct.shape).copy()
+    gi = np.broadcast_to(group_idx, (B,) + group_idx.shape).copy()
+    va = np.broadcast_to(valid, (B,) + valid.shape).copy()
+    pen = np.full(B, 10.0, dtype=np.float32)
+
+    ref_c, ref_s, _ = place_sequence_batch(
+        fleet.capacity, fleet.reserved, view.usage, jc, feas, asks_b,
+        dist_b, gi, va, pen)
+    mesh = fleet_mesh(jax.devices("cpu"))
+    c, s, _ = place_sequence_batch_sharded(
+        mesh, fleet.capacity, fleet.reserved, view.usage, jc, feas,
+        asks_b, dist_b, gi, va, pen)
+
+    assert np.asarray(c).tolist() == np.asarray(ref_c).tolist()
+    np.testing.assert_allclose(np.asarray(s), np.asarray(ref_s), rtol=1e-6)
