@@ -109,6 +109,10 @@ class HTTPServer:
             raise BadRequest(str(e)) from e
         if "stale" in query:
             rpc_args["stale"] = True
+        if query.get("region"):
+            # Cross-region addressing (reference http.go parseRegion):
+            # the server's _forward routes it or errors on unknown.
+            rpc_args["region"] = query["region"]
 
         parts = [p for p in path.split("/") if p]
         if not parts or parts[0] != "v1":

@@ -47,13 +47,37 @@ class Endpoints:
         }.items():
             for m in methods:
                 handler = getattr(self, f"{service.lower()}_{_snake(m)}")
-                rpc_server.register(f"{service}.{m}", handler)
+                rpc_server.register(f"{service}.{m}",
+                                    self._with_region(f"{service}.{m}",
+                                                      handler))
 
     # -- plumbing ---------------------------------------------------------
+    def _with_region(self, method: str, handler):
+        """Region routing for EVERY endpoint, reads included (reference
+        nomad/rpc.go:162-227 ``forward`` stage 1): a request addressed to
+        another region goes to a random server there; an unknown region
+        errors — it must never silently execute locally."""
+        def routed(args: dict):
+            region = args.get("region")
+            if region and region != self.server.config.region:
+                if args.get("_region_forwarded"):
+                    raise RuntimeError(
+                        f"region forwarding loop: this server is in "
+                        f"{self.server.config.region!r}, request wants "
+                        f"{region!r}")
+                addr = self.server.region_server(region)
+                fwd_args = dict(args)
+                fwd_args["_region_forwarded"] = True
+                return self.server.conn_pool.call(addr, method, fwd_args)
+            return handler(args)
+        return routed
+
     def _forward(self, method: str, args: dict) -> Optional[dict]:
         """Returns None if this server should handle the request, else the
-        forwarded response from the leader.  Guards: never forward to self
-        (leadership-transition window) and at most one hop."""
+        forwarded response from the in-region leader (reference
+        nomad/rpc.go ``forward`` stage 2; stage 1 — region routing — runs
+        in _with_region before any handler).  Guards: never forward to
+        self (leadership-transition window) and at most one hop."""
         if self.server.is_leader():
             return None
         if args.get("stale"):

@@ -2,10 +2,12 @@
 
 Capability parity with /root/reference/nomad/rpc.go:20-158 + nomad/pool.go:
 the server's single TCP port serves multiple planes, demuxed by the first
-byte of each connection (0x01 nomad RPC, 0x02 raft hand-off); RPC frames are
-length-prefixed msgpack maps; clients keep pooled connections.  TLS and
-yamux multiplexing are replaced by plain framed TCP (one in-flight request
-per pooled connection, pool grows on demand) — same contract, simpler
+byte of each connection (0x01 nomad RPC, 0x02 raft hand-off, 0x04 TLS —
+the TLS byte wraps the stream and re-demuxes the inner byte, exactly the
+reference's recursive handleConn at rpc.go:73-117); RPC frames are
+length-prefixed msgpack maps; clients keep pooled connections.  yamux
+multiplexing is replaced by plain framed TCP (one in-flight request per
+pooled connection, pool grows on demand) — same contract, simpler
 substrate.
 
 Frame format (both directions): 4-byte big-endian length + msgpack body.
@@ -17,6 +19,7 @@ from __future__ import annotations
 import logging
 import socket
 import socketserver
+import ssl
 import struct
 import threading
 from typing import Callable, Optional
@@ -27,8 +30,43 @@ logger = logging.getLogger("nomad_tpu.server.rpc")
 
 RPC_NOMAD = 0x01
 RPC_RAFT = 0x02
+RPC_TLS = 0x04
 
 MAX_FRAME = 128 * 1024 * 1024
+
+
+def server_tls_context(cert_file: str, key_file: str,
+                       ca_file: Optional[str] = None,
+                       verify_client: bool = False) -> ssl.SSLContext:
+    """Server-side TLS context for the RPC plane."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_file, key_file)
+    if ca_file:
+        ctx.load_verify_locations(ca_file)
+    if verify_client:
+        ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx
+
+
+def client_tls_context(ca_file: Optional[str] = None,
+                       cert_file: Optional[str] = None,
+                       key_file: Optional[str] = None,
+                       check_hostname: bool = True) -> ssl.SSLContext:
+    """Client-side TLS context; verifies the server against ca_file (or
+    skips verification entirely when none is given — dev mode).  With
+    ``check_hostname=False`` the peer cert chain is still verified
+    against the CA but no name is matched — the mode for inter-server
+    dials addressed by raw IP when no tls_server_name is configured."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    if ca_file:
+        ctx.check_hostname = check_hostname
+        ctx.load_verify_locations(ca_file)
+    else:
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+    if cert_file:
+        ctx.load_cert_chain(cert_file, key_file)
+    return ctx
 
 
 def send_frame(sock: socket.socket, obj) -> None:
@@ -60,11 +98,15 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 
 
 class RPCServer:
-    """Threaded TCP listener demuxing nomad-RPC and raft streams."""
+    """Threaded TCP listener demuxing nomad-RPC, raft and TLS streams."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 tls_context: Optional[ssl.SSLContext] = None,
+                 require_tls: bool = False) -> None:
         self._handlers: dict = {}        # "Service.Method" -> callable
         self._raft_handler: Optional[Callable] = None
+        self._tls_context = tls_context
+        self._require_tls = require_tls and tls_context is not None
         self._lock = threading.Lock()
 
         outer = self
@@ -73,18 +115,8 @@ class RPCServer:
             def handle(self) -> None:
                 sock = self.request
                 try:
-                    first = sock.recv(1)
-                    if not first:
-                        return
-                    if first[0] == RPC_NOMAD:
-                        outer._serve_rpc(sock)
-                    elif first[0] == RPC_RAFT:
-                        if outer._raft_handler is not None:
-                            outer._raft_handler(sock)
-                    else:
-                        logger.warning("unrecognized RPC byte: %#x",
-                                       first[0])
-                except (ConnectionError, OSError):
+                    outer._demux(sock, tls_ok=True)
+                except (ConnectionError, OSError, ssl.SSLError):
                     pass
                 finally:
                     try:
@@ -127,6 +159,39 @@ class RPCServer:
         self._server.server_close()
 
     # -- serving ----------------------------------------------------------
+    def _demux(self, sock, tls_ok: bool) -> None:
+        """Dispatch one connection by its first byte; a TLS byte wraps the
+        stream and demuxes the inner byte once (no nested TLS)."""
+        first = sock.recv(1)
+        if not first:
+            return
+        if self._require_tls and tls_ok and first[0] != RPC_TLS:
+            # TLS-required listeners reject plaintext planes outright:
+            # encryption/mTLS must not be bypassable on the same port.
+            logger.warning("rejecting non-TLS connection (%#x): TLS "
+                           "required", first[0])
+            return
+        if first[0] == RPC_NOMAD:
+            self._serve_rpc(sock)
+        elif first[0] == RPC_RAFT:
+            if self._raft_handler is not None:
+                self._raft_handler(sock)
+        elif first[0] == RPC_TLS and tls_ok:
+            if self._tls_context is None:
+                logger.warning("TLS connection attempted but no TLS "
+                               "configured")
+                return
+            wrapped = self._tls_context.wrap_socket(sock, server_side=True)
+            try:
+                self._demux(wrapped, tls_ok=False)
+            finally:
+                try:
+                    wrapped.close()
+                except OSError:
+                    pass
+        else:
+            logger.warning("unrecognized RPC byte: %#x", first[0])
+
     def _serve_rpc(self, sock: socket.socket) -> None:
         while True:
             req = recv_frame(sock)
@@ -163,8 +228,18 @@ DEFAULT_CALL_TIMEOUT = 330.0  # > blocking-query max
 
 
 class _PooledConn:
-    def __init__(self, address: tuple) -> None:
+    def __init__(self, address: tuple,
+                 tls_context: Optional[ssl.SSLContext] = None,
+                 server_hostname: str = "") -> None:
         self.sock = socket.create_connection(address, timeout=330)
+        if tls_context is not None:
+            # Outer TLS byte in the clear, then handshake, then the inner
+            # plane byte rides encrypted (reference rpc.go:73-117).
+            self.sock.sendall(bytes([RPC_TLS]))
+            self.sock = tls_context.wrap_socket(
+                self.sock,
+                server_hostname=server_hostname or address[0]
+                if tls_context.check_hostname else None)
         self.sock.sendall(bytes([RPC_NOMAD]))
         self.lock = threading.Lock()
         self.seq = 0
@@ -197,10 +272,15 @@ class _PooledConn:
 
 class ConnPool:
     """Pooled msgpack-RPC client connections per server address
-    (reference nomad/pool.go)."""
+    (reference nomad/pool.go).  With a ``tls_context`` every pooled
+    connection rides the server's 0x04 TLS plane."""
 
-    def __init__(self, max_per_host: int = 4) -> None:
+    def __init__(self, max_per_host: int = 4,
+                 tls_context: Optional[ssl.SSLContext] = None,
+                 server_hostname: str = "") -> None:
         self.max_per_host = max_per_host
+        self.tls_context = tls_context
+        self.server_hostname = server_hostname
         self._lock = threading.Lock()
         self._pools: dict = {}   # address -> [idle _PooledConn]
 
@@ -218,7 +298,7 @@ class ConnPool:
             # Request never reached the server: retry once on a fresh
             # connection (safe even for writes).
             conn.close()
-            conn = _PooledConn(address)
+            conn = self._new_conn(address)
             try:
                 result = conn.call(method, args, timeout)
             except RPCError:
@@ -235,12 +315,16 @@ class ConnPool:
         self._checkin(address, conn)
         return result
 
+    def _new_conn(self, address: tuple) -> _PooledConn:
+        return _PooledConn(address, tls_context=self.tls_context,
+                           server_hostname=self.server_hostname)
+
     def _checkout(self, address: tuple) -> _PooledConn:
         with self._lock:
             pool = self._pools.get(address)
             if pool:
                 return pool.pop()
-        return _PooledConn(address)
+        return self._new_conn(address)
 
     def _checkin(self, address: tuple, conn: _PooledConn) -> None:
         with self._lock:

@@ -72,6 +72,20 @@ class ServerConfig:
         self.raft_heartbeat_interval: float = 0.05
         self.raft_snapshot_threshold: int = 8192
         self.bootstrap_expect: int = 1
+        self.tune_gc: bool = True   # server-process GC thresholds+freeze
+        # TLS on the RPC plane (0x04 demux, reference nomad/rpc.go:73-117):
+        # when cert+key are set the listener accepts TLS connections and
+        # the server's own ConnPool dials peers over TLS.
+        self.tls_cert_file: str = ""
+        self.tls_key_file: str = ""
+        self.tls_ca_file: str = ""
+        self.tls_verify_client: bool = False
+        # Reject plaintext planes on the listener (mTLS deployments).
+        self.tls_require: bool = False
+        # Expected peer cert name for inter-server dials (reference dials
+        # "server.<region>.nomad"); empty = verify the CA chain only (no
+        # hostname match — servers are usually addressed by raw IP).
+        self.tls_server_name: str = ""
         for k, v in kw.items():
             if not hasattr(self, k):
                 raise TypeError(f"unknown config key {k!r}")
@@ -81,6 +95,11 @@ class ServerConfig:
 class Server:
     def __init__(self, config: Optional[ServerConfig] = None) -> None:
         self.config = config or ServerConfig()
+        if self.config.tune_gc:
+            # Scheduler churn + a large live store make default GC
+            # thresholds cost 100-200ms pauses (utils/gctune.py).
+            from nomad_tpu.utils.gctune import tune_gc
+            tune_gc()
         self.eval_broker = EvalBroker(self.config.eval_nack_timeout,
                                       self.config.eval_delivery_limit)
         self.plan_queue = PlanQueue()
@@ -96,13 +115,29 @@ class Server:
         # RPC plane first (reference nomad/server.go:348-363 setupRPC) —
         # networked raft rides the same listener.
         from .rpc import ConnPool
-        self.conn_pool = ConnPool()
+        server_tls = client_tls = None
+        if self.config.tls_cert_file:
+            from .rpc import client_tls_context, server_tls_context
+            server_tls = server_tls_context(
+                self.config.tls_cert_file, self.config.tls_key_file,
+                ca_file=self.config.tls_ca_file or None,
+                verify_client=self.config.tls_verify_client)
+            client_tls = client_tls_context(
+                ca_file=self.config.tls_ca_file or None,
+                cert_file=self.config.tls_cert_file or None,
+                key_file=self.config.tls_key_file or None,
+                check_hostname=bool(self.config.tls_server_name))
+        self.conn_pool = ConnPool(
+            tls_context=client_tls,
+            server_hostname=self.config.tls_server_name)
         self.rpc_server = None
         if self.config.enable_rpc or self.config.raft_mode == "net":
             from .endpoints import Endpoints
             from .rpc import RPCServer
             self.rpc_server = RPCServer(self.config.bind_addr,
-                                        self.config.rpc_port)
+                                        self.config.rpc_port,
+                                        tls_context=server_tls,
+                                        require_tls=self.config.tls_require)
             Endpoints(self).install(self.rpc_server)
             self.rpc_server.start()
 
@@ -133,6 +168,14 @@ class Server:
             self.plan_queue, self.eval_broker, self.raft,
             lambda: self.fsm.state)
 
+        # Multi-region federation: region name -> {rpc address, ...} of
+        # known servers there, maintained from gossip member tags
+        # (reference nomad/server.go:503-538 — serf WAN tags feed the
+        # peers-by-region table consulted by rpc.go forwardRegion) or
+        # statically via add_region_server (join_wan analogue).
+        self._region_servers: dict = {}
+        self._region_lock = threading.Lock()
+
         # Gossip membership: servers discover one another and reconcile
         # raft peers from alive/fail events (reference nomad/serf.go +
         # leader.go:277-303 reconcileMember).
@@ -155,13 +198,17 @@ class Server:
         self._setup_workers()
 
     def _gossip_join(self, member) -> None:
-        """A server joined the gossip pool: add it as a raft peer
-        (reference serf.go nodeJoin + leader.go reconcileMember)."""
+        """A server joined the gossip pool: record its region for
+        cross-region forwarding, and (same region only) add it as a raft
+        peer (reference serf.go nodeJoin + leader.go reconcileMember)."""
         if member.tags.get("role") != "nomad-server":
             return
-        if member.tags.get("region") != self.config.region:
-            return  # other regions federate, they don't share raft
         rpc = member.tags.get("rpc")
+        region = member.tags.get("region")
+        if rpc and region:
+            self.add_region_server(region, (rpc[0], rpc[1]))
+        if region != self.config.region:
+            return  # other regions federate, they don't share raft
         add_peer = getattr(self.raft, "add_peer", None)
         if rpc and callable(add_peer):
             add_peer((rpc[0], rpc[1]))
@@ -170,9 +217,44 @@ class Server:
         if member.tags.get("role") != "nomad-server":
             return
         rpc = member.tags.get("rpc")
+        region = member.tags.get("region")
+        if rpc and region:
+            self.remove_region_server(region, (rpc[0], rpc[1]))
         remove_peer = getattr(self.raft, "remove_peer", None)
         if rpc and callable(remove_peer):
             remove_peer((rpc[0], rpc[1]))
+
+    # -- multi-region federation ------------------------------------------
+    def add_region_server(self, region: str, addr: tuple) -> None:
+        with self._region_lock:
+            self._region_servers.setdefault(region, set()).add(
+                (addr[0], addr[1]))
+
+    def remove_region_server(self, region: str, addr: tuple) -> None:
+        with self._region_lock:
+            servers = self._region_servers.get(region)
+            if servers:
+                servers.discard((addr[0], addr[1]))
+                if not servers:
+                    del self._region_servers[region]
+
+    def regions(self) -> list:
+        """Known region names, ours included (reference Region list API)."""
+        with self._region_lock:
+            known = set(self._region_servers)
+        known.add(self.config.region)
+        return sorted(known)
+
+    def region_server(self, region: str) -> tuple:
+        """A server address in ``region``, chosen at random (reference
+        nomad/rpc.go:207-227 forwardRegion).  Raises when the region is
+        unknown — a mis-addressed request must error, not run locally."""
+        import random as _random
+        with self._region_lock:
+            servers = list(self._region_servers.get(region, ()))
+        if not servers:
+            raise RuntimeError(f"no path to region {region!r}")
+        return _random.choice(servers)
 
     def _on_leadership_change(self, is_leader: bool) -> None:
         """monitorLeadership parity (leader.go:16-50)."""

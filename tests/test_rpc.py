@@ -147,3 +147,222 @@ class TestEndpoints:
         assert out["heartbeat_ttl"] > 0
         hb = pool.call(addr, "Node.Heartbeat", {"node_id": node.id})
         assert hb["heartbeat_ttl"] >= 10.0
+
+
+class TestRegionForwarding:
+    """Multi-region federation: requests addressed to another region
+    route to a server there; unknown regions error (reference
+    nomad/rpc.go:162-227 forward/forwardRegion)."""
+
+    def _two_regions(self):
+        a = Server(ServerConfig(num_schedulers=1, enable_rpc=True,
+                                region="region-a"))
+        b = Server(ServerConfig(num_schedulers=1, enable_rpc=True,
+                                region="region-b"))
+        a.establish_leadership()
+        b.establish_leadership()
+        # Static federation (the serf-WAN-tags analogue).
+        a.add_region_server("region-b", b.rpc_address())
+        b.add_region_server("region-a", a.rpc_address())
+        return a, b
+
+    def test_cross_region_register_and_read(self, pool):
+        a, b = self._two_regions()
+        try:
+            node = mock.node()
+            # Send to region A's server, addressed to region B.
+            pool.call(a.rpc_address(), "Node.Register",
+                      {"node": node.to_dict(), "region": "region-b"})
+            # The write landed in B, not A.
+            assert b.fsm.state.node_by_id(node.id) is not None
+            assert a.fsm.state.node_by_id(node.id) is None
+            # Cross-region read sees it too.
+            out = pool.call(a.rpc_address(), "Node.GetNode",
+                            {"node_id": node.id, "region": "region-b"})
+            assert out["node"]["id"] == node.id
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+    def test_unknown_region_errors(self, pool):
+        a, b = self._two_regions()
+        try:
+            with pytest.raises(RPCError, match="no path to region"):
+                pool.call(a.rpc_address(), "Node.Register",
+                          {"node": mock.node().to_dict(),
+                           "region": "atlantis"})
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+    def test_own_region_is_local(self, pool):
+        a, b = self._two_regions()
+        try:
+            node = mock.node()
+            pool.call(a.rpc_address(), "Node.Register",
+                      {"node": node.to_dict(), "region": "region-a"})
+            assert a.fsm.state.node_by_id(node.id) is not None
+            assert b.fsm.state.node_by_id(node.id) is None
+            assert a.regions() == ["region-a", "region-b"]
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+
+def _make_cert(tmp_path, cn="nomad-tpu-test"):
+    """Self-signed cert/key pair via the cryptography package."""
+    import datetime
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name).issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(
+            x509.SubjectAlternativeName([x509.DNSName("localhost"),
+                                         x509.DNSName(cn)]),
+            critical=False)
+        .sign(key, hashes.SHA256())
+    )
+    cert_path = tmp_path / "cert.pem"
+    key_path = tmp_path / "key.pem"
+    cert_path.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    key_path.write_bytes(key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.TraditionalOpenSSL,
+        serialization.NoEncryption()))
+    return str(cert_path), str(key_path)
+
+
+class TestTLS:
+    """TLS plane: 0x04 demux wraps the stream, inner planes unchanged
+    (reference nomad/rpc.go:73-117)."""
+
+    def test_rpc_over_tls(self, tmp_path):
+        from nomad_tpu.server.rpc import (
+            RPCServer,
+            client_tls_context,
+            server_tls_context,
+        )
+
+        cert, key = _make_cert(tmp_path)
+        srv = RPCServer(tls_context=server_tls_context(cert, key))
+        srv.register("Echo.Hello", lambda args: {"hi": args.get("x")})
+        srv.start()
+        pool = ConnPool(
+            tls_context=client_tls_context(ca_file=cert),
+            server_hostname="localhost")
+        try:
+            out = pool.call(srv.address, "Echo.Hello", {"x": 42})
+            assert out == {"hi": 42}
+            # Pooled connection reuse over TLS.
+            for i in range(5):
+                assert pool.call(srv.address, "Echo.Hello",
+                                 {"x": i}) == {"hi": i}
+            # Plaintext clients still work on the same listener.
+            plain = ConnPool()
+            assert plain.call(srv.address, "Echo.Hello",
+                              {"x": 1}) == {"hi": 1}
+            plain.shutdown()
+        finally:
+            pool.shutdown()
+            srv.shutdown()
+
+    def test_server_endpoints_over_tls(self, tmp_path):
+        from nomad_tpu.server.rpc import client_tls_context
+
+        cert, key = _make_cert(tmp_path)
+        s = Server(ServerConfig(
+            num_schedulers=1, enable_rpc=True,
+            tls_cert_file=cert, tls_key_file=key, tls_ca_file=cert))
+        s.establish_leadership()
+        pool = ConnPool(tls_context=client_tls_context(ca_file=cert),
+                        server_hostname="localhost")
+        try:
+            node = mock.node()
+            pool.call(s.rpc_address(), "Node.Register",
+                      {"node": node.to_dict()})
+            assert s.fsm.state.node_by_id(node.id) is not None
+            out = pool.call(s.rpc_address(), "Node.GetNode",
+                            {"node_id": node.id})
+            assert out["node"]["id"] == node.id
+        finally:
+            pool.shutdown()
+            s.shutdown()
+
+    def test_tls_refused_without_config(self):
+        from nomad_tpu.server.rpc import RPCServer, client_tls_context
+
+        srv = RPCServer()  # no TLS context
+        srv.register("Echo.Hello", lambda args: {})
+        srv.start()
+        pool = ConnPool(tls_context=client_tls_context())
+        try:
+            with pytest.raises((ConnectionError, OSError, Exception)):
+                pool.call(srv.address, "Echo.Hello", {}, timeout=2)
+        finally:
+            pool.shutdown()
+            srv.shutdown()
+
+    def test_require_tls_rejects_plaintext(self, tmp_path):
+        from nomad_tpu.server.rpc import (
+            RPCServer,
+            client_tls_context,
+            server_tls_context,
+        )
+
+        cert, key = _make_cert(tmp_path)
+        srv = RPCServer(tls_context=server_tls_context(cert, key),
+                        require_tls=True)
+        srv.register("Echo.Hello", lambda args: {"hi": 1})
+        srv.start()
+        tls_pool = ConnPool(tls_context=client_tls_context(ca_file=cert),
+                            server_hostname="localhost")
+        plain = ConnPool()
+        try:
+            # TLS clients work; plaintext is rejected outright.
+            assert tls_pool.call(srv.address, "Echo.Hello", {}) == {"hi": 1}
+            with pytest.raises((ConnectionError, OSError)):
+                plain.call(srv.address, "Echo.Hello", {}, timeout=2)
+        finally:
+            tls_pool.shutdown()
+            plain.shutdown()
+            srv.shutdown()
+
+    def test_tls_servers_forward_without_hostname_config(self, tmp_path):
+        """Inter-server forwarding with CA-only verification (no
+        tls_server_name): follower forwards to leader over TLS even
+        though servers are addressed by raw IP (code-review regression)."""
+        cert, key = _make_cert(tmp_path)
+        a = Server(ServerConfig(
+            num_schedulers=1, enable_rpc=True, region="ra",
+            tls_cert_file=cert, tls_key_file=key, tls_ca_file=cert))
+        b = Server(ServerConfig(
+            num_schedulers=1, enable_rpc=True, region="rb",
+            tls_cert_file=cert, tls_key_file=key, tls_ca_file=cert))
+        a.establish_leadership()
+        b.establish_leadership()
+        a.add_region_server("rb", b.rpc_address())
+        try:
+            node = mock.node()
+            # Cross-region forward rides A's TLS'd ConnPool to B.
+            from nomad_tpu.server.rpc import client_tls_context
+            pool = ConnPool(tls_context=client_tls_context(ca_file=cert),
+                            server_hostname="localhost")
+            pool.call(a.rpc_address(), "Node.Register",
+                      {"node": node.to_dict(), "region": "rb"})
+            assert b.fsm.state.node_by_id(node.id) is not None
+            pool.shutdown()
+        finally:
+            a.shutdown()
+            b.shutdown()
