@@ -56,6 +56,7 @@ class AgentConfig:
     bind_addr: str = "127.0.0.1"
     http_port: int = 4646
     rpc_port: int = 4647
+    serf_port: int = 4648
     server_enabled: bool = False
     client_enabled: bool = False
     dev_mode: bool = False
@@ -68,11 +69,25 @@ class AgentConfig:
     client_options: dict = field(default_factory=dict)
     node_class: str = ""
     meta: dict = field(default_factory=dict)
+    # Config-file parity fields (reference command/agent/config.go)
+    log_level: str = "INFO"
+    enable_debug: bool = False
+    leave_on_int: bool = False
+    leave_on_term: bool = False
+    addresses: dict = field(default_factory=dict)
+    advertise: dict = field(default_factory=dict)
+    telemetry: dict = field(default_factory=dict)
+    client_state_dir: str = ""
+    client_alloc_dir: str = ""
+    client_node_id: str = ""
+    network_speed: int = 0
+    server_data_dir: str = ""
 
     @classmethod
     def dev(cls) -> "AgentConfig":
         return cls(server_enabled=True, client_enabled=True, dev_mode=True,
-                   http_port=0, rpc_port=0)
+                   http_port=0, rpc_port=0, log_level="DEBUG",
+                   enable_debug=True)
 
 
 class Agent:
@@ -81,6 +96,8 @@ class Agent:
         self.server: Optional[Server] = None
         self.client: Optional[Client] = None
         self.http = None
+        self._apply_log_level(config.log_level)
+        self._apply_telemetry(config.telemetry)
 
         if config.dev_mode:
             config.server_enabled = True
@@ -114,7 +131,9 @@ class Agent:
         )
         if self.config.enabled_schedulers:
             cfg.enabled_schedulers = list(self.config.enabled_schedulers)
-        if self.config.data_dir and not self.config.dev_mode:
+        if self.config.server_data_dir:
+            cfg.data_dir = self.config.server_data_dir
+        elif self.config.data_dir and not self.config.dev_mode:
             cfg.data_dir = os.path.join(self.config.data_dir, "server")
         if self.config.raft_peers:
             cfg.raft_mode = "net"
@@ -132,11 +151,15 @@ class Agent:
                     name=self.config.name,
                     node_class=self.config.node_class,
                     meta=dict(self.config.meta))
+        if self.config.client_node_id:
+            node.id = self.config.client_node_id
         cfg = ClientConfig(
-            state_dir=os.path.join(self.config.data_dir, "client")
-            if self.config.data_dir else "",
-            alloc_dir=os.path.join(self.config.data_dir, "alloc")
-            if self.config.data_dir else "",
+            state_dir=self.config.client_state_dir or (
+                os.path.join(self.config.data_dir, "client")
+                if self.config.data_dir else ""),
+            alloc_dir=self.config.client_alloc_dir or (
+                os.path.join(self.config.data_dir, "alloc")
+                if self.config.data_dir else ""),
             node=node,
             region=self.config.region,
             options=dict(self.config.client_options),
@@ -176,6 +199,48 @@ class Agent:
             add_peer(address)
             return 1
         return 0
+
+    # -- reload --------------------------------------------------------------
+    def _apply_log_level(self, level: str) -> None:
+        numeric = getattr(logging, str(level).upper(), None)
+        if isinstance(numeric, int):
+            logging.getLogger("nomad_tpu").setLevel(numeric)
+
+    def _apply_telemetry(self, telemetry: dict) -> None:
+        if not telemetry:
+            return
+        from nomad_tpu.utils.metrics import metrics
+
+        addr = telemetry.get("statsd_address") or \
+            telemetry.get("statsite_address")
+        if addr and ":" in str(addr):
+            host, _, port = str(addr).rpartition(":")
+            already = any(
+                getattr(s, "address", None) == (host, int(port))
+                for s in metrics.sinks)
+            if not already:
+                metrics.add_statsd(host, int(port))
+
+    def reload(self, tree: dict) -> list:
+        """Apply the reloadable subset of a fresh config-file tree
+        (SIGHUP path; reference command.go:463 handleReload re-applies
+        the log filter).  Returns the list of keys applied."""
+        from .config import RELOADABLE_KEYS
+
+        applied = []
+        for key in RELOADABLE_KEYS:
+            if key not in tree:
+                continue
+            if key == "log_level":
+                self.config.log_level = tree[key]
+                self._apply_log_level(tree[key])
+            elif key == "enable_debug":
+                self.config.enable_debug = bool(tree[key])
+            elif key == "telemetry":
+                self.config.telemetry = dict(tree[key])
+                self._apply_telemetry(self.config.telemetry)
+            applied.append(key)
+        return applied
 
     # -- introspection -------------------------------------------------------
     def stats(self) -> dict:

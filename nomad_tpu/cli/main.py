@@ -91,8 +91,9 @@ def main(argv=None) -> int:
     p.add_argument("-rpc-port", type=int, default=4647)
     p.add_argument("-servers", default="",
                    help="comma-separated server RPC addrs (client mode)")
-    p.add_argument("-config", default="",
-                   help="JSON config file (merged over flags)")
+    p.add_argument("-config", action="append", default=[],
+                   help="HCL/JSON config file or directory; repeatable, "
+                        "merged in order (reloaded on SIGHUP)")
 
     p = sub.add_parser("init", help="create an example job file")
 
@@ -168,9 +169,9 @@ def cmd_agent(args) -> int:
                 host, port = part.rsplit(":", 1)
                 cfg.servers.append((host, int(port)))
     if args.config:
-        with open(args.config) as fh:
-            for key, value in json.load(fh).items():
-                setattr(cfg, key, value)
+        from nomad_tpu.agent.config import (apply_to_agent_config,
+                                            load_config_sources)
+        apply_to_agent_config(cfg, load_config_sources(args.config))
 
     agent = Agent(cfg)
     http_host, http_port = agent.http.address
@@ -182,8 +183,26 @@ def cmd_agent(args) -> int:
     if agent.client is not None:
         print(f"    Node: {agent.client.node.id}")
     stop = []
+
+    def _reload(*_sig):
+        # SIGHUP: re-read every -config source and apply the reloadable
+        # fields (reference command/agent/command.go:418-423,463).
+        if not args.config:
+            return
+        from nomad_tpu.agent.config import (ConfigError,
+                                            load_config_sources)
+        print("==> caught SIGHUP, reloading configuration...")
+        try:
+            applied = agent.reload(load_config_sources(args.config))
+        except (ConfigError, OSError) as e:
+            print(f"    failed to reload configs: {e}", file=sys.stderr)
+            return
+        print(f"    reloaded: {', '.join(applied) if applied else 'nothing'}")
+
     signal.signal(signal.SIGINT, lambda *_: stop.append(1))
     signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
+    if hasattr(signal, "SIGHUP"):
+        signal.signal(signal.SIGHUP, _reload)
     while not stop:
         time.sleep(0.2)
     print("==> caught signal, shutting down")
