@@ -96,7 +96,10 @@ class Agent:
         self.server: Optional[Server] = None
         self.client: Optional[Client] = None
         self.http = None
-        self._apply_log_level(config.log_level)
+        # Apply the configured level only when nothing else set one —
+        # embedders who configured logging themselves keep their setting.
+        if logging.getLogger("nomad_tpu").level == logging.NOTSET:
+            self._apply_log_level(config.log_level)
         self._apply_telemetry(config.telemetry)
 
         if config.dev_mode:
@@ -200,6 +203,18 @@ class Agent:
             return 1
         return 0
 
+    def leave(self) -> None:
+        """Gracefully leave the cluster before shutdown (reference
+        command.go:537 gracefulLeave: gossip Leave so peers don't mark us
+        failed)."""
+        if self.server is not None:
+            gossip = getattr(self.server, "gossip", None)
+            if gossip is not None:
+                try:
+                    gossip.leave()
+                except Exception:
+                    logger.warning("gossip leave failed", exc_info=True)
+
     # -- reload --------------------------------------------------------------
     def _apply_log_level(self, level: str) -> None:
         numeric = getattr(logging, str(level).upper(), None)
@@ -209,17 +224,23 @@ class Agent:
     def _apply_telemetry(self, telemetry: dict) -> None:
         if not telemetry:
             return
+        from nomad_tpu.agent.config import ConfigError
         from nomad_tpu.utils.metrics import metrics
 
         addr = telemetry.get("statsd_address") or \
             telemetry.get("statsite_address")
         if addr and ":" in str(addr):
             host, _, port = str(addr).rpartition(":")
+            try:
+                port = int(port)
+            except ValueError:
+                raise ConfigError(
+                    f"telemetry address {addr!r} has a bad port") from None
             already = any(
-                getattr(s, "address", None) == (host, int(port))
+                getattr(s, "address", None) == (host, port)
                 for s in metrics.sinks)
             if not already:
-                metrics.add_statsd(host, int(port))
+                metrics.add_statsd(host, port)
 
     def reload(self, tree: dict) -> list:
         """Apply the reloadable subset of a fresh config-file tree

@@ -125,11 +125,11 @@ def apply_to_agent_config(cfg: "AgentConfig", tree: dict) -> "AgentConfig":
             _set(scalar_map[key], value)
         elif key == "ports":
             if "http" in value:
-                cfg.http_port = int(value["http"])
+                cfg.http_port = _int("ports.http", value["http"])
             if "rpc" in value:
-                cfg.rpc_port = int(value["rpc"])
+                cfg.rpc_port = _int("ports.rpc", value["rpc"])
             if "serf" in value:
-                cfg.serf_port = int(value["serf"])
+                cfg.serf_port = _int("ports.serf", value["serf"])
         elif key in ("addresses", "advertise"):
             # Bind/advertise overrides default to bind_addr; carried for
             # parity, applied where the planes read them.
@@ -152,14 +152,14 @@ def apply_to_agent_config(cfg: "AgentConfig", tree: dict) -> "AgentConfig":
             if "node_id" in value:
                 cfg.client_node_id = value["node_id"]
             if "network_speed" in value:
-                cfg.network_speed = int(value["network_speed"])
+                cfg.network_speed = _int("client.network_speed", value["network_speed"])
         elif key == "server":
             if "enabled" in value:
                 cfg.server_enabled = bool(value["enabled"])
             if "bootstrap_expect" in value:
-                cfg.bootstrap_expect = int(value["bootstrap_expect"])
+                cfg.bootstrap_expect = _int("server.bootstrap_expect", value["bootstrap_expect"])
             if "num_schedulers" in value:
-                cfg.num_schedulers = int(value["num_schedulers"])
+                cfg.num_schedulers = _int("server.num_schedulers", value["num_schedulers"])
             if "enabled_schedulers" in value:
                 cfg.enabled_schedulers = _as_list(
                     value["enabled_schedulers"])
@@ -178,8 +178,16 @@ def _as_list(value: Any) -> list:
     return value if isinstance(value, list) else [value]
 
 
+def _int(key: str, value: Any) -> int:
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        raise ConfigError(f"config key {key!r} wants an integer, "
+                          f"got {value!r}") from None
+
+
 def _addr(spec: str) -> tuple:
     host, _, port = str(spec).rpartition(":")
     if not host:
         raise ConfigError(f"server address {spec!r} needs host:port")
-    return (host, int(port))
+    return (host, _int("server address port", port))

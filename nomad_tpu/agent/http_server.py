@@ -257,6 +257,38 @@ class HTTPServer:
                 agent.server.gossip.force_leave(name)
             return 200, {}, None
 
+        if parts and parts[0] == "agent" and \
+                parts[1:2] in (["pprof"], ["profile"]):
+            # Debug introspection, mounted only when enable_debug is set
+            # (reference http.go:115-120 pprof under enableDebug).
+            if not agent.config.enable_debug:
+                raise KeyError("debug endpoints disabled "
+                               "(set enable_debug)")
+            from nomad_tpu.utils import profiling
+
+            if parts[1] == "pprof":
+                return 200, {"stacks": profiling.thread_stacks()}, None
+            action = query.get("action", "")
+            if action == "start":
+                log_dir = query.get("dir", "")
+                if not log_dir:
+                    raise BadRequest("profile start needs ?dir=")
+                try:
+                    profiling.start_device_trace(log_dir)
+                except RuntimeError as e:
+                    raise BadRequest(str(e)) from e
+                return 200, {"tracing": log_dir}, None
+            if action == "stop":
+                try:
+                    done = profiling.stop_device_trace()
+                except RuntimeError as e:
+                    raise BadRequest(str(e)) from e
+                return 200, {"traced": done}, None
+            if action == "status":
+                return 200, {"tracing":
+                             profiling.active_trace_dir()}, None
+            raise BadRequest("profile wants ?action=start|stop|status")
+
         if parts == ["status", "leader"]:
             return out(agent.rpc("Status.Leader", {}), "leader")
         if parts == ["status", "peers"]:

@@ -194,18 +194,28 @@ def cmd_agent(args) -> int:
         print("==> caught SIGHUP, reloading configuration...")
         try:
             applied = agent.reload(load_config_sources(args.config))
-        except (ConfigError, OSError) as e:
+        except (ValueError, OSError) as e:
+            # ConfigError subclasses ValueError; a reload must never be
+            # able to take the agent down (reference command.go:463).
             print(f"    failed to reload configs: {e}", file=sys.stderr)
             return
         print(f"    reloaded: {', '.join(applied) if applied else 'nothing'}")
 
-    signal.signal(signal.SIGINT, lambda *_: stop.append(1))
-    signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
+    # leave_on_interrupt / leave_on_terminate: gracefully gossip-leave
+    # before shutdown (reference command.go:403-443 graceful leave).
+    signal.signal(signal.SIGINT, lambda *_: stop.append(
+        "leave" if cfg.leave_on_int else "stop"))
+    signal.signal(signal.SIGTERM, lambda *_: stop.append(
+        "leave" if cfg.leave_on_term else "stop"))
     if hasattr(signal, "SIGHUP"):
         signal.signal(signal.SIGHUP, _reload)
     while not stop:
         time.sleep(0.2)
-    print("==> caught signal, shutting down")
+    if stop[0] == "leave":
+        print("==> caught signal, gracefully leaving cluster")
+        agent.leave()
+    else:
+        print("==> caught signal, shutting down")
     agent.shutdown()
     return 0
 

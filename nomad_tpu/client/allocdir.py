@@ -34,17 +34,54 @@ class AllocDir:
             self.task_dirs[task.name] = task_dir
 
     def embed(self, task_name: str, entries: dict) -> None:
-        """Copy host paths into a task dir (chroot population,
-        reference alloc_dir.go Embed)."""
+        """Populate a task dir with host paths (chroot population,
+        reference alloc_dir.go Embed).
+
+        The reference bind-mounts on Linux; here regular files are
+        hardlinked when the alloc dir shares a filesystem with the host
+        path (near-free for a multi-GB /usr/lib) and copied otherwise.
+        Like bind mounts, hardlinks share the host inode — containment
+        relies on the exec driver's privilege drop (tasks run as nobody,
+        which cannot write the root-owned system files embedded here).
+        """
         task_dir = self.task_dirs[task_name]
         for host_path, rel_dest in entries.items():
             dest = os.path.join(task_dir, rel_dest.lstrip("/"))
             if os.path.isdir(host_path):
-                shutil.copytree(host_path, dest, dirs_exist_ok=True,
-                                symlinks=True)
+                self._embed_tree(host_path, dest)
             elif os.path.isfile(host_path):
                 os.makedirs(os.path.dirname(dest), exist_ok=True)
-                shutil.copy2(host_path, dest)
+                self._embed_file(host_path, dest)
+
+    @staticmethod
+    def _embed_file(src: str, dest: str) -> None:
+        try:
+            if os.path.exists(dest):
+                st, dt = os.stat(src), os.stat(dest)
+                if st.st_ino == dt.st_ino or (
+                        st.st_size == dt.st_size
+                        and st.st_mtime <= dt.st_mtime):
+                    return
+                os.unlink(dest)
+            os.link(src, dest)
+        except OSError:
+            shutil.copy2(src, dest)
+
+    def _embed_tree(self, src: str, dest: str) -> None:
+        for root, dirs, files in os.walk(src):
+            rel = os.path.relpath(root, src)
+            target = dest if rel == "." else os.path.join(dest, rel)
+            os.makedirs(target, exist_ok=True)
+            for name in files + [d for d in dirs if os.path.islink(
+                    os.path.join(root, d))]:
+                s = os.path.join(root, name)
+                d = os.path.join(target, name)
+                if os.path.lexists(d):
+                    continue
+                if os.path.islink(s):
+                    os.symlink(os.readlink(s), d)
+                else:
+                    self._embed_file(s, d)
 
     def log_path(self, task_name: str, kind: str) -> str:
         return os.path.join(self.shared_dir, "logs",

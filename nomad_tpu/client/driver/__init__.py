@@ -16,6 +16,7 @@ from .exec_driver import ExecDriver
 from .java import JavaDriver
 from .qemu import QemuDriver
 from .docker import DockerDriver
+from .rkt import RktDriver
 
 BUILTIN_DRIVERS: dict = {
     "raw_exec": RawExecDriver,
@@ -23,6 +24,7 @@ BUILTIN_DRIVERS: dict = {
     "java": JavaDriver,
     "qemu": QemuDriver,
     "docker": DockerDriver,
+    "rkt": RktDriver,
 }
 
 

@@ -55,8 +55,11 @@ class ExecDriver(Driver):
         task_dir = self.ctx.alloc_dir.task_dirs[task.name]
         self._populate_chroot(task)
         cgroup = self._make_cgroup(task)
+        uid, gid = self._drop_identity(task)
 
-        # Re-exec through a shim that joins the cgroup + chroots before
+        # Re-exec through a shim that joins the cgroup, chroots, then drops
+        # privileges (setgid/setgroups/setuid — reference executor drops to
+        # user `nobody` after chroot, client/executor/exec_linux.go) before
         # exec'ing the task command.
         import sys
 
@@ -64,13 +67,44 @@ class ExecDriver(Driver):
             sys.executable, "-c",
             ("import os,sys;"
              "cg=sys.argv[1];root=sys.argv[2];"
+             "uid=int(sys.argv[3]);gid=int(sys.argv[4]);"
              "cg and open(cg+'/cgroup.procs','w').write(str(os.getpid()));"
              "os.chroot(root);os.chdir('/');"
-             "os.execvp(sys.argv[3], sys.argv[3:])"),
-            cgroup or "", task_dir,
+             "gid>=0 and (os.setgroups([]),os.setgid(gid));"
+             "uid>=0 and os.setuid(uid);"
+             "os.execvp(sys.argv[5], sys.argv[5:])"),
+            cgroup or "", task_dir, str(uid), str(gid),
         ] + argv
         handle = self.spawn(task, shim, kind="exec")
         return handle
+
+    def _drop_identity(self, task) -> tuple:
+        """Resolve the unprivileged identity to run the task as.
+
+        Defaults to ``nobody`` (reference exec_linux.go); the task config's
+        ``user`` overrides it; ``user = "root"`` keeps root.  Returns
+        (-1, -1) when the drop is disabled or the user is unknown.
+        """
+        user = task.config.get("user") or "nobody"
+        if user == "root":
+            return -1, -1
+        try:
+            import pwd
+
+            ent = pwd.getpwnam(user)
+        except (KeyError, ImportError):
+            logger.warning("exec user %r not found; keeping root", user)
+            return -1, -1
+        # chown the task dir so the dropped user can write its cwd/logs.
+        task_dir = self.ctx.alloc_dir.task_dirs[task.name]
+        try:
+            os.chown(task_dir, ent.pw_uid, ent.pw_gid)
+            local = os.path.join(task_dir, "local")
+            if os.path.isdir(local):
+                os.chown(local, ent.pw_uid, ent.pw_gid)
+        except OSError:
+            pass
+        return ent.pw_uid, ent.pw_gid
 
     def _populate_chroot(self, task) -> None:
         embed = {src: dst for src, dst in CHROOT_ENV.items()

@@ -254,6 +254,48 @@ class TestHTTPAPI:
         with pytest.raises(APIError):
             client.raw("GET", "/v1/bogus")
 
+    def test_pprof_endpoint(self, dev_agent):
+        """Thread-stack dump — the pprof-goroutine analogue (reference
+        http.go:115-120)."""
+        _, client = dev_agent
+        data, _ = client.raw("GET", "/v1/agent/pprof")
+        stacks = data["stacks"]
+        assert any("MainThread" in name for name in stacks)
+        frames = next(iter(stacks.values()))
+        assert frames and {"file", "line", "func"} <= set(frames[0])
+
+    def test_pprof_gated_on_enable_debug(self, dev_agent):
+        agent, client = dev_agent
+        agent.config.enable_debug = False
+        try:
+            with pytest.raises(APIError) as e:
+                client.raw("GET", "/v1/agent/pprof")
+            assert e.value.status == 404
+        finally:
+            agent.config.enable_debug = True
+
+    def test_device_profile_toggle(self, dev_agent, tmp_path):
+        """Start/stop a jax.profiler trace over live dispatches; the
+        directory is xprof-loadable (SURVEY §5 device profiler hook)."""
+        _, client = dev_agent
+        trace_dir = str(tmp_path / "xla-trace")
+        data, _ = client.raw(
+            "PUT", f"/v1/agent/profile",
+            params={"action": "start", "dir": trace_dir})
+        assert data["tracing"] == trace_dir
+        # double-start is a client error
+        with pytest.raises(APIError) as e:
+            client.raw("PUT", "/v1/agent/profile",
+                       params={"action": "start", "dir": trace_dir})
+        assert e.value.status == 400
+        import jax.numpy as jnp
+
+        (jnp.ones((16, 16)) @ jnp.ones((16, 16))).block_until_ready()
+        data, _ = client.raw("PUT", "/v1/agent/profile",
+                             params={"action": "stop"})
+        assert data["traced"] == trace_dir
+        assert os.path.isdir(trace_dir) and os.listdir(trace_dir)
+
 
 # ---------------------------------------------------------------------------
 # CLI (in-process, pointed at the dev agent)

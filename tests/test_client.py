@@ -168,6 +168,100 @@ def test_task_runner_reattach(tmp_path):
     tr.destroy()
 
 
+@pytest.fixture
+def fake_rkt(tmp_path, monkeypatch):
+    """A stand-in rkt binary: prints versions, records invocations."""
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    log = tmp_path / "rkt-invocations.log"
+    rkt = bindir / "rkt"
+    rkt.write_text(
+        "#!/bin/sh\n"
+        f'echo "$@" >> {log}\n'
+        'if [ "$1" = "version" ]; then\n'
+        '  echo "rkt Version: 1.30.0"\n'
+        '  echo "appc Version: 0.8.11"\n'
+        "fi\n")
+    rkt.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+    return log
+
+
+@pytest.mark.skipif(os.geteuid() != 0, reason="rkt driver is root-only")
+def test_rkt_driver_fingerprint_and_start(tmp_path, fake_rkt):
+    from nomad_tpu.client.driver import BUILTIN_DRIVERS
+
+    node = Node(attributes={"kernel.name": "linux"})
+    assert BUILTIN_DRIVERS["rkt"].fingerprint(ClientConfig(), node)
+    assert node.attributes["driver.rkt"] == "1"
+    assert node.attributes["driver.rkt.version"] == "1.30.0"
+    assert node.attributes["driver.rkt.appc.version"] == "0.8.11"
+
+    ad = AllocDir(str(tmp_path / "alloc"))
+    task = Task(name="pod", driver="rkt",
+                config={"image": "coreos.com/etcd:v2.0.4",
+                        "command": "/etcd", "args": "--version"},
+                resources=Resources(cpu=100, memory_mb=64))
+    ad.build([task])
+    drv = BUILTIN_DRIVERS["rkt"](ExecContext(ad, "alloc-rkt"))
+    handle = drv.start(task)
+    assert handle.wait(10) == 0
+    line = [l for l in fake_rkt.read_text().splitlines()
+            if "run" in l][-1]
+    assert "--insecure-skip-verify" in line
+    assert "run --mds-register=false coreos.com/etcd:v2.0.4" in line
+    assert "--exec=/etcd" in line and line.endswith("-- --version")
+
+
+def test_rkt_driver_fingerprint_absent_without_binary(monkeypatch,
+                                                      tmp_path):
+    from nomad_tpu.client.driver import BUILTIN_DRIVERS
+
+    empty = tmp_path / "emptybin"
+    empty.mkdir()
+    monkeypatch.setenv("PATH", str(empty))
+    node = Node(attributes={"kernel.name": "linux"})
+    assert not BUILTIN_DRIVERS["rkt"].fingerprint(ClientConfig(), node)
+    assert "driver.rkt" not in node.attributes
+
+
+@pytest.mark.skipif(os.geteuid() != 0, reason="requires root")
+def test_exec_driver_drops_privileges(tmp_path):
+    """Root exec tasks run as nobody after chroot (reference
+    client/executor/exec_linux.go privilege drop)."""
+    import pwd
+
+    from nomad_tpu.client.driver import BUILTIN_DRIVERS
+
+    ad = AllocDir(str(tmp_path / "alloc"))
+    task = Task(name="iduid", driver="exec",
+                config={"command": "/usr/bin/id", "args": "-u"},
+                resources=Resources(cpu=100, memory_mb=64))
+    ad.build([task])
+    drv = BUILTIN_DRIVERS["exec"](ExecContext(ad, "alloc-priv"))
+    handle = drv.start(task)
+    assert handle.wait(30) == 0
+    out = open(ad.log_path("iduid", "stdout")).read().strip()
+    assert out == str(pwd.getpwnam("nobody").pw_uid)
+
+
+@pytest.mark.skipif(os.geteuid() != 0, reason="requires root")
+def test_exec_driver_user_override_keeps_root(tmp_path):
+    from nomad_tpu.client.driver import BUILTIN_DRIVERS
+
+    ad = AllocDir(str(tmp_path / "alloc"))
+    task = Task(name="idroot", driver="exec",
+                config={"command": "/usr/bin/id", "args": "-u",
+                        "user": "root"},
+                resources=Resources(cpu=100, memory_mb=64))
+    ad.build([task])
+    drv = BUILTIN_DRIVERS["exec"](ExecContext(ad, "alloc-priv2"))
+    handle = drv.start(task)
+    assert handle.wait(30) == 0
+    out = open(ad.log_path("idroot", "stdout")).read().strip()
+    assert out == "0"
+
+
 # ---------------------------------------------------------------------------
 # alloc runner
 # ---------------------------------------------------------------------------
