@@ -54,3 +54,20 @@ if _HAS_JAX:
         _register_jax()
     except Exception:  # pragma: no cover - keep host plane importable
         pass
+
+
+def device_available() -> bool:
+    """One-time probe: can the JAX backend actually hand out devices?
+
+    Importing jax succeeding does not mean the backend initialises (e.g. a
+    plugin platform selected via JAX_PLATFORMS whose plugin isn't on the
+    path).  Without this probe a broken device plane would fail every
+    device-scheduled eval into the delivery-limit reaper; with it the
+    server degrades to the sequential schedulers at startup.
+    """
+    if not _HAS_JAX:
+        return False
+    try:
+        return bool(jax.devices())
+    except Exception:
+        return False

@@ -135,22 +135,38 @@ class BatchEvalRunner:
             job_counts[b] = a.view.job_counts
             counts[b, :a.g_pad] = a.counts
 
+        penalty = np.asarray([a.penalty for _, _, a in pending],
+                             dtype=np.float32)
+        rounds_ok = all(a.rounds_eligible for _, _, a in pending)
+        k_cap = max(a.k_cap for _, _, a in pending)
+        rounds = max(a.rounds for _, _, a in pending)
+
+        # Executor policy (same trade as JaxBinPackScheduler.
+        # choose_host_executor): a fused dispatch pays one device round
+        # trip + a [B, G, N] upload; below this op-count the numpy kernels
+        # finish before the request would even reach the device.
+        steps = rounds * g_max if rounds_ok else p_max
+        fused_cost = B * steps * statics.n_real
+        if fused_cost <= JaxBinPackScheduler.HOST_SINGLE_SHOT_COST:
+            self._finish_fused_host(pending, rounds_ok, feasible, asks,
+                                    distinct, counts, group_idx, valid,
+                                    job_counts, k_cap, rounds)
+            if leftovers:
+                self._process_leftovers(leftovers)
+            return
+
         capacity_d, reserved_d = statics.device_capacity_reserved()
         # All fused lanes share the same snapshot base usage (fast-path
         # contract above); use the mirror's device-resident copy when the
         # first lane's view carries one (no upload).
         base_usage = pending[0][2].view.dispatch_usage()
-        penalty = np.asarray([a.penalty for _, _, a in pending],
-                             dtype=np.float32)
 
-        if all(a.rounds_eligible for _, _, a in pending):
+        if rounds_ok:
             # Fast path: top-k rounds — device steps scale with unique
             # groups x rounds, not with placements.
             from nomad_tpu.ops.binpack import place_rounds_batch
             from .jax_binpack import rounds_to_placements
 
-            k_cap = max(a.k_cap for _, _, a in pending)
-            rounds = max(a.rounds for _, _, a in pending)
             chosen_s, score_s, _u = place_rounds_batch(
                 capacity_d, reserved_d, base_usage, job_counts, feasible,
                 asks, distinct, counts, penalty, k_cap=k_cap,
@@ -173,6 +189,37 @@ class BatchEvalRunner:
         if leftovers:
             self._process_leftovers(leftovers)
 
+    def _finish_fused_host(self, pending, rounds_ok, feasible, asks,
+                           distinct, counts, group_idx, valid, job_counts,
+                           k_cap, rounds) -> None:
+        """Host-executor twin of the fused dispatch: every lane plans
+        against the same snapshot base usage via the numpy kernels, one
+        lane at a time (each lane's kernel is vectorized over nodes)."""
+        from nomad_tpu.ops.binpack_host import (place_rounds_host,
+                                                place_sequence_host)
+        from .jax_binpack import rounds_to_placements
+
+        statics = pending[0][2].statics
+        base_usage = pending[0][2].view.usage  # host array
+        n_real = statics.n_real
+        for b, (sched, place, args) in enumerate(pending):
+            if rounds_ok:
+                chosen_s, score_s, _u = place_rounds_host(
+                    statics.capacity, statics.reserved, base_usage,
+                    job_counts[b], feasible[b], asks[b], distinct[b],
+                    counts[b], float(args.penalty), k_cap=k_cap,
+                    rounds=rounds, n_real=n_real)
+                chosen, scores = rounds_to_placements(
+                    args, chosen_s, score_s)
+            else:
+                chosen, scores, _u = place_sequence_host(
+                    statics.capacity, statics.reserved, base_usage,
+                    job_counts[b], feasible[b], asks[b], distinct[b],
+                    group_idx[b], valid[b], float(args.penalty),
+                    n_real=n_real)
+            sched.finish_deferred(place, args, chosen, scores)
+            self._finish(sched)
+
     def _process_leftovers(self, leftovers: list) -> None:
         if self.state_refresh is None:
             for ev in leftovers:
@@ -184,14 +231,8 @@ class BatchEvalRunner:
         self.process(leftovers)
 
     def _run_single(self, sched, place, args) -> None:
-        from nomad_tpu.ops.binpack import place_sequence
-
-        capacity_d, reserved_d = args.statics.device_capacity_reserved()
-        chosen, scores, _ = place_sequence(
-            capacity_d, reserved_d, args.view.dispatch_usage(),
-            args.view.job_counts, args.feasible_d, args.asks,
-            args.distinct, args.group_idx, args.valid, args.penalty)
-        chosen, scores = fetch_results(chosen, scores)
+        handles = sched.dispatch_device(args)
+        chosen, scores = sched.collect_device(args, handles)
         sched.finish_deferred(place, args, chosen, scores)
         self._finish(sched)
 

@@ -63,6 +63,30 @@ from .stack import (
 from .util import ready_nodes_in_dcs, task_group_constraints
 
 
+def _proto_of(cls) -> tuple[dict, list]:
+    """Split a dataclass into (static-default dict, default_factory list)
+    for template-based construction: the finish loop builds thousands of
+    identical-shaped objects per eval, and ``cls.__new__`` + one dict copy
+    is ~3x cheaper than the generated ``__init__`` while staying in sync
+    with the dataclass definition automatically."""
+    import dataclasses
+
+    static, factories = {}, []
+    for f in dataclasses.fields(cls):
+        if f.default_factory is not dataclasses.MISSING:  # type: ignore
+            factories.append((f.name, f.default_factory))
+        else:
+            static[f.name] = None if f.default is dataclasses.MISSING \
+                else f.default
+    return static, factories
+
+
+_ALLOC_STATIC, _ALLOC_FACTORIES = _proto_of(Allocation)
+_METRIC_STATIC, _METRIC_FACTORIES = _proto_of(AllocMetric)
+_RES_STATIC, _RES_FACTORIES = _proto_of(Resources)
+_NET_STATIC, _NET_FACTORIES = _proto_of(NetworkResource)
+
+
 def _net_plan_for(tg):
     """Per-slot network plan for the bulk finish path:
     (fast_ok, [(task_name, base_resources, net_ask | None), ...]).
@@ -101,6 +125,7 @@ class DeviceArgs:
     __slots__ = ("statics", "view", "feasible_d", "feasible_h", "asks",
                  "distinct", "group_idx", "valid", "sizes", "slot_of_tg",
                  "penalty", "g_pad", "p_pad", "start", "net_plans",
+                 "n_groups", "n_place",
                  # rounds-mode plan (see ops/binpack.py place_rounds):
                  "counts", "slot_placements", "k_cap", "rounds",
                  "rounds_eligible")
@@ -146,27 +171,78 @@ class JaxBinPackScheduler(GenericScheduler):
         chosen, scores = self.collect_device(args, handles)
         self.finish_deferred(place, args, chosen, scores)
 
-    def dispatch_device(self, args: "DeviceArgs") -> tuple:
+    # Executor policy: estimated elementwise-op count (scan steps x node
+    # axis) below which the numpy host kernels beat shipping the work to
+    # the device.  A device dispatch has a fixed floor — one network round
+    # trip (~100 ms) on remote-attached TPUs, ~100 us locally — so tiny
+    # workloads always stay host-side; mid-size ones stay host-side only
+    # when the caller isn't pipelining dispatches (a pipeline hides the
+    # round trip behind host work, a single-shot eval eats it whole).
+    HOST_ALWAYS_COST = 1 << 18       # ~sub-ms of numpy
+    HOST_SINGLE_SHOT_COST = 1 << 25  # ~tens of ms, still << 1 RTT
+
+    def choose_host_executor(self, args: "DeviceArgs",
+                             pipelined: bool) -> bool:
+        steps = args.rounds * args.n_groups if args.rounds_eligible \
+            else args.n_place
+        cost = steps * args.statics.n_real
+        if cost <= self.HOST_ALWAYS_COST:
+            return True
+        return not pipelined and cost <= self.HOST_SINGLE_SHOT_COST
+
+    def dispatch_host(self, args: "DeviceArgs") -> tuple:
+        """Run the placement kernels eagerly with numpy
+        (ops/binpack_host.py) — same semantics, zero dispatch latency."""
+        from nomad_tpu.ops.binpack_host import (place_rounds_host,
+                                                place_sequence_host)
+
+        statics = args.statics
+        if args.rounds_eligible:
+            chosen, scores, _ = place_rounds_host(
+                statics.capacity, statics.reserved, args.view.usage,
+                args.view.job_counts, args.feasible_h, args.asks,
+                args.distinct, args.counts, args.penalty,
+                k_cap=args.k_cap, rounds=args.rounds,
+                n_real=statics.n_real)
+        else:
+            chosen, scores, _ = place_sequence_host(
+                statics.capacity, statics.reserved, args.view.usage,
+                args.view.job_counts, args.feasible_h, args.asks,
+                args.distinct, args.group_idx, args.valid, args.penalty,
+                n_real=statics.n_real)
+        return chosen, scores
+
+    def dispatch_device(self, args: "DeviceArgs",
+                        pipelined: bool = False) -> tuple:
         """Start the device dispatch for prepared args WITHOUT blocking:
         the computation and its device->host result copies are left in
         flight, so a pipelined caller (scheduler/pipeline.py) can prep
         and dispatch the next eval while this one crosses the wire —
         on remote-attached TPUs a synchronous dispatch costs a full
         network round trip (~100 ms through the axon tunnel) no matter
-        how small the compute."""
+        how small the compute.  Small workloads skip the device entirely
+        (choose_host_executor) and come back as ready numpy arrays."""
+        if self.choose_host_executor(args, pipelined):
+            return self.dispatch_host(args)
         capacity_d, reserved_d = args.statics.device_capacity_reserved()
+        feas_cached = args.feasible_d  # [host, device-or-None], lazy
+        if feas_cached[1] is None:
+            import jax
+
+            feas_cached[1] = jax.device_put(feas_cached[0])
+        feasible_d = feas_cached[1]
         if args.rounds_eligible:
             from nomad_tpu.ops.binpack import place_rounds
 
             chosen_s, scores_s, _ = place_rounds(
                 capacity_d, reserved_d, args.view.dispatch_usage(),
-                args.view.job_counts, args.feasible_d, args.asks,
+                args.view.job_counts, feasible_d, args.asks,
                 args.distinct, args.counts, args.penalty,
                 k_cap=args.k_cap, rounds=args.rounds)
         else:
             chosen_s, scores_s, _ = place_sequence(
                 capacity_d, reserved_d, args.view.dispatch_usage(),
-                args.view.job_counts, args.feasible_d, args.asks,
+                args.view.job_counts, feasible_d, args.asks,
                 args.distinct, args.group_idx, args.valid, args.penalty)
         for a in (chosen_s, scores_s):
             try:
@@ -319,11 +395,11 @@ class JaxBinPackScheduler(GenericScheduler):
                     statics, self.job.datacenters, self.job.constraints,
                     tg_constr.constraints, tg_constr.drivers)
                 feasible_h[g] = mask
-            import jax
-            feasible_d = jax.device_put(feasible_h)
-            statics.device_cache[feas_key] = (feasible_h, feasible_d)
-        else:
-            feasible_h, feasible_d = cached
+            # Device copy is lazy (filled on first device dispatch) so
+            # host-executor evals never touch the device at all.
+            cached = [feasible_h, None]
+            statics.device_cache[feas_key] = cached
+        feasible_h = cached[0]
 
         group_idx = np.zeros(p_pad, dtype=np.int32)
         valid = np.zeros(p_pad, dtype=bool)
@@ -377,11 +453,12 @@ class JaxBinPackScheduler(GenericScheduler):
             rounds = max(rounds, need)
 
         return DeviceArgs(
-            statics=statics, view=view, feasible_d=feasible_d,
+            statics=statics, view=view, feasible_d=cached,
             feasible_h=feasible_h, asks=asks, distinct=distinct,
             group_idx=group_idx, valid=valid, sizes=sizes,
             slot_of_tg=slot_of_tg, penalty=penalty, g_pad=g_pad,
             p_pad=p_pad, start=start, net_plans=net_plans, counts=counts,
+            n_groups=len(groups), n_place=len(place),
             slot_placements=slot_placements, k_cap=k_cap, rounds=rounds,
             rounds_eligible=eligible)
 
@@ -417,6 +494,23 @@ class JaxBinPackScheduler(GenericScheduler):
         job_id = job.id
         plan = self.plan
         uuids = generate_uuids(len(place))
+
+        # Template-based construction (see _proto_of): the loop below
+        # builds one AllocMetric + Allocation per placement.
+        metric_proto = dict(_METRIC_STATIC, nodes_evaluated=n_real,
+                            allocation_time=per_time)
+        alloc_proto = dict(_ALLOC_STATIC, eval_id=eval_id, job_id=job_id,
+                           job=job)
+
+        def fast_metric(score_key=None, score=0.0) -> AllocMetric:
+            m = AllocMetric.__new__(AllocMetric)
+            d = dict(metric_proto)
+            for nm, fac in _METRIC_FACTORIES:
+                d[nm] = fac()
+            if score_key is not None:
+                d["scores"][score_key] = score
+            m.__dict__ = d
+            return m
 
         failed_tg: dict = {}
         fallback_nodes = None
@@ -470,34 +564,34 @@ class JaxBinPackScheduler(GenericScheduler):
                         statics.index_of.get(option_node.id), None)
                 # stack.select populated fresh ctx metrics (incl. scores).
                 metrics = self.ctx.metrics()
+            elif option_node is not None:
+                metrics = fast_metric(option_node.id + ".binpack",
+                                      scores_l[p])
             else:
-                metrics = AllocMetric(nodes_evaluated=n_real,
-                                      allocation_time=per_time)
-                if option_node is not None:
-                    metrics.scores[f"{option_node.id}.binpack"] = \
-                        scores_l[p]
+                metrics = fast_metric()
 
-            alloc = Allocation(
-                id=uuids[p],
-                eval_id=eval_id,
-                name=missing.name,
-                job_id=job_id,
-                job=job,
-                task_group=tg.name,
-                resources=size,
-                metrics=metrics,
-            )
+            alloc = Allocation.__new__(Allocation)
+            d = dict(alloc_proto)
+            d["id"] = uuids[p]
+            d["name"] = missing.name
+            d["task_group"] = tg.name
+            d["resources"] = size
+            d["metrics"] = metrics
+            d["task_states"] = {}
             if option_node is not None:
-                alloc.node_id = option_node.id
-                alloc.task_resources = task_resources
-                alloc.desired_status = ALLOC_DESIRED_STATUS_RUN
-                alloc.client_status = ALLOC_CLIENT_STATUS_PENDING
+                d["node_id"] = option_node.id
+                d["task_resources"] = task_resources
+                d["desired_status"] = ALLOC_DESIRED_STATUS_RUN
+                d["client_status"] = ALLOC_CLIENT_STATUS_PENDING
+                alloc.__dict__ = d
                 plan.append_alloc(alloc)
             else:
-                alloc.desired_status = ALLOC_DESIRED_STATUS_FAILED
-                alloc.desired_description = \
+                d["task_resources"] = {}
+                d["desired_status"] = ALLOC_DESIRED_STATUS_FAILED
+                d["desired_description"] = \
                     "failed to find a node for placement"
-                alloc.client_status = ALLOC_CLIENT_STATUS_FAILED
+                d["client_status"] = ALLOC_CLIENT_STATUS_FAILED
+                alloc.__dict__ = d
                 plan.append_failed(alloc)
                 failed_tg[id(tg)] = alloc
 
@@ -567,12 +661,16 @@ class JaxBinPackScheduler(GenericScheduler):
         span = MAX_DYNAMIC_PORT - MIN_DYNAMIC_PORT
         staged_bw = 0
         mirrored = []   # offers mirrored into the cached exact-path index
+        net_cache = self._net_cache
         for name, res, ask in plan_tasks:
             if ask is None:
-                out[name] = Resources(
+                r = Resources.__new__(Resources)
+                r.__dict__ = dict(
+                    _RES_STATIC, networks=[],
                     cpu=res.cpu, memory_mb=res.memory_mb,
                     disk_mb=res.disk_mb, iops=res.iops) \
-                    if res is not None else Resources()
+                    if res is not None else dict(_RES_STATIC, networks=[])
+                out[name] = r
                 continue
             if bw_used + staged_bw + ask.mbits > bw_avail:
                 # Roll back staged ports — and the offers already mirrored
@@ -583,7 +681,7 @@ class JaxBinPackScheduler(GenericScheduler):
                     for offer in tr.networks:
                         used.difference_update(offer.reserved_ports)
                 for offer in mirrored:
-                    self._net_cache[node.id].remove_reserved(offer)
+                    net_cache[node.id].remove_reserved(offer)
                 return None
             ports = []
             lcg = self._port_lcg
@@ -599,18 +697,21 @@ class JaxBinPackScheduler(GenericScheduler):
                 used.add(port)
                 ports.append(port)
             self._port_lcg = lcg
-            offer = NetworkResource(
-                device=device, ip=ip, mbits=ask.mbits,
+            offer = NetworkResource.__new__(NetworkResource)
+            offer.__dict__ = dict(
+                _NET_STATIC, device=device, ip=ip, mbits=ask.mbits,
                 reserved_ports=ports,
                 dynamic_ports=list(ask.dynamic_ports))
             staged_bw += ask.mbits
-            out[name] = Resources(
-                cpu=res.cpu, memory_mb=res.memory_mb, disk_mb=res.disk_mb,
-                iops=res.iops, networks=[offer])
+            r = Resources.__new__(Resources)
+            r.__dict__ = dict(
+                _RES_STATIC, cpu=res.cpu, memory_mb=res.memory_mb,
+                disk_mb=res.disk_mb, iops=res.iops, networks=[offer])
+            out[name] = r
             # Keep an exact-path NetworkIndex for this node (if one was
             # built for a non-fast slot) coherent with our offers.
-            if self._net_cache:
-                idx = self._net_cache.get(node.id)
+            if net_cache:
+                idx = net_cache.get(node.id)
                 if idx is not None:
                     idx.add_reserved(offer)
                     mirrored.append(offer)

@@ -58,7 +58,7 @@ class PipelinedEvalRunner(BatchEvalRunner):
                 self.latencies.append(time.perf_counter() - start)
                 continue
             place, args = sched.deferred
-            handles = sched.dispatch_device(args)
+            handles = sched.dispatch_device(args, pipelined=True)
             window.append((sched, place, args, handles, start))
             if len(window) >= self.depth:
                 self._drain_one(window)

@@ -290,6 +290,14 @@ class Server:
     def _setup_workers(self) -> None:
         n = self.config.num_schedulers
         if self.config.use_device_scheduler:
+            import nomad_tpu.scheduler as sched_registry
+
+            if not sched_registry.device_available():
+                logger.warning(
+                    "device backend unavailable; falling back to "
+                    "sequential schedulers for this server")
+                self.config.use_device_scheduler = False
+        if self.config.use_device_scheduler:
             # One device batch worker replaces the goroutine fleet for
             # service/batch evals; plain workers cover system/_core so the
             # two pools never race for the same queues.

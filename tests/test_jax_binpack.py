@@ -438,3 +438,170 @@ def test_fast_network_rollback_keeps_cached_index_coherent():
     assert idx.used_bandwidth == bw_before
     assert {ip: set(p) for ip, p in idx.used_ports.items()
             if p} == {ip: set(p) for ip, p in ports_before.items() if p}
+
+
+# ---------------------------------------------------------------------------
+# host (numpy) executor: kernel parity + dispatch policy
+# ---------------------------------------------------------------------------
+
+def _random_case(rng, n_nodes=23, n_groups=3, n_place=17):
+    nodes = [mock.node(i) for i in range(n_nodes)]
+    for i, n in enumerate(nodes):
+        n.resources.cpu = int(rng.integers(800, 4000))
+        n.resources.memory_mb = int(rng.integers(900, 8000))
+    fleet = build_fleet(nodes)
+    view = build_usage(fleet, [])
+    g_pad = max(4, n_groups)
+    asks = np.zeros((g_pad, 6), dtype=np.float32)
+    for g in range(n_groups):
+        asks[g] = Resources(
+            cpu=int(rng.integers(50, 700)),
+            memory_mb=int(rng.integers(40, 900))).as_vector()
+    feasible = np.zeros((g_pad, fleet.n_pad), dtype=bool)
+    feasible[:n_groups, :fleet.n_real] = \
+        rng.random((n_groups, fleet.n_real)) > 0.2
+    distinct = rng.random(g_pad) > 0.7
+    group_idx = rng.integers(0, n_groups, n_place).astype(np.int32)
+    valid = np.ones(n_place, dtype=bool)
+    valid[-2:] = False
+    return fleet, view, asks, feasible, distinct, group_idx, valid
+
+
+def test_host_place_sequence_parity():
+    from nomad_tpu.ops.binpack_host import place_sequence_host
+
+    rng = np.random.default_rng(7)
+    for trial in range(4):
+        fleet, view, asks, feasible, distinct, group_idx, valid = \
+            _random_case(rng)
+        dev = place_sequence(
+            fleet.capacity, fleet.reserved, view.usage, view.job_counts,
+            feasible, asks, distinct, group_idx, valid, 10.0)
+        host = place_sequence_host(
+            fleet.capacity, fleet.reserved, view.usage, view.job_counts,
+            feasible, asks, distinct, group_idx, valid, 10.0)
+        dev_chosen = np.asarray(dev[0])
+        assert np.array_equal(dev_chosen, host[0]), trial
+        placed = dev_chosen >= 0  # scores are meaningless where -1
+        np.testing.assert_allclose(np.asarray(dev[1])[placed],
+                                   host[1][placed], rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(dev[2]), host[2],
+                                   rtol=1e-5, atol=1e-3)
+
+
+def test_host_place_rounds_parity():
+    from nomad_tpu.ops.binpack import place_rounds
+    from nomad_tpu.ops.binpack_host import place_rounds_host
+
+    rng = np.random.default_rng(11)
+    for trial in range(4):
+        fleet, view, asks, feasible, distinct, _gi, _v = \
+            _random_case(rng)
+        counts = np.zeros(asks.shape[0], dtype=np.int32)
+        counts[:3] = rng.integers(1, 9, 3)
+        dev = place_rounds(
+            fleet.capacity, fleet.reserved, view.usage, view.job_counts,
+            feasible, asks, distinct, counts, 10.0, k_cap=4, rounds=3)
+        host = place_rounds_host(
+            fleet.capacity, fleet.reserved, view.usage, view.job_counts,
+            feasible, asks, distinct, counts, 10.0, k_cap=4, rounds=3)
+        assert np.array_equal(np.asarray(dev[0]), host[0]), trial
+        np.testing.assert_allclose(np.asarray(dev[2]), host[2],
+                                   rtol=1e-5, atol=1e-3)
+
+
+def test_small_eval_uses_host_executor(monkeypatch):
+    """Tiny fleets must never pay a device dispatch: the executor policy
+    routes them to the numpy kernels."""
+    import nomad_tpu.scheduler.jax_binpack as jb
+
+    def boom(*a, **k):
+        raise AssertionError("device dispatched for a tiny workload")
+
+    monkeypatch.setattr(jb, "place_sequence", boom)
+    monkeypatch.setattr(
+        "nomad_tpu.ops.binpack.place_rounds", boom)
+    h = Harness()
+    _register_cluster(h, 10)
+    job = mock.job()
+    job.task_groups[0].count = 5
+    h.state.upsert_job(h.next_index(), job)
+    h.process("jax-binpack", make_eval(job))
+    placed = sum(len(v) for v in h.plans[0].node_allocation.values())
+    assert placed == 5
+
+
+def test_large_eval_uses_device_when_pipelined():
+    """The policy must keep big pipelined workloads on the device."""
+    from nomad_tpu.scheduler.jax_binpack import DeviceArgs, \
+        JaxBinPackScheduler
+
+    class _S:
+        n_real = 20_000
+
+    args = DeviceArgs(statics=_S(), rounds_eligible=False,
+                      n_groups=64, n_place=1_000, rounds=1)
+    sched = JaxBinPackScheduler.__new__(JaxBinPackScheduler)
+    assert not sched.choose_host_executor(args, pipelined=True)
+    # Single-shot: same workload prefers the host (one RTT >> numpy).
+    assert sched.choose_host_executor(args, pipelined=False)
+
+
+def test_fast_proto_matches_dataclass():
+    """The template constructor (finish loop hot path) must stay
+    field-for-field identical to the dataclass constructor."""
+    import dataclasses
+
+    from nomad_tpu.scheduler.jax_binpack import (_ALLOC_FACTORIES,
+                                                 _ALLOC_STATIC,
+                                                 _METRIC_FACTORIES,
+                                                 _METRIC_STATIC)
+    from nomad_tpu.structs import AllocMetric
+
+    for cls, static, factories in (
+            (Allocation, _ALLOC_STATIC, _ALLOC_FACTORIES),
+            (AllocMetric, _METRIC_STATIC, _METRIC_FACTORIES)):
+        names = {f.name for f in dataclasses.fields(cls)}
+        assert set(static) | {n for n, _ in factories} == names
+        d = dict(static)
+        for n, fac in factories:
+            d[n] = fac()
+        assert d == cls().__dict__
+
+    # The network fast path fills factory fields explicitly instead of
+    # looping; it must fail loudly if the dataclasses grow new ones.
+    from nomad_tpu.scheduler.jax_binpack import (_NET_FACTORIES,
+                                                 _RES_FACTORIES)
+
+    assert {n for n, _ in _RES_FACTORIES} == {"networks"}
+    assert {n for n, _ in _NET_FACTORIES} == {"reserved_ports",
+                                              "dynamic_ports"}
+
+
+def test_host_place_rounds_tie_parity():
+    """Homogeneous fleets tie on every score — the common case for a
+    fresh cluster of identical nodes.  Host and device top-k must break
+    ties the same way (lowest node index first) or the executor policy
+    would change placements (code-review regression)."""
+    from nomad_tpu.ops.binpack import place_rounds
+    from nomad_tpu.ops.binpack_host import place_rounds_host
+
+    nodes = [mock.node(i) for i in range(33)]  # identical resources
+    fleet = build_fleet(nodes)
+    view = build_usage(fleet, [])
+    asks = np.zeros((4, 6), dtype=np.float32)
+    asks[0] = Resources(cpu=100, memory_mb=64).as_vector()
+    feasible = np.zeros((4, fleet.n_pad), dtype=bool)
+    feasible[0, :fleet.n_real] = True
+    distinct = np.zeros(4, dtype=bool)
+    counts = np.zeros(4, dtype=np.int32)
+    counts[0] = 8
+    dev = place_rounds(
+        fleet.capacity, fleet.reserved, view.usage, view.job_counts,
+        feasible, asks, distinct, counts, 10.0, k_cap=4, rounds=3)
+    host = place_rounds_host(
+        fleet.capacity, fleet.reserved, view.usage, view.job_counts,
+        feasible, asks, distinct, counts, 10.0, k_cap=4, rounds=3,
+        n_real=fleet.n_real)
+    assert np.array_equal(np.asarray(dev[0]), host[0])
+    assert np.asarray(dev[2]).shape == host[2].shape

@@ -310,6 +310,29 @@ class TestServerEndToEnd:
         finally:
             srv.shutdown()
 
+    def test_device_unavailable_falls_back_to_sequential(self,
+                                                         monkeypatch):
+        """A broken device backend degrades to the sequential schedulers
+        instead of failing every eval into the delivery-limit reaper."""
+        import nomad_tpu.scheduler as sched_registry
+        from nomad_tpu.server.worker import BatchWorker
+
+        monkeypatch.setattr(sched_registry, "device_available",
+                            lambda: False)
+        srv = make_server(use_device_scheduler=True)
+        try:
+            assert not srv.config.use_device_scheduler
+            assert not any(isinstance(w, BatchWorker)
+                           for w in srv.workers)
+            srv.node_register(mock.node(0))
+            job = mock.job()
+            _, eval_id = srv.job_register(job)
+            statuses = srv.wait_for_evals([eval_id], timeout=15)
+            assert statuses[eval_id] == "complete"
+            assert srv.fsm.state.allocs_by_job(job.id)
+        finally:
+            srv.shutdown()
+
     def test_concurrent_jobs_no_oversubscription(self):
         from nomad_tpu.structs import allocs_fit
 
