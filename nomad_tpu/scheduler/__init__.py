@@ -27,6 +27,10 @@ from .stack import GenericStack, SystemStack  # noqa: F401
 register_scheduler("service", new_service_scheduler)
 register_scheduler("batch", new_batch_scheduler)
 register_scheduler("system", new_system_scheduler)
+# The sequential iterator-chain system scheduler stays addressable for
+# golden-parity tests; "system" is rebound to the vectorized one below
+# when the array stack imports.
+register_scheduler("system-seq", new_system_scheduler)
 
 
 def _register_jax() -> None:
@@ -35,10 +39,12 @@ def _register_jax() -> None:
             new_jax_binpack_batch_scheduler,
             new_jax_binpack_scheduler,
         )
+        from .system_vec import new_vector_system_scheduler
     except ImportError:  # pragma: no cover - jax always present in CI
         return
     register_scheduler("jax-binpack", new_jax_binpack_scheduler)
     register_scheduler("jax-binpack-batch", new_jax_binpack_batch_scheduler)
+    register_scheduler("system", new_vector_system_scheduler)
     global BatchEvalRunner
     from .batch import BatchEvalRunner  # noqa: F401
 

@@ -135,19 +135,12 @@ class DeviceArgs:
             setattr(self, k, v)
 
 
-class JaxBinPackScheduler(GenericScheduler):
-    """GenericScheduler with the placement hot loop moved to TPU.
-
-    ``defer_device=True`` pauses after argument preparation so a batch
-    driver (nomad_tpu/scheduler/batch.py) can fuse many evals into one
-    device dispatch; ``finish_deferred`` resumes with the device results.
-    """
-
-    defer_device = False
-
-    def __init__(self, state, planner, batch: bool) -> None:
-        super().__init__(state, planner, batch)
-        self.deferred: tuple | None = None  # (place, DeviceArgs)
+class FastPlacementMixin:
+    """Host-side placement machinery shared by the device-backed generic
+    scheduler and the vectorized system scheduler: fleet-wide proposed
+    allocs, exact + O(1) network assignment, and post-divergence fit
+    re-checks.  Host classes provide self.state/self.plan/self.ctx and
+    per-eval `_statics`/`_net_cache`/`_node_net`/`_port_lcg`."""
 
     def _proposed_allocs_all(self) -> list:
         """All non-terminal allocs under the in-flight plan: existing minus
@@ -161,6 +154,202 @@ class JaxBinPackScheduler(GenericScheduler):
         for placements in self.plan.node_allocation.values():
             allocs.extend(placements)
         return allocs
+
+    def _node_net_init(self, node_index: int, node):
+        """Fast per-node network state: [used_ports, bw_used, bw_avail,
+        ip, device], or None when the topology needs the exact path
+        (multi-network nodes).  The reserved-only base is node-static and
+        cached on the fleet statics; per-eval state adds proposed allocs'
+        offers on top."""
+        base_cache = self._statics.net_base
+        base = base_cache.get(node_index, False)
+        if base is False:
+            base = None
+            nets = [n for n in node.resources.networks if n.device] \
+                if node.resources is not None else []
+            if len(nets) == 1:
+                n0 = nets[0]
+                ip = n0.ip
+                if not ip:
+                    for ip in _cidr_ips(n0.cidr):
+                        break
+                if ip:
+                    used: set = set()
+                    bw_used = 0
+                    if node.reserved is not None:
+                        for rn in node.reserved.networks:
+                            used.update(rn.reserved_ports)
+                            bw_used += rn.mbits
+                    base = (frozenset(used), bw_used, n0.mbits, ip,
+                            n0.device)
+            base_cache[node_index] = base
+        if base is None:
+            return None
+        used = set(base[0])
+        bw_used = base[1]
+        # O(1) emptiness probes (live, not precomputed: the plan grows
+        # during the finish loop): only nodes with store allocs or plan
+        # deltas need the exact proposed-alloc walk.
+        node_id = node.id
+        plan = self.plan
+        if self.state.has_allocs_on_node(node_id) or \
+                node_id in plan.node_update or \
+                node_id in plan.node_allocation:
+            for alloc in self.ctx.proposed_allocs(node_id):
+                for tr in alloc.task_resources.values():
+                    for offer in tr.networks:
+                        used.update(offer.reserved_ports)
+                        bw_used += offer.mbits
+        return [used, bw_used, base[2], base[3], base[4]]
+
+    def _assign_networks_fast(self, node_index: int, node, plan_tasks):
+        """O(1) port/bandwidth assignment for single-network dynamic-port
+        asks.  Returns task name -> Resources, or None to trigger the
+        sequential fallback (exact semantics preserved: bandwidth bound +
+        port uniqueness per node IP, reference nomad/structs/network.go)."""
+        st = self._node_net.get(node_index)
+        if st is None:
+            st = self._node_net_init(node_index, node)
+            if st is None:
+                # Complex topology: exact path.
+                return self._assign_networks(
+                    node, None, plan_tasks=plan_tasks)
+            self._node_net[node_index] = st
+        used, bw_used, bw_avail, ip, device = st
+
+        out = {}
+        span = MAX_DYNAMIC_PORT - MIN_DYNAMIC_PORT
+        staged_bw = 0
+        mirrored = []   # offers mirrored into the cached exact-path index
+        net_cache = self._net_cache
+        for name, res, ask in plan_tasks:
+            if ask is None:
+                r = Resources.__new__(Resources)
+                r.__dict__ = dict(
+                    _RES_STATIC, networks=[],
+                    cpu=res.cpu, memory_mb=res.memory_mb,
+                    disk_mb=res.disk_mb, iops=res.iops) \
+                    if res is not None else dict(_RES_STATIC, networks=[])
+                out[name] = r
+                continue
+            if bw_used + staged_bw + ask.mbits > bw_avail:
+                # Roll back staged ports — and the offers already mirrored
+                # into the cached exact-path NetworkIndex, which would
+                # otherwise carry phantom reservations into later
+                # exact-path assignments on this node.
+                for tr in out.values():
+                    for offer in tr.networks:
+                        used.difference_update(offer.reserved_ports)
+                for offer in mirrored:
+                    net_cache[node.id].remove_reserved(offer)
+                return None
+            ports = []
+            lcg = self._port_lcg
+            for _label in ask.dynamic_ports:
+                # LCG instead of random.randrange: one multiply per port
+                # (the plan seed is random, spreading ports like the
+                # reference's random picks; exact value is untested API).
+                lcg = (lcg * 1103515245 + 12345) & 0x3FFFFFFF
+                port = MIN_DYNAMIC_PORT + lcg % span
+                while port in used:
+                    port = MIN_DYNAMIC_PORT + (port - MIN_DYNAMIC_PORT
+                                               + 1) % span
+                used.add(port)
+                ports.append(port)
+            self._port_lcg = lcg
+            offer = NetworkResource.__new__(NetworkResource)
+            offer.__dict__ = dict(
+                _NET_STATIC, device=device, ip=ip, mbits=ask.mbits,
+                reserved_ports=ports,
+                dynamic_ports=list(ask.dynamic_ports))
+            staged_bw += ask.mbits
+            r = Resources.__new__(Resources)
+            r.__dict__ = dict(
+                _RES_STATIC, cpu=res.cpu, memory_mb=res.memory_mb,
+                disk_mb=res.disk_mb, iops=res.iops, networks=[offer])
+            out[name] = r
+            # Keep an exact-path NetworkIndex for this node (if one was
+            # built for a non-fast slot) coherent with our offers.
+            if net_cache:
+                idx = net_cache.get(node.id)
+                if idx is not None:
+                    idx.add_reserved(offer)
+                    mirrored.append(offer)
+        st[1] = bw_used + staged_bw
+        return out
+
+    def _node_index_of(self, node) -> int:
+        statics = getattr(self, "_statics", None)
+        if statics is not None:
+            return statics.index_of.get(node.id, -1)
+        return -1
+
+    def _still_fits(self, node, size) -> bool:
+        """Exact host-side allocs_fit re-check, used after the plan has
+        deviated from the device scan's usage accounting."""
+        proposed = self.ctx.proposed_allocs(node.id)
+        fit, _dim, _util = allocs_fit(
+            node, proposed + [Allocation(resources=size)])
+        return fit
+
+    def _assign_networks(self, node, tg, plan_tasks=None):
+        """Exact host-side port/bandwidth assignment on the device winner
+        (BinPackIterator parity, reference scheduler/rank.go:180-205).
+        Returns task name -> Resources, or None if the node can't take it."""
+        cache = getattr(self, "_net_cache", None)
+        net_idx = cache.get(node.id) if cache is not None else None
+        if net_idx is None:
+            net_idx = NetworkIndex()
+            net_idx.set_node(node)
+            net_idx.add_allocs(self.ctx.proposed_allocs(node.id))
+            if cache is not None:
+                cache[node.id] = net_idx
+        if plan_tasks is not None:
+            items = [(name, res) for name, res, _ask in plan_tasks]
+        else:
+            items = [(t.name, t.resources) for t in tg.tasks]
+        staged = []
+        out = {}
+        for task_name, res in items:
+            task_resources = res.copy() if res is not None else Resources()
+            if task_resources.networks:
+                ask = task_resources.networks[0]
+                offer, _err = net_idx.assign_network(ask)
+                if offer is None:
+                    # Roll back offers staged for earlier tasks of this
+                    # group so the cached index stays consistent.
+                    for o in staged:
+                        net_idx.remove_reserved(o)
+                    return None
+                net_idx.add_reserved(offer)
+                staged.append(offer)
+                task_resources.networks = [offer]
+            out[task_name] = task_resources
+        # Keep the fast per-node state (if built) coherent with these
+        # exact-path offers.
+        node_net = getattr(self, "_node_net", None)
+        if node_net:
+            st = node_net.get(self._node_index_of(node))
+            if st is not None:
+                for o in staged:
+                    st[0].update(o.reserved_ports)
+                    st[1] += o.mbits
+        return out
+
+
+class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
+    """GenericScheduler with the placement hot loop moved to TPU.
+
+    ``defer_device=True`` pauses after argument preparation so a batch
+    driver (nomad_tpu/scheduler/batch.py) can fuse many evals into one
+    device dispatch; ``finish_deferred`` resumes with the device results.
+    """
+
+    defer_device = False
+
+    def __init__(self, state, planner, batch: bool) -> None:
+        super().__init__(state, planner, batch)
+        self.deferred: tuple | None = None  # (place, DeviceArgs)
 
     def _compute_placements(self, place: list) -> None:
         args = self._prepare_device(place)
@@ -594,187 +783,6 @@ class JaxBinPackScheduler(GenericScheduler):
                 alloc.__dict__ = d
                 plan.append_failed(alloc)
                 failed_tg[id(tg)] = alloc
-
-    def _node_net_init(self, node_index: int, node):
-        """Fast per-node network state: [used_ports, bw_used, bw_avail,
-        ip, device], or None when the topology needs the exact path
-        (multi-network nodes).  The reserved-only base is node-static and
-        cached on the fleet statics; per-eval state adds proposed allocs'
-        offers on top."""
-        base_cache = self._statics.net_base
-        base = base_cache.get(node_index, False)
-        if base is False:
-            base = None
-            nets = [n for n in node.resources.networks if n.device] \
-                if node.resources is not None else []
-            if len(nets) == 1:
-                n0 = nets[0]
-                ip = n0.ip
-                if not ip:
-                    for ip in _cidr_ips(n0.cidr):
-                        break
-                if ip:
-                    used: set = set()
-                    bw_used = 0
-                    if node.reserved is not None:
-                        for rn in node.reserved.networks:
-                            used.update(rn.reserved_ports)
-                            bw_used += rn.mbits
-                    base = (frozenset(used), bw_used, n0.mbits, ip,
-                            n0.device)
-            base_cache[node_index] = base
-        if base is None:
-            return None
-        used = set(base[0])
-        bw_used = base[1]
-        # O(1) emptiness probes (live, not precomputed: the plan grows
-        # during the finish loop): only nodes with store allocs or plan
-        # deltas need the exact proposed-alloc walk.
-        node_id = node.id
-        plan = self.plan
-        if self.state.has_allocs_on_node(node_id) or \
-                node_id in plan.node_update or \
-                node_id in plan.node_allocation:
-            for alloc in self.ctx.proposed_allocs(node_id):
-                for tr in alloc.task_resources.values():
-                    for offer in tr.networks:
-                        used.update(offer.reserved_ports)
-                        bw_used += offer.mbits
-        return [used, bw_used, base[2], base[3], base[4]]
-
-    def _assign_networks_fast(self, node_index: int, node, plan_tasks):
-        """O(1) port/bandwidth assignment for single-network dynamic-port
-        asks.  Returns task name -> Resources, or None to trigger the
-        sequential fallback (exact semantics preserved: bandwidth bound +
-        port uniqueness per node IP, reference nomad/structs/network.go)."""
-        st = self._node_net.get(node_index)
-        if st is None:
-            st = self._node_net_init(node_index, node)
-            if st is None:
-                # Complex topology: exact path.
-                return self._assign_networks(
-                    node, None, plan_tasks=plan_tasks)
-            self._node_net[node_index] = st
-        used, bw_used, bw_avail, ip, device = st
-
-        out = {}
-        span = MAX_DYNAMIC_PORT - MIN_DYNAMIC_PORT
-        staged_bw = 0
-        mirrored = []   # offers mirrored into the cached exact-path index
-        net_cache = self._net_cache
-        for name, res, ask in plan_tasks:
-            if ask is None:
-                r = Resources.__new__(Resources)
-                r.__dict__ = dict(
-                    _RES_STATIC, networks=[],
-                    cpu=res.cpu, memory_mb=res.memory_mb,
-                    disk_mb=res.disk_mb, iops=res.iops) \
-                    if res is not None else dict(_RES_STATIC, networks=[])
-                out[name] = r
-                continue
-            if bw_used + staged_bw + ask.mbits > bw_avail:
-                # Roll back staged ports — and the offers already mirrored
-                # into the cached exact-path NetworkIndex, which would
-                # otherwise carry phantom reservations into later
-                # exact-path assignments on this node.
-                for tr in out.values():
-                    for offer in tr.networks:
-                        used.difference_update(offer.reserved_ports)
-                for offer in mirrored:
-                    net_cache[node.id].remove_reserved(offer)
-                return None
-            ports = []
-            lcg = self._port_lcg
-            for _label in ask.dynamic_ports:
-                # LCG instead of random.randrange: one multiply per port
-                # (the plan seed is random, spreading ports like the
-                # reference's random picks; exact value is untested API).
-                lcg = (lcg * 1103515245 + 12345) & 0x3FFFFFFF
-                port = MIN_DYNAMIC_PORT + lcg % span
-                while port in used:
-                    port = MIN_DYNAMIC_PORT + (port - MIN_DYNAMIC_PORT
-                                               + 1) % span
-                used.add(port)
-                ports.append(port)
-            self._port_lcg = lcg
-            offer = NetworkResource.__new__(NetworkResource)
-            offer.__dict__ = dict(
-                _NET_STATIC, device=device, ip=ip, mbits=ask.mbits,
-                reserved_ports=ports,
-                dynamic_ports=list(ask.dynamic_ports))
-            staged_bw += ask.mbits
-            r = Resources.__new__(Resources)
-            r.__dict__ = dict(
-                _RES_STATIC, cpu=res.cpu, memory_mb=res.memory_mb,
-                disk_mb=res.disk_mb, iops=res.iops, networks=[offer])
-            out[name] = r
-            # Keep an exact-path NetworkIndex for this node (if one was
-            # built for a non-fast slot) coherent with our offers.
-            if net_cache:
-                idx = net_cache.get(node.id)
-                if idx is not None:
-                    idx.add_reserved(offer)
-                    mirrored.append(offer)
-        st[1] = bw_used + staged_bw
-        return out
-
-    def _node_index_of(self, node) -> int:
-        statics = getattr(self, "_statics", None)
-        if statics is not None:
-            return statics.index_of.get(node.id, -1)
-        return -1
-
-    def _still_fits(self, node, size) -> bool:
-        """Exact host-side allocs_fit re-check, used after the plan has
-        deviated from the device scan's usage accounting."""
-        proposed = self.ctx.proposed_allocs(node.id)
-        fit, _dim, _util = allocs_fit(
-            node, proposed + [Allocation(resources=size)])
-        return fit
-
-    def _assign_networks(self, node, tg, plan_tasks=None):
-        """Exact host-side port/bandwidth assignment on the device winner
-        (BinPackIterator parity, reference scheduler/rank.go:180-205).
-        Returns task name -> Resources, or None if the node can't take it."""
-        cache = getattr(self, "_net_cache", None)
-        net_idx = cache.get(node.id) if cache is not None else None
-        if net_idx is None:
-            net_idx = NetworkIndex()
-            net_idx.set_node(node)
-            net_idx.add_allocs(self.ctx.proposed_allocs(node.id))
-            if cache is not None:
-                cache[node.id] = net_idx
-        if plan_tasks is not None:
-            items = [(name, res) for name, res, _ask in plan_tasks]
-        else:
-            items = [(t.name, t.resources) for t in tg.tasks]
-        staged = []
-        out = {}
-        for task_name, res in items:
-            task_resources = res.copy() if res is not None else Resources()
-            if task_resources.networks:
-                ask = task_resources.networks[0]
-                offer, _err = net_idx.assign_network(ask)
-                if offer is None:
-                    # Roll back offers staged for earlier tasks of this
-                    # group so the cached index stays consistent.
-                    for o in staged:
-                        net_idx.remove_reserved(o)
-                    return None
-                net_idx.add_reserved(offer)
-                staged.append(offer)
-                task_resources.networks = [offer]
-            out[task_name] = task_resources
-        # Keep the fast per-node state (if built) coherent with these
-        # exact-path offers.
-        node_net = getattr(self, "_node_net", None)
-        if node_net:
-            st = node_net.get(self._node_index_of(node))
-            if st is not None:
-                for o in staged:
-                    st[0].update(o.reserved_ports)
-                    st[1] += o.mbits
-        return out
 
 
 def rounds_to_placements(args: DeviceArgs, chosen_slots: np.ndarray,

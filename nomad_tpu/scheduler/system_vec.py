@@ -1,0 +1,179 @@
+"""Vectorized system scheduler: node-pinned placement without the
+per-node iterator walk.
+
+Capability parity with /root/reference/scheduler/system_sched.go via the
+same reconcile logic as the sequential SystemScheduler (diff_system_allocs
+etc. — inherited unchanged), but ``_compute_placements`` is re-expressed
+TPU-style: the per-task-group feasibility mask is compiled once over the
+whole fleet (nomad_tpu/models/constraints.py, the same compiler the
+jax-binpack path uses), fit is one vector compare against the fleet
+tensors, and the ScoreFit scalar is computed from the same rows — instead
+of running the SystemStack iterator chain once per node (O(nodes) chain
+setups per eval; this is what made a 1k-node system eval cost ~40 ms).
+
+System placements are *node-pinned* (diff_system_allocs names the node for
+every missing alloc), so there is no argmax over the fleet — the device
+has nothing to win here and every placement decision is O(D) host math.
+The shared FastPlacementMixin supplies the exact port/bandwidth
+assignment, so plans are exactly as valid as the sequential scheduler's
+(parity-tested in tests/test_system_vec.py).
+"""
+from __future__ import annotations
+
+from random import randrange as _randrange
+
+import numpy as np
+
+from nomad_tpu.models.constraints import compile_group_mask
+from nomad_tpu.models.fleet import build_usage, fleet_cache, mirror_for
+from nomad_tpu.structs import (
+    ALLOC_CLIENT_STATUS_FAILED,
+    ALLOC_CLIENT_STATUS_PENDING,
+    ALLOC_DESIRED_STATUS_FAILED,
+    ALLOC_DESIRED_STATUS_RUN,
+    AllocMetric,
+    Allocation,
+    generate_uuids,
+)
+
+from .jax_binpack import (
+    _ALLOC_STATIC,
+    _METRIC_FACTORIES,
+    _METRIC_STATIC,
+    FastPlacementMixin,
+    _net_plan_for,
+)
+from .system import SystemScheduler
+from .util import task_group_constraints
+
+
+class VectorSystemScheduler(SystemScheduler, FastPlacementMixin):
+    def _compute_placements(self, place: list) -> None:
+        import time
+
+        start = time.perf_counter()
+        statics = fleet_cache.statics_for(self.state)
+        view = mirror_for(statics).view_at(self.state, self.plan,
+                                           self.job.id)
+        if view is None:
+            view = build_usage(statics, self._proposed_allocs_all(),
+                               job_id=self.job.id)
+
+        # Per-unique-TG compilation (system jobs typically have few TGs).
+        tg_info: dict = {}  # id(tg) -> (mask, dist, ask_vec, size, plan)
+        for missing in place:
+            tg = missing.task_group
+            if id(tg) in tg_info:
+                continue
+            tg_constr = task_group_constraints(tg)
+            mask, dist = compile_group_mask(
+                statics, self.job.datacenters, self.job.constraints,
+                tg_constr.constraints, tg_constr.drivers)
+            ask_vec = np.asarray(tg_constr.size.as_vector(),
+                                 dtype=np.float32)
+            tg_info[id(tg)] = (mask, dist, ask_vec, tg_constr.size,
+                               _net_plan_for(tg))
+
+        capacity = statics.capacity
+        reserved = statics.reserved
+        usage = view.usage.copy()       # accumulates as we place
+        jc = view.job_counts.copy()
+        index_of = statics.index_of
+        nodes_arr = statics.nodes
+        n_real = statics.n_real
+
+        self._net_cache = {}
+        self._node_net = {}
+        self._statics = statics
+        self._port_lcg = _randrange(1 << 30)
+
+        plan = self.plan
+        eval_id = self.eval.id
+        job = self.job
+        uuids = generate_uuids(len(place))
+        per_time = (time.perf_counter() - start) / max(1, len(place))
+        metric_proto = dict(_METRIC_STATIC, nodes_evaluated=1,
+                            allocation_time=per_time)
+        alloc_proto = dict(_ALLOC_STATIC, eval_id=eval_id, job_id=job.id,
+                           job=job)
+        failed_tg: dict = {}
+
+        for p, missing in enumerate(place):
+            tg = missing.task_group
+            mask, dist, ask_vec, size, net_plan = tg_info[id(tg)]
+            ni = index_of.get(missing.alloc.node_id, -1)
+            if ni < 0:
+                raise KeyError(
+                    f"could not find node {missing.alloc.node_id!r}")
+
+            node = nodes_arr[ni]
+            task_resources = None
+            score = 0.0
+            ok = bool(mask[ni]) and ni < n_real and \
+                not (dist and jc[ni] > 0)
+            if ok:
+                util = reserved[ni] + usage[ni] + ask_vec
+                ok = bool((util <= capacity[ni]).all())
+                if ok:
+                    # ScoreFit (BestFit v3) on the same rows the device
+                    # kernel uses (structs/funcs score_fit parity).
+                    node_cpu = capacity[ni, 0] - reserved[ni, 0]
+                    node_mem = capacity[ni, 1] - reserved[ni, 1]
+                    if node_cpu > 0 and node_mem > 0:
+                        score = 20.0 - (
+                            10.0 ** (1.0 - util[0] / node_cpu)
+                            + 10.0 ** (1.0 - util[1] / node_mem))
+                        score = min(max(score, 0.0), 18.0)
+            if ok:
+                fast_ok, plan_tasks = net_plan
+                if fast_ok:
+                    task_resources = self._assign_networks_fast(
+                        ni, node, plan_tasks)
+                else:
+                    task_resources = self._assign_networks(node, tg)
+                ok = task_resources is not None
+
+            if not ok:
+                prior_fail = failed_tg.get(id(tg))
+                if prior_fail is not None:
+                    prior_fail.metrics.coalesced_failures += 1
+                    continue
+
+            m = AllocMetric.__new__(AllocMetric)
+            md = dict(metric_proto)
+            for nm, fac in _METRIC_FACTORIES:
+                md[nm] = fac()
+            alloc = Allocation.__new__(Allocation)
+            d = dict(alloc_proto)
+            d["id"] = uuids[p]
+            d["name"] = missing.name
+            d["task_group"] = tg.name
+            d["resources"] = size
+            d["metrics"] = m
+            d["task_states"] = {}
+            if ok:
+                md["scores"] = {node.id + ".binpack": float(score)}
+                d["node_id"] = node.id
+                d["task_resources"] = task_resources
+                d["desired_status"] = ALLOC_DESIRED_STATUS_RUN
+                d["client_status"] = ALLOC_CLIENT_STATUS_PENDING
+                m.__dict__ = md
+                alloc.__dict__ = d
+                plan.append_alloc(alloc)
+                usage[ni] += ask_vec
+                jc[ni] += 1
+            else:
+                md["nodes_filtered"] = 1
+                d["task_resources"] = {}
+                d["desired_status"] = ALLOC_DESIRED_STATUS_FAILED
+                d["desired_description"] = \
+                    "failed to find a node for placement"
+                d["client_status"] = ALLOC_CLIENT_STATUS_FAILED
+                m.__dict__ = md
+                alloc.__dict__ = d
+                plan.append_failed(alloc)
+                failed_tg[id(tg)] = alloc
+
+
+def new_vector_system_scheduler(state, planner) -> VectorSystemScheduler:
+    return VectorSystemScheduler(state, planner)
