@@ -233,8 +233,29 @@ class HTTPServer:
                          "addr": list(agent.server.rpc_address() or ())}]
             return 200, {"members": members}, None
         if parts == ["agent", "servers"]:
+            if method in ("PUT", "POST"):
+                # Update the client's server list (reference
+                # agent_endpoint.go updateServers).
+                if agent.client is None:
+                    raise BadRequest("agent is not running in client mode")
+                raw_list = body if isinstance(body, list) else \
+                    (body or {}).get("servers", [])
+                parsed = []
+                for spec in raw_list:
+                    if isinstance(spec, (list, tuple)) and len(spec) == 2:
+                        parsed.append((spec[0], int(spec[1])))
+                        continue
+                    host, _, port = str(spec).rpartition(":")
+                    if not host or not port.isdigit():
+                        raise BadRequest(
+                            f"invalid server address {spec!r}")
+                    parsed.append((host, int(port)))
+                if not parsed:
+                    raise BadRequest("no server addresses given")
+                agent.client.set_servers(parsed)
+                return 200, {}, None
             if agent.client is not None:
-                servers = [list(s) for s in agent.client.config.servers]
+                servers = [list(s) for s in agent.client.servers()]
             elif agent.server is not None:
                 servers = [list(p) for p in agent.server.peers()]
             else:
@@ -251,7 +272,8 @@ class HTTPServer:
             n = agent.join(target)
             return 200, {"num_joined": n}, None
         if parts == ["agent", "force-leave"]:
-            name = query.get("node", "")
+            name = query.get("node") or \
+                (body.get("node", "") if isinstance(body, dict) else "")
             if agent.server is not None and \
                     getattr(agent.server, "gossip", None) is not None:
                 agent.server.gossip.force_leave(name)

@@ -187,6 +187,13 @@ class APIClient:
     def agent_force_leave(self, node: str) -> None:
         self.raw("PUT", "/v1/agent/force-leave", {"node": node})
 
+    def agent_servers(self) -> list:
+        data, _ = self.get("/v1/agent/servers")
+        return data
+
+    def agent_set_servers(self, servers: list) -> None:
+        self.put("/v1/agent/servers", {"servers": list(servers)})
+
     def status_leader(self) -> str:
         data, _ = self.get("/v1/status/leader")
         return data

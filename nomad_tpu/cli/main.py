@@ -127,6 +127,13 @@ def main(argv=None) -> int:
     sub.add_parser("server-members", help="list cluster servers")
     p = sub.add_parser("server-join", help="join a server")
     p.add_argument("join_address")
+    p = sub.add_parser("server-force-leave",
+                       help="force a gossip member into left state")
+    p.add_argument("member_name")
+    p = sub.add_parser("client-config",
+                       help="view or update the client's server list")
+    p.add_argument("-update-servers", dest="update_servers", default="",
+                   help="comma-separated host:port list to switch to")
     sub.add_parser("agent-info", help="agent diagnostics")
     sub.add_parser("version", help="print version")
 
@@ -402,6 +409,30 @@ def cmd_server_join(args) -> int:
     return 0
 
 
+def cmd_server_force_leave(args) -> int:
+    """Force a gossip member into the left state (reference
+    command/server_force_leave.go)."""
+    client = APIClient(args.address)
+    client.agent_force_leave(args.member_name)
+    print(f"Forced leave of member {args.member_name!r}")
+    return 0
+
+
+def cmd_client_config(args) -> int:
+    """View or update the client's server list (reference
+    command/client_config.go)."""
+    client = APIClient(args.address)
+    if args.update_servers:
+        servers = [s.strip() for s in args.update_servers.split(",")
+                   if s.strip()]
+        client.agent_set_servers(servers)
+        print(f"Updated server list ({len(servers)} servers)")
+        return 0
+    for host, port in client.agent_servers():
+        print(f"{host}:{port}")
+    return 0
+
+
 def cmd_agent_info(args) -> int:
     client = APIClient(args.address)
     print(json.dumps(client.agent_self(), indent=2, default=str))
@@ -426,6 +457,8 @@ COMMANDS = {
     "alloc-status": cmd_alloc_status,
     "server-members": cmd_server_members,
     "server-join": cmd_server_join,
+    "server-force-leave": cmd_server_force_leave,
+    "client-config": cmd_client_config,
     "agent-info": cmd_agent_info,
     "version": cmd_version,
 }

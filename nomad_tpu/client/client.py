@@ -79,6 +79,20 @@ class Client:
 
         self._restore_state()
 
+    def servers(self) -> list:
+        """The RPC server list (reference client_config.go surface)."""
+        if isinstance(self.rpc, NetRPCHandler):
+            return list(self.rpc.servers)
+        return list(self.config.servers)
+
+    def set_servers(self, servers: list) -> None:
+        """Swap the RPC server list at runtime (reference
+        command/agent agent servers endpoint + client_config.go)."""
+        parsed = [tuple(s) for s in servers]
+        self.config.servers = list(parsed)
+        if isinstance(self.rpc, NetRPCHandler):
+            self.rpc.servers = parsed
+
     # -- setup -------------------------------------------------------------
     def _setup_node(self) -> None:
         node = self.node

@@ -320,6 +320,28 @@ class TestCLI:
         code, out = self.run_cli(dev_agent, "version")
         assert code == 0 and "nomad-tpu v" in out
 
+    def test_client_config_view_and_update(self, dev_agent):
+        agent, _ = dev_agent
+        # Dev agent uses in-proc RPC; the config list is what's shown.
+        code, out = self.run_cli(
+            dev_agent, "client-config",
+            "-update-servers", "10.0.0.9:4647,10.0.0.10:4647")
+        assert code == 0, out
+        assert "2 servers" in out
+        assert agent.client.servers() == [("10.0.0.9", 4647),
+                                          ("10.0.0.10", 4647)]
+        code, out = self.run_cli(dev_agent, "client-config")
+        assert code == 0
+        assert "10.0.0.9:4647" in out and "10.0.0.10:4647" in out
+
+    def test_server_force_leave_cli(self, dev_agent):
+        # No gossip plane on the dev agent: the command still succeeds
+        # as a no-op (parity with the reference's idempotent force-leave).
+        code, out = self.run_cli(dev_agent, "server-force-leave",
+                                 "nonexistent-member")
+        assert code == 0
+        assert "Forced leave" in out
+
     def test_node_status(self, dev_agent):
         code, out = self.run_cli(dev_agent, "node-status")
         assert code == 0
