@@ -352,15 +352,15 @@ def main() -> None:
         "single_eval_speedup": round(lat_seq / lat_dev, 2),
         "p99_ms": round(_p(dev_lats, 99), 2),
         "seq_p99_ms": round(_p(seq_lats, 99), 2),
-        "bottleneck": ("per-eval host work after the adaptive-executor + "
-                       "template-construction round: reconcile/diff "
-                       "~1.7ms, dispatch prep ~0.9ms, rounds kernel "
-                       "~0.7ms, finish loop (alloc construction + exact "
-                       "port assignment) ~7ms for 1k placements — "
-                       "single-threaded Python object construction is the "
-                       "remaining factor; the executor policy keeps this "
-                       "shape host-side because one remote-TPU round trip "
-                       "(~100ms) exceeds the whole eval"),
+        "bottleneck": ("per-eval host work: reconcile/diff ~1.7ms, "
+                       "dispatch prep ~0.9ms, rounds kernel ~0.7ms, "
+                       "native bulk finish (C alloc construction + port "
+                       "assignment, native/port_alloc.cpp) ~2ms for 1k "
+                       "placements, plan submit ~1ms; the executor "
+                       "policy keeps this shape host-side because one "
+                       "remote-TPU round trip (~100ms) exceeds the whole "
+                       "eval — the device carries the fused storm and "
+                       "multi-chip shapes instead"),
     }
     note(f"config4 {args.nodes}n x {args.groups}tg: stream "
          f"{len(jobs4) / dev_s:.1f} evals/s vs seq "

@@ -146,11 +146,767 @@ PyObject* add_all(PyObject*, PyObject* args) {
   return PyBool_FromLong(collide);
 }
 
+// ---------------------------------------------------------------------------
+// bulk_finish: the scheduler finish loop's happy path in C.
+//
+// nomad_tpu/scheduler/jax_binpack.py finish_deferred constructs one
+// Allocation (+ AllocMetric, Resources, NetworkResource, port picks) per
+// placement; at 1k placements/eval the CPython interpreter overhead of
+// that loop dominates the whole evaluation.  This function executes the
+// same per-placement steps through the C API.  It processes a PREFIX of
+// the placement list and stops (returning how far it got) at the first
+// case that needs Python-side handling — complex network topology,
+// bandwidth overflow (divergence fallback), CIDR-derived IPs — so the
+// Python general loop resumes exactly where C left off.  Semantics are
+// kept bit-identical (same LCG port stream, same dict layouts); parity
+// is asserted by tests/test_native_finish.py against a pure-Python run
+// with the same seed and uuids.
+// ---------------------------------------------------------------------------
+
+struct Interned {
+  PyObject* name = nullptr;
+  PyObject* task_group = nullptr;
+  PyObject* resources = nullptr;
+  PyObject* networks = nullptr;
+  PyObject* device = nullptr;
+  PyObject* ip = nullptr;
+  PyObject* mbits = nullptr;
+  PyObject* reserved = nullptr;
+  PyObject* reserved_ports = nullptr;
+  PyObject* dynamic_ports = nullptr;
+  PyObject* id = nullptr;
+  PyObject* task_resources = nullptr;
+  PyObject* metrics = nullptr;
+  PyObject* task_states = nullptr;
+  PyObject* node_id = nullptr;
+  PyObject* desired_status = nullptr;
+  PyObject* desired_description = nullptr;
+  PyObject* client_status = nullptr;
+  PyObject* scores = nullptr;
+  PyObject* coalesced = nullptr;
+  PyObject* dunder_new = nullptr;
+  PyObject* dunder_dict = nullptr;
+  PyObject* has_allocs = nullptr;
+  PyObject* proposed_allocs = nullptr;
+  PyObject* binpack_suffix = nullptr;
+  bool ok = false;
+};
+
+Interned& interned() {
+  static Interned s;
+  if (!s.ok) {
+    s.name = PyUnicode_InternFromString("name");
+    s.task_group = PyUnicode_InternFromString("task_group");
+    s.resources = PyUnicode_InternFromString("resources");
+    s.networks = PyUnicode_InternFromString("networks");
+    s.device = PyUnicode_InternFromString("device");
+    s.ip = PyUnicode_InternFromString("ip");
+    s.mbits = PyUnicode_InternFromString("mbits");
+    s.reserved = PyUnicode_InternFromString("reserved");
+    s.reserved_ports = PyUnicode_InternFromString("reserved_ports");
+    s.dynamic_ports = PyUnicode_InternFromString("dynamic_ports");
+    s.id = PyUnicode_InternFromString("id");
+    s.task_resources = PyUnicode_InternFromString("task_resources");
+    s.metrics = PyUnicode_InternFromString("metrics");
+    s.task_states = PyUnicode_InternFromString("task_states");
+    s.node_id = PyUnicode_InternFromString("node_id");
+    s.desired_status = PyUnicode_InternFromString("desired_status");
+    s.desired_description =
+        PyUnicode_InternFromString("desired_description");
+    s.client_status = PyUnicode_InternFromString("client_status");
+    s.scores = PyUnicode_InternFromString("scores");
+    s.coalesced = PyUnicode_InternFromString("coalesced_failures");
+    s.dunder_new = PyUnicode_InternFromString("__new__");
+    s.dunder_dict = PyUnicode_InternFromString("__dict__");
+    s.has_allocs = PyUnicode_InternFromString("has_allocs_on_node");
+    s.proposed_allocs = PyUnicode_InternFromString("proposed_allocs");
+    s.binpack_suffix = PyUnicode_InternFromString(".binpack");
+    s.ok = true;
+  }
+  return s;
+}
+
+// cls.__new__(cls) + inst.__dict__ = d (steals nothing; returns new ref).
+PyObject* make_instance(PyObject* cls, PyObject* d) {
+  Interned& I = interned();
+  PyObject* new_fn = PyObject_GetAttr(cls, I.dunder_new);
+  if (!new_fn) return nullptr;
+  PyObject* inst = PyObject_CallFunctionObjArgs(new_fn, cls, nullptr);
+  Py_DECREF(new_fn);
+  if (!inst) return nullptr;
+  if (PyObject_SetAttr(inst, I.dunder_dict, d) < 0) {
+    Py_DECREF(inst);
+    return nullptr;
+  }
+  return inst;
+}
+
+// Fresh metric dict from the proto + empty factory dicts.
+PyObject* metric_dict(PyObject* proto, PyObject* factory_names) {
+  PyObject* d = PyDict_Copy(proto);
+  if (!d) return nullptr;
+  Py_ssize_t n = PyTuple_GET_SIZE(factory_names);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* empty = PyDict_New();
+    if (!empty || PyDict_SetItem(d, PyTuple_GET_ITEM(factory_names, i),
+                                 empty) < 0) {
+      Py_XDECREF(empty);
+      Py_DECREF(d);
+      return nullptr;
+    }
+    Py_DECREF(empty);
+  }
+  return d;
+}
+
+// Accumulate one node's proposed-alloc network usage into (used, bw).
+int walk_proposed(PyObject* ctx, PyObject* node_id, PyObject* used,
+                  long* bw) {
+  Interned& I = interned();
+  PyObject* allocs =
+      PyObject_CallMethodObjArgs(ctx, I.proposed_allocs, node_id, nullptr);
+  if (!allocs) return -1;
+  PyObject* it = PyObject_GetIter(allocs);
+  Py_DECREF(allocs);
+  if (!it) return -1;
+  PyObject* alloc;
+  while ((alloc = PyIter_Next(it))) {
+    PyObject* trs = PyObject_GetAttr(alloc, I.task_resources);
+    Py_DECREF(alloc);
+    if (!trs) goto fail;
+    {
+      PyObject* values = PyDict_Values(trs);
+      Py_DECREF(trs);
+      if (!values) goto fail;
+      for (Py_ssize_t i = 0; i < PyList_GET_SIZE(values); i++) {
+        PyObject* nets =
+            PyObject_GetAttr(PyList_GET_ITEM(values, i), I.networks);
+        if (!nets) {
+          Py_DECREF(values);
+          goto fail;
+        }
+        PyObject* nets_fast = PySequence_Fast(nets, "networks");
+        Py_DECREF(nets);
+        if (!nets_fast) {
+          Py_DECREF(values);
+          goto fail;
+        }
+        for (Py_ssize_t j = 0; j < PySequence_Fast_GET_SIZE(nets_fast);
+             j++) {
+          PyObject* offer = PySequence_Fast_GET_ITEM(nets_fast, j);
+          PyObject* rports = PyObject_GetAttr(offer, I.reserved_ports);
+          if (!rports) {
+            Py_DECREF(nets_fast);
+            Py_DECREF(values);
+            goto fail;
+          }
+          PyObject* rp_fast = PySequence_Fast(rports, "reserved_ports");
+          Py_DECREF(rports);
+          if (!rp_fast) {
+            Py_DECREF(nets_fast);
+            Py_DECREF(values);
+            goto fail;
+          }
+          for (Py_ssize_t k = 0; k < PySequence_Fast_GET_SIZE(rp_fast);
+               k++) {
+            if (PySet_Add(used, PySequence_Fast_GET_ITEM(rp_fast, k)) <
+                0) {
+              Py_DECREF(rp_fast);
+              Py_DECREF(nets_fast);
+              Py_DECREF(values);
+              goto fail;
+            }
+          }
+          Py_DECREF(rp_fast);
+          PyObject* mb = PyObject_GetAttr(offer, I.mbits);
+          if (!mb) {
+            Py_DECREF(nets_fast);
+            Py_DECREF(values);
+            goto fail;
+          }
+          *bw += PyLong_AsLong(mb);
+          Py_DECREF(mb);
+          if (PyErr_Occurred()) {
+            Py_DECREF(nets_fast);
+            Py_DECREF(values);
+            goto fail;
+          }
+        }
+        Py_DECREF(nets_fast);
+      }
+      Py_DECREF(values);
+    }
+  }
+  Py_DECREF(it);
+  return PyErr_Occurred() ? -1 : 0;
+fail:
+  Py_DECREF(it);
+  return -1;
+}
+
+// Node-static network base lookup: cached tuple from net_base, else one
+// callback into Python's _net_base_for (which computes, handles CIDR
+// IPs, and caches).  Returns 1 ok (*out = borrowed tuple), 0 bail
+// (complex topology), -1 error.
+int node_base(PyObject* net_base, PyObject* base_fn, PyObject* ch_key,
+              PyObject* node, PyObject** out) {
+  PyObject* base = PyDict_GetItemWithError(net_base, ch_key);
+  if (base) {
+    if (base == Py_None) return 0;
+    *out = base;  // borrowed from net_base, same as the miss path below
+    return 1;
+  }
+  if (PyErr_Occurred()) return -1;
+  base = PyObject_CallFunctionObjArgs(base_fn, ch_key, node, nullptr);
+  if (!base) return -1;
+  bool is_none = base == Py_None;
+  Py_DECREF(base);
+  if (is_none) return 0;
+  // _net_base_for cached the tuple into net_base; borrow it from there
+  // so the caller needs no ownership bookkeeping.
+  base = PyDict_GetItem(net_base, ch_key);
+  if (!base || base == Py_None) return 0;  // defensive: cacheless callback
+  *out = base;
+  return 1;
+}
+
+// bulk_finish(place, group_idx, chosen, scores, uuids, slots, nodes,
+//             node_net, net_base, base_fn, state, ctx, plan_nu, plan_na,
+//             failed_list, alloc_proto, metric_proto, metric_factories,
+//             alloc_cls, metric_cls, res_cls, net_cls,
+//             statuses, port_lcg, min_port, max_port)
+//   -> (n_done, port_lcg, failed_map)
+//
+// slots[g] = (size_obj, tasks) with tasks = list of
+//   (task_name, res_proto_dict, None | (mbits, net_proto, dyn_labels)).
+// statuses = (run, pending, failed, client_failed, failed_desc).
+PyObject* bulk_finish(PyObject*, PyObject* args) {
+  PyObject *place, *group_idx, *chosen, *scores, *uuids, *slots, *nodes;
+  PyObject *node_net, *net_base, *base_fn, *state, *ctx, *plan_nu, *plan_na;
+  PyObject *failed_list, *alloc_proto, *metric_proto, *metric_factories;
+  PyObject *alloc_cls, *metric_cls, *res_cls, *net_cls, *statuses;
+  long long lcg;  // 64-bit: lcg*1103515245 overflows a 32-bit long
+  long min_port, max_port;
+  if (!PyArg_ParseTuple(
+          args, "OOOOOOOOOOOOOOOOOOOOOOOLll", &place, &group_idx, &chosen,
+          &scores, &uuids, &slots, &nodes, &node_net, &net_base, &base_fn,
+          &state, &ctx, &plan_nu, &plan_na, &failed_list, &alloc_proto,
+          &metric_proto, &metric_factories, &alloc_cls, &metric_cls,
+          &res_cls, &net_cls, &statuses, &lcg, &min_port,
+          &max_port)) {
+    return nullptr;
+  }
+  Interned& I = interned();
+  const long span = max_port - min_port;
+  PyObject* st_run = PyTuple_GET_ITEM(statuses, 0);
+  PyObject* st_pending = PyTuple_GET_ITEM(statuses, 1);
+  PyObject* st_failed = PyTuple_GET_ITEM(statuses, 2);
+  PyObject* st_cfailed = PyTuple_GET_ITEM(statuses, 3);
+  PyObject* failed_desc = PyTuple_GET_ITEM(statuses, 4);
+
+  PyObject* failed_map = PyDict_New();
+  if (!failed_map) return nullptr;
+
+  Py_ssize_t P = PyList_GET_SIZE(place);
+  Py_ssize_t p = 0;
+  for (; p < P; p++) {
+    PyObject* missing = PyList_GET_ITEM(place, p);
+    PyObject* tg = PyObject_GetAttr(missing, I.task_group);
+    if (!tg) goto fail;
+    PyObject* tg_key = PyLong_FromVoidPtr((void*)tg);
+    if (!tg_key) {
+      Py_DECREF(tg);
+      goto fail;
+    }
+
+    // Coalesce onto a prior failure of the same task group.
+    PyObject* prior = PyDict_GetItemWithError(failed_map, tg_key);
+    if (!prior && PyErr_Occurred()) {
+      Py_DECREF(tg_key);
+      Py_DECREF(tg);
+      goto fail;
+    }
+    if (prior) {
+      PyObject* m = PyObject_GetAttr(prior, I.metrics);
+      PyObject* c = m ? PyObject_GetAttr(m, I.coalesced) : nullptr;
+      if (!c) {
+        Py_XDECREF(m);
+        Py_DECREF(tg_key);
+        Py_DECREF(tg);
+        goto fail;
+      }
+      long v = PyLong_AsLong(c) + 1;
+      Py_DECREF(c);
+      PyObject* nv = PyLong_FromLong(v);
+      int rc = nv ? PyObject_SetAttr(m, I.coalesced, nv) : -1;
+      Py_XDECREF(nv);
+      Py_DECREF(m);
+      Py_DECREF(tg_key);
+      Py_DECREF(tg);
+      if (rc < 0) goto fail;
+      continue;
+    }
+
+    long g = PyLong_AsLong(PyList_GET_ITEM(group_idx, p));
+    long ch = PyLong_AsLong(PyList_GET_ITEM(chosen, p));
+    PyObject* slot = PyList_GET_ITEM(slots, g);
+    PyObject* size_obj = PyTuple_GET_ITEM(slot, 0);
+    PyObject* tasks = PyTuple_GET_ITEM(slot, 1);
+
+    PyObject* node = nullptr;
+    PyObject* node_id = nullptr;
+    PyObject* out_trs = nullptr;  // task name -> Resources
+    double score = 0.0;
+
+    if (ch >= 0) {
+      node = PyList_GET_ITEM(nodes, ch);  // borrowed
+      node_id = PyObject_GetAttr(node, I.id);
+      if (!node_id) {
+        Py_DECREF(tg_key);
+        Py_DECREF(tg);
+        goto fail;
+      }
+      score = PyFloat_AsDouble(PyList_GET_ITEM(scores, p));
+
+      // --- network state for the node -------------------------------
+      PyObject* ch_key = PyLong_FromLong(ch);
+      if (!ch_key) {
+        Py_DECREF(node_id);
+        Py_DECREF(tg_key);
+        Py_DECREF(tg);
+        goto fail;
+      }
+      PyObject* st = PyDict_GetItemWithError(node_net, ch_key);
+      if (!st && PyErr_Occurred()) {
+        Py_DECREF(ch_key);
+        Py_DECREF(node_id);
+        Py_DECREF(tg_key);
+        Py_DECREF(tg);
+        goto fail;
+      }
+      if (!st) {
+        PyObject* base = nullptr;
+        int rc = node_base(net_base, base_fn, ch_key, node, &base);
+        if (rc < 0) {
+          Py_DECREF(ch_key);
+          Py_DECREF(node_id);
+          Py_DECREF(tg_key);
+          Py_DECREF(tg);
+          goto fail;
+        }
+        if (rc == 0) {  // bail: Python path owns this placement
+          Py_DECREF(ch_key);
+          Py_DECREF(node_id);
+          Py_DECREF(tg_key);
+          Py_DECREF(tg);
+          goto done;
+        }
+        PyObject* used = PySet_New(PyTuple_GET_ITEM(base, 0));
+        if (!used) {
+          Py_DECREF(ch_key);
+          Py_DECREF(node_id);
+          Py_DECREF(tg_key);
+          Py_DECREF(tg);
+          goto fail;
+        }
+        long bw = PyLong_AsLong(PyTuple_GET_ITEM(base, 1));
+        // Probe for proposed allocs needing the exact walk.
+        PyObject* has =
+            PyObject_CallMethodObjArgs(state, I.has_allocs, node_id,
+                                       nullptr);
+        if (!has) {
+          Py_DECREF(used);
+          Py_DECREF(ch_key);
+          Py_DECREF(node_id);
+          Py_DECREF(tg_key);
+          Py_DECREF(tg);
+          goto fail;
+        }
+        int busy = PyObject_IsTrue(has);
+        Py_DECREF(has);
+        if (busy == 0) {
+          int c1 = PyDict_Contains(plan_nu, node_id);
+          int c2 = c1 == 0 ? PyDict_Contains(plan_na, node_id) : c1;
+          if (c1 < 0 || c2 < 0) busy = -1;
+          else busy = (c1 > 0 || c2 > 0) ? 1 : 0;
+        }
+        if (busy < 0) {
+          Py_DECREF(used);
+          Py_DECREF(ch_key);
+          Py_DECREF(node_id);
+          Py_DECREF(tg_key);
+          Py_DECREF(tg);
+          goto fail;
+        }
+        if (busy &&
+            walk_proposed(ctx, node_id, used, &bw) < 0) {
+          Py_DECREF(used);
+          Py_DECREF(ch_key);
+          Py_DECREF(node_id);
+          Py_DECREF(tg_key);
+          Py_DECREF(tg);
+          goto fail;
+        }
+        PyObject* bw_obj = PyLong_FromLong(bw);
+        st = bw_obj ? PyList_New(5) : nullptr;
+        if (!st) {
+          Py_XDECREF(bw_obj);
+          Py_DECREF(used);
+          Py_DECREF(ch_key);
+          Py_DECREF(node_id);
+          Py_DECREF(tg_key);
+          Py_DECREF(tg);
+          goto fail;
+        }
+        PyList_SET_ITEM(st, 0, used);  // steals
+        PyList_SET_ITEM(st, 1, bw_obj);
+        PyObject* avail = PyTuple_GET_ITEM(base, 2);
+        Py_INCREF(avail);
+        PyList_SET_ITEM(st, 2, avail);
+        PyObject* ipo = PyTuple_GET_ITEM(base, 3);
+        Py_INCREF(ipo);
+        PyList_SET_ITEM(st, 3, ipo);
+        PyObject* devo = PyTuple_GET_ITEM(base, 4);
+        Py_INCREF(devo);
+        PyList_SET_ITEM(st, 4, devo);
+        int rc2 = PyDict_SetItem(node_net, ch_key, st);
+        Py_DECREF(st);  // dict holds it now
+        if (rc2 < 0) {
+          Py_DECREF(ch_key);
+          Py_DECREF(node_id);
+          Py_DECREF(tg_key);
+          Py_DECREF(tg);
+          goto fail;
+        }
+        st = PyDict_GetItem(node_net, ch_key);  // borrowed
+      }
+      Py_DECREF(ch_key);
+
+      PyObject* used = PyList_GET_ITEM(st, 0);
+      long bw_used = PyLong_AsLong(PyList_GET_ITEM(st, 1));
+      long bw_avail = PyLong_AsLong(PyList_GET_ITEM(st, 2));
+      PyObject* node_ip = PyList_GET_ITEM(st, 3);
+      PyObject* node_dev = PyList_GET_ITEM(st, 4);
+
+      // Total bandwidth ask up-front: no mid-slot rollback needed.
+      long total_mbits = 0;
+      Py_ssize_t n_tasks = PyList_GET_SIZE(tasks);
+      for (Py_ssize_t t = 0; t < n_tasks; t++) {
+        PyObject* net = PyTuple_GET_ITEM(PyList_GET_ITEM(tasks, t), 2);
+        if (net != Py_None) {
+          total_mbits += PyLong_AsLong(PyTuple_GET_ITEM(net, 0));
+        }
+      }
+      if (bw_used + total_mbits > bw_avail) {
+        // Divergence: Python fallback owns this placement onward.
+        Py_DECREF(node_id);
+        Py_DECREF(tg_key);
+        Py_DECREF(tg);
+        goto done;
+      }
+
+      out_trs = PyDict_New();
+      if (!out_trs) {
+        Py_DECREF(node_id);
+        Py_DECREF(tg_key);
+        Py_DECREF(tg);
+        goto fail;
+      }
+      bool task_fail = false;
+      for (Py_ssize_t t = 0; t < n_tasks && !task_fail; t++) {
+        PyObject* task = PyList_GET_ITEM(tasks, t);
+        PyObject* tname = PyTuple_GET_ITEM(task, 0);
+        PyObject* res_proto = PyTuple_GET_ITEM(task, 1);
+        PyObject* net = PyTuple_GET_ITEM(task, 2);
+        PyObject* rd = PyDict_Copy(res_proto);
+        if (!rd) {
+          task_fail = true;
+          break;
+        }
+        if (net == Py_None) {
+          PyObject* empty = PyList_New(0);
+          if (!empty || PyDict_SetItem(rd, I.networks, empty) < 0) {
+            Py_XDECREF(empty);
+            Py_DECREF(rd);
+            task_fail = true;
+            break;
+          }
+          Py_DECREF(empty);
+        } else {
+          PyObject* net_proto = PyTuple_GET_ITEM(net, 1);
+          PyObject* labels = PyTuple_GET_ITEM(net, 2);
+          Py_ssize_t n_dyn = PySequence_Fast_GET_SIZE(labels);
+          PyObject* ports = PyList_New(0);
+          if (!ports) {
+            Py_DECREF(rd);
+            task_fail = true;
+            break;
+          }
+          bool port_fail = false;
+          for (Py_ssize_t dp = 0; dp < n_dyn && !port_fail; dp++) {
+            lcg = (lcg * 1103515245LL + 12345LL) & 0x3FFFFFFFLL;
+            long port = min_port + (long)(lcg % span);
+            long tries = 0;
+            while (true) {
+              PyObject* po = PyLong_FromLong(port);
+              if (!po) {
+                port_fail = true;
+                break;
+              }
+              int hit = PySet_Contains(used, po);
+              if (hit < 0) {
+                Py_DECREF(po);
+                port_fail = true;
+                break;
+              }
+              if (!hit) {
+                if (PySet_Add(used, po) < 0 ||
+                    PyList_Append(ports, po) < 0) {
+                  Py_DECREF(po);
+                  port_fail = true;
+                  break;
+                }
+                Py_DECREF(po);
+                break;
+              }
+              Py_DECREF(po);
+              port = min_port + (port - min_port + 1) % span;
+              if (++tries > span) {
+                // Whole dynamic range exhausted on this node: a genuine
+                // error (the Python twin would spin); raise, don't bail.
+                PyErr_SetString(PyExc_RuntimeError,
+                                "dynamic port range exhausted");
+                port_fail = true;
+                break;
+              }
+            }
+          }
+          if (port_fail) {
+            Py_DECREF(ports);
+            Py_DECREF(rd);
+            task_fail = true;
+            break;
+          }
+          PyObject* nd = PyDict_Copy(net_proto);
+          PyObject* labels_copy = nd ? PySequence_List(labels) : nullptr;
+          if (!labels_copy ||
+              PyDict_SetItem(nd, I.device, node_dev) < 0 ||
+              PyDict_SetItem(nd, I.ip, node_ip) < 0 ||
+              PyDict_SetItem(nd, I.reserved_ports, ports) < 0 ||
+              PyDict_SetItem(nd, I.dynamic_ports, labels_copy) < 0) {
+            Py_XDECREF(labels_copy);
+            Py_XDECREF(nd);
+            Py_DECREF(ports);
+            Py_DECREF(rd);
+            task_fail = true;
+            break;
+          }
+          Py_DECREF(labels_copy);
+          Py_DECREF(ports);
+          PyObject* offer = make_instance(net_cls, nd);
+          Py_DECREF(nd);
+          if (!offer) {
+            Py_DECREF(rd);
+            task_fail = true;
+            break;
+          }
+          PyObject* offer_list = PyList_New(1);
+          if (!offer_list) {
+            Py_DECREF(offer);
+            Py_DECREF(rd);
+            task_fail = true;
+            break;
+          }
+          PyList_SET_ITEM(offer_list, 0, offer);  // steals
+          int rc3 = PyDict_SetItem(rd, I.networks, offer_list);
+          Py_DECREF(offer_list);
+          if (rc3 < 0) {
+            Py_DECREF(rd);
+            task_fail = true;
+            break;
+          }
+        }
+        PyObject* res_inst = make_instance(res_cls, rd);
+        Py_DECREF(rd);
+        if (!res_inst || PyDict_SetItem(out_trs, tname, res_inst) < 0) {
+          Py_XDECREF(res_inst);
+          task_fail = true;
+          break;
+        }
+        Py_DECREF(res_inst);
+      }
+      if (task_fail) {
+        Py_DECREF(out_trs);
+        Py_DECREF(node_id);
+        Py_DECREF(tg_key);
+        Py_DECREF(tg);
+        goto fail;
+      }
+      // Commit bandwidth.
+      PyObject* new_bw = PyLong_FromLong(bw_used + total_mbits);
+      if (!new_bw) {
+        Py_DECREF(out_trs);
+        Py_DECREF(node_id);
+        Py_DECREF(tg_key);
+        Py_DECREF(tg);
+        goto fail;
+      }
+      PyList_SetItem(st, 1, new_bw);  // steals
+    }
+
+    // --- metric + alloc construction --------------------------------
+    PyObject* md = metric_dict(metric_proto, metric_factories);
+    if (!md) {
+      Py_XDECREF(out_trs);
+      Py_XDECREF(node_id);
+      Py_DECREF(tg_key);
+      Py_DECREF(tg);
+      goto fail;
+    }
+    if (node_id) {
+      PyObject* key = PyUnicode_Concat(node_id, I.binpack_suffix);
+      PyObject* sv = key ? PyFloat_FromDouble(score) : nullptr;
+      PyObject* sd = sv ? PyDict_New() : nullptr;
+      if (!sd || PyDict_SetItem(sd, key, sv) < 0 ||
+          PyDict_SetItem(md, I.scores, sd) < 0) {
+        Py_XDECREF(sd);
+        Py_XDECREF(sv);
+        Py_XDECREF(key);
+        Py_DECREF(md);
+        Py_XDECREF(out_trs);
+        Py_DECREF(node_id);
+        Py_DECREF(tg_key);
+        Py_DECREF(tg);
+        goto fail;
+      }
+      Py_DECREF(sd);
+      Py_DECREF(sv);
+      Py_DECREF(key);
+    }
+    PyObject* metric = make_instance(metric_cls, md);
+    Py_DECREF(md);
+    if (!metric) {
+      Py_XDECREF(out_trs);
+      Py_XDECREF(node_id);
+      Py_DECREF(tg_key);
+      Py_DECREF(tg);
+      goto fail;
+    }
+
+    PyObject* ad = PyDict_Copy(alloc_proto);
+    PyObject* tg_name = ad ? PyObject_GetAttr(tg, I.name) : nullptr;
+    PyObject* m_name = tg_name ? PyObject_GetAttr(missing, I.name)
+                               : nullptr;
+    PyObject* ts = m_name ? PyDict_New() : nullptr;
+    if (!ts ||
+        PyDict_SetItem(ad, I.id, PyList_GET_ITEM(uuids, p)) < 0 ||
+        PyDict_SetItem(ad, I.name, m_name) < 0 ||
+        PyDict_SetItem(ad, I.task_group, tg_name) < 0 ||
+        PyDict_SetItem(ad, I.resources, size_obj) < 0 ||
+        PyDict_SetItem(ad, I.metrics, metric) < 0 ||
+        PyDict_SetItem(ad, I.task_states, ts) < 0) {
+      Py_XDECREF(ts);
+      Py_XDECREF(m_name);
+      Py_XDECREF(tg_name);
+      Py_XDECREF(ad);
+      Py_DECREF(metric);
+      Py_XDECREF(out_trs);
+      Py_XDECREF(node_id);
+      Py_DECREF(tg_key);
+      Py_DECREF(tg);
+      goto fail;
+    }
+    Py_DECREF(ts);
+    Py_DECREF(m_name);
+    Py_DECREF(tg_name);
+    Py_DECREF(metric);
+
+    int rc4 = 0;
+    if (node_id) {
+      rc4 = PyDict_SetItem(ad, I.node_id, node_id) < 0 ||
+            PyDict_SetItem(ad, I.task_resources, out_trs) < 0 ||
+            PyDict_SetItem(ad, I.desired_status, st_run) < 0 ||
+            PyDict_SetItem(ad, I.client_status, st_pending) < 0;
+      Py_DECREF(out_trs);
+      out_trs = nullptr;
+    } else {
+      PyObject* empty_trs = PyDict_New();
+      rc4 = !empty_trs ||
+            PyDict_SetItem(ad, I.task_resources, empty_trs) < 0 ||
+            PyDict_SetItem(ad, I.desired_status, st_failed) < 0 ||
+            PyDict_SetItem(ad, I.desired_description, failed_desc) < 0 ||
+            PyDict_SetItem(ad, I.client_status, st_cfailed) < 0;
+      Py_XDECREF(empty_trs);
+    }
+    if (rc4) {
+      Py_DECREF(ad);
+      Py_XDECREF(node_id);
+      Py_DECREF(tg_key);
+      Py_DECREF(tg);
+      goto fail;
+    }
+    PyObject* alloc = make_instance(alloc_cls, ad);
+    Py_DECREF(ad);
+    if (!alloc) {
+      Py_XDECREF(node_id);
+      Py_DECREF(tg_key);
+      Py_DECREF(tg);
+      goto fail;
+    }
+
+    if (node_id) {
+      PyObject* lst = PyDict_GetItemWithError(plan_na, node_id);
+      if (!lst) {
+        if (PyErr_Occurred()) {
+          Py_DECREF(alloc);
+          Py_DECREF(node_id);
+          Py_DECREF(tg_key);
+          Py_DECREF(tg);
+          goto fail;
+        }
+        lst = PyList_New(0);
+        if (!lst || PyDict_SetItem(plan_na, node_id, lst) < 0) {
+          Py_XDECREF(lst);
+          Py_DECREF(alloc);
+          Py_DECREF(node_id);
+          Py_DECREF(tg_key);
+          Py_DECREF(tg);
+          goto fail;
+        }
+        Py_DECREF(lst);
+        lst = PyDict_GetItem(plan_na, node_id);
+      }
+      int rc5 = PyList_Append(lst, alloc);
+      Py_DECREF(alloc);
+      Py_DECREF(node_id);
+      Py_DECREF(tg_key);
+      Py_DECREF(tg);
+      if (rc5 < 0) goto fail;
+    } else {
+      int rc5 = PyList_Append(failed_list, alloc) < 0 ||
+                PyDict_SetItem(failed_map, tg_key, alloc) < 0;
+      Py_DECREF(alloc);
+      Py_DECREF(tg_key);
+      Py_DECREF(tg);
+      if (rc5) goto fail;
+    }
+  }
+
+done:
+  return Py_BuildValue("(nLN)", p, lcg, failed_map);
+
+fail:
+  Py_DECREF(failed_map);
+  return nullptr;
+}
+
 PyMethodDef methods[] = {
     {"assign_ports", assign_ports, METH_VARARGS,
      "Assign reserved + dynamic ports against a used-port set."},
     {"add_all", add_all, METH_VARARGS,
      "Add ports to a used-port set; returns True on any collision."},
+    {"bulk_finish", bulk_finish, METH_VARARGS,
+     "Scheduler finish-loop happy path: bulk alloc construction."},
     {nullptr, nullptr, 0, nullptr},
 };
 

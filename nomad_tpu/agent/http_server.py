@@ -243,13 +243,17 @@ class HTTPServer:
                 parsed = []
                 for spec in raw_list:
                     if isinstance(spec, (list, tuple)) and len(spec) == 2:
-                        parsed.append((spec[0], int(spec[1])))
-                        continue
-                    host, _, port = str(spec).rpartition(":")
-                    if not host or not port.isdigit():
+                        host, port = str(spec[0]), spec[1]
+                    else:
+                        host, _, port = str(spec).rpartition(":")
+                    try:
+                        port = int(port)
+                    except (TypeError, ValueError):
+                        port = -1
+                    if not host or not 0 < port < 65536:
                         raise BadRequest(
                             f"invalid server address {spec!r}")
-                    parsed.append((host, int(port)))
+                    parsed.append((host, port))
                 if not parsed:
                     raise BadRequest("no server addresses given")
                 agent.client.set_servers(parsed)

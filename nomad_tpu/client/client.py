@@ -48,9 +48,12 @@ class NetRPCHandler:
         self._i = 0
 
     def call(self, method: str, args: dict, timeout=None):
+        # Snapshot the list: set_servers may swap it from another thread
+        # (PUT /v1/agent/servers) mid-call.
+        servers = self.servers
         last_err: Optional[Exception] = None
-        for _ in range(len(self.servers)):
-            address = self.servers[self._i % len(self.servers)]
+        for _ in range(len(servers)):
+            address = servers[self._i % len(servers)]
             try:
                 return self.pool.call(address, method, args,
                                       timeout=timeout)

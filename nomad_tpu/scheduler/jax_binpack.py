@@ -86,6 +86,21 @@ _METRIC_STATIC, _METRIC_FACTORIES = _proto_of(AllocMetric)
 _RES_STATIC, _RES_FACTORIES = _proto_of(Resources)
 _NET_STATIC, _NET_FACTORIES = _proto_of(NetworkResource)
 
+# Native bulk finish (native/port_alloc.cpp bulk_finish): available only
+# when the C extension built AND every AllocMetric factory is a plain dict
+# (the C side creates dicts directly).
+def _native_bulk():
+    from nomad_tpu.utils.native import HAS_NATIVE, native
+
+    if not HAS_NATIVE or not hasattr(native, "bulk_finish"):
+        return None
+    if any(fac is not dict for _n, fac in _METRIC_FACTORIES):
+        return None  # pragma: no cover - metric factories are dicts
+    return native
+
+
+_METRIC_FACTORY_NAMES = tuple(n for n, _f in _METRIC_FACTORIES)
+
 
 def _net_plan_for(tg):
     """Per-slot network plan for the bulk finish path:
@@ -155,34 +170,43 @@ class FastPlacementMixin:
             allocs.extend(placements)
         return allocs
 
+    def _net_base_for(self, node_index: int, node):
+        """Node-static network base (frozen used-ports, reserved bw, bw
+        capacity, ip, device) or None for topologies needing the exact
+        path.  Cached on the fleet statics; also the callback the native
+        bulk finish uses on a base-cache miss."""
+        base_cache = self._statics.net_base
+        base = base_cache.get(node_index, False)
+        if base is not False:
+            return base
+        base = None
+        nets = [n for n in node.resources.networks if n.device] \
+            if node.resources is not None else []
+        if len(nets) == 1:
+            n0 = nets[0]
+            ip = n0.ip
+            if not ip:
+                for ip in _cidr_ips(n0.cidr):
+                    break
+            if ip:
+                used: set = set()
+                bw_used = 0
+                if node.reserved is not None:
+                    for rn in node.reserved.networks:
+                        used.update(rn.reserved_ports)
+                        bw_used += rn.mbits
+                base = (frozenset(used), bw_used, n0.mbits, ip,
+                        n0.device)
+        base_cache[node_index] = base
+        return base
+
     def _node_net_init(self, node_index: int, node):
         """Fast per-node network state: [used_ports, bw_used, bw_avail,
         ip, device], or None when the topology needs the exact path
         (multi-network nodes).  The reserved-only base is node-static and
         cached on the fleet statics; per-eval state adds proposed allocs'
         offers on top."""
-        base_cache = self._statics.net_base
-        base = base_cache.get(node_index, False)
-        if base is False:
-            base = None
-            nets = [n for n in node.resources.networks if n.device] \
-                if node.resources is not None else []
-            if len(nets) == 1:
-                n0 = nets[0]
-                ip = n0.ip
-                if not ip:
-                    for ip in _cidr_ips(n0.cidr):
-                        break
-                if ip:
-                    used: set = set()
-                    bw_used = 0
-                    if node.reserved is not None:
-                        for rn in node.reserved.networks:
-                            used.update(rn.reserved_ports)
-                            bw_used += rn.mbits
-                    base = (frozenset(used), bw_used, n0.mbits, ip,
-                            n0.device)
-            base_cache[node_index] = base
+        base = self._net_base_for(node_index, node)
         if base is None:
             return None
         used = set(base[0])
@@ -708,7 +732,55 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
         # later device winner must be re-verified host-side with the exact
         # allocs_fit before being trusted.
         usage_diverged = False
-        for p, missing in enumerate(place):
+
+        # Native happy-path prefix: the C extension executes the common
+        # per-placement steps (port picks, offer/Resources/AllocMetric/
+        # Allocation construction, plan append) and stops at the first
+        # case needing Python (complex topology, bandwidth overflow);
+        # this loop then resumes from that index.  Identical results by
+        # construction (same LCG stream, same protos) — parity-tested in
+        # tests/test_native_finish.py.
+        start_p = 0
+        native = _native_bulk()
+        if native is not None and \
+                all(np_[0] for np_ in net_plans[:args.n_groups]):
+            slots_c = []
+            for g in range(args.n_groups):
+                _fast, plan_tasks = net_plans[g]
+                tasks_c = []
+                for tname, res, ask in plan_tasks:
+                    if res is None:
+                        res_proto = dict(_RES_STATIC)
+                    else:
+                        res_proto = dict(
+                            _RES_STATIC, cpu=res.cpu,
+                            memory_mb=res.memory_mb,
+                            disk_mb=res.disk_mb, iops=res.iops)
+                    net_c = None
+                    if ask is not None:
+                        net_c = (int(ask.mbits),
+                                 dict(_NET_STATIC, mbits=ask.mbits),
+                                 list(ask.dynamic_ports))
+                    tasks_c.append((tname, res_proto, net_c))
+                slots_c.append((sizes[g], tasks_c))
+            group_l = args.group_idx[:len(place)].tolist()
+            place_l = place if type(place) is list else list(place)
+            start_p, self._port_lcg, fmap = native.bulk_finish(
+                place_l, group_l, chosen_l, scores_l, uuids, slots_c,
+                nodes_arr, self._node_net, statics.net_base,
+                self._net_base_for,
+                self.state, self.ctx, plan.node_update,
+                plan.node_allocation, plan.failed_allocs,
+                alloc_proto, metric_proto, _METRIC_FACTORY_NAMES,
+                Allocation, AllocMetric, Resources, NetworkResource,
+                (ALLOC_DESIRED_STATUS_RUN, ALLOC_CLIENT_STATUS_PENDING,
+                 ALLOC_DESIRED_STATUS_FAILED, ALLOC_CLIENT_STATUS_FAILED,
+                 "failed to find a node for placement"),
+                self._port_lcg, MIN_DYNAMIC_PORT, MAX_DYNAMIC_PORT)
+            failed_tg.update(fmap)
+
+        for p in range(start_p, len(place)):
+            missing = place[p]
             tg = missing.task_group
             prior_fail = failed_tg.get(id(tg))
             if prior_fail is not None:

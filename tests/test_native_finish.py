@@ -1,0 +1,186 @@
+"""Parity: native (C) bulk finish vs the pure-Python finish loop.
+
+With the same uuid stream and port-LCG seed the two paths must produce
+BIT-IDENTICAL plans — same nodes, ports, offers, metrics (modulo the
+wall-clock allocation_time).  See native/port_alloc.cpp bulk_finish.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import nomad_tpu.mock as mock
+import nomad_tpu.scheduler.jax_binpack as jb
+from nomad_tpu.scheduler import Harness
+from nomad_tpu.structs import (
+    EVAL_TRIGGER_JOB_REGISTER,
+    Evaluation,
+    NetworkResource,
+    Resources,
+    Task,
+    TaskGroup,
+)
+
+pytestmark = pytest.mark.skipif(
+    jb._native_bulk() is None, reason="native extension unavailable")
+
+
+def make_eval(job):
+    return Evaluation(id=f"ev-{job.id}", priority=job.priority,
+                      type="service",
+                      triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+                      job_id=job.id)
+
+
+def _job(n_groups=6, count=2, with_failures=False):
+    job = mock.job()
+    groups = []
+    for g in range(n_groups):
+        cpu = 100_000 if (with_failures and g % 3 == 0) else 100
+        tg = TaskGroup(
+            name=f"tg-{g}", count=count,
+            tasks=[
+                Task(name="web", driver="exec",
+                     resources=Resources(
+                         cpu=cpu, memory_mb=64,
+                         networks=[NetworkResource(
+                             mbits=5, dynamic_ports=["http", "admin"])])),
+                Task(name="sidecar", driver="exec",
+                     resources=Resources(cpu=50, memory_mb=32)),
+            ])
+        groups.append(tg)
+    job.task_groups = groups
+    return job
+
+
+def _deterministic(monkeypatch):
+    counter = {"n": 0}
+
+    def fake_uuids(n):
+        base = counter["n"]
+        counter["n"] += n
+        return [f"u-{base + i:08d}" for i in range(n)]
+
+    monkeypatch.setattr(jb, "generate_uuids", fake_uuids)
+    monkeypatch.setattr(jb, "_randrange", lambda n: 987654321 % n)
+
+
+def _normalize(plan):
+    out = {}
+    for node_id, allocs in plan.node_allocation.items():
+        rows = []
+        for a in allocs:
+            d = a.to_dict()
+            d["metrics"]["allocation_time"] = 0.0
+            rows.append(d)
+        out[node_id] = rows
+    failed = []
+    for a in plan.failed_allocs:
+        d = a.to_dict()
+        d["metrics"]["allocation_time"] = 0.0
+        failed.append(d)
+    return out, failed
+
+
+def _run(monkeypatch, native: bool, nodes, jobs):
+    _deterministic(monkeypatch)
+    if not native:
+        monkeypatch.setattr(jb, "_native_bulk", lambda: None)
+    h = Harness()
+    for n in nodes:
+        h.state.upsert_node(h.next_index(), n)
+    plans = []
+    for job in jobs:
+        h.state.upsert_job(h.next_index(), job)
+        h.process("jax-binpack", make_eval(job))
+        plans.append(_normalize(h.plans[-1]))
+    return plans
+
+
+def _cluster(n):
+    proto = Harness()
+    nodes = []
+    for i in range(n):
+        nodes.append(mock.node(i))
+    del proto
+    return nodes
+
+
+def test_native_finish_parity_basic(monkeypatch):
+    nodes = _cluster(16)
+    jobs = [_job(n_groups=6, count=2)]
+    with monkeypatch.context() as m:
+        py = _run(m, False, nodes, [j.copy() for j in jobs])
+    with monkeypatch.context() as m:
+        nat = _run(m, True, nodes, [j.copy() for j in jobs])
+    assert py == nat
+    placed, failed = nat[0]
+    assert sum(len(v) for v in placed.values()) == 12 and not failed
+
+
+def test_native_finish_parity_with_failures_and_coalescing(monkeypatch):
+    nodes = _cluster(8)
+    jobs = [_job(n_groups=6, count=3, with_failures=True)]
+    with monkeypatch.context() as m:
+        py = _run(m, False, nodes, [j.copy() for j in jobs])
+    with monkeypatch.context() as m:
+        nat = _run(m, True, nodes, [j.copy() for j in jobs])
+    assert py == nat
+    _placed, failed = nat[0]
+    assert failed  # unsatisfiable groups failed identically
+    assert any(f["metrics"]["coalesced_failures"] > 0 for f in failed)
+
+
+def test_native_finish_parity_busy_nodes(monkeypatch):
+    """Second job's eval sees the first job's allocs on the nodes: the C
+    path must walk proposed allocs for port/bandwidth state."""
+    nodes = _cluster(6)
+    jobs = [_job(n_groups=3, count=2), _job(n_groups=4, count=2)]
+    with monkeypatch.context() as m:
+        py = _run(m, False, nodes, [j.copy() for j in jobs])
+    with monkeypatch.context() as m:
+        nat = _run(m, True, nodes, [j.copy() for j in jobs])
+    assert py == nat
+    # Ports must be unique per node across BOTH jobs' offers.
+    seen: dict = {}
+    for placed, _f in nat:
+        for node_id, allocs in placed.items():
+            for a in allocs:
+                for tr in a["task_resources"].values():
+                    for net in tr["networks"]:
+                        for port in net["reserved_ports"]:
+                            key = (node_id, port)
+                            assert key not in seen, key
+                            seen[key] = True
+
+
+def test_native_finish_bails_to_python_on_bandwidth_overflow(monkeypatch):
+    """A node whose bandwidth fills mid-eval forces the divergence
+    fallback; C must hand over cleanly and the combined plan still
+    respects the bandwidth bound."""
+    nodes = _cluster(2)
+    job = mock.job()
+    job.task_groups = [TaskGroup(
+        name=f"tg-{g}", count=1,
+        tasks=[Task(name="t", driver="exec",
+                    resources=Resources(
+                        cpu=10, memory_mb=8,
+                        networks=[NetworkResource(
+                            mbits=400, dynamic_ports=["p"])]))])
+        for g in range(8)]
+    with monkeypatch.context() as m:
+        py = _run(m, False, nodes, [job.copy()])
+    with monkeypatch.context() as m:
+        nat = _run(m, True, nodes, [job.copy()])
+    assert py == nat
+    placed, failed = nat[0]
+    per_node_bw: dict = {}
+    for node_id, allocs in placed.items():
+        for a in allocs:
+            for tr in a["task_resources"].values():
+                for net in tr["networks"]:
+                    per_node_bw[node_id] = \
+                        per_node_bw.get(node_id, 0) + net["mbits"]
+    # mock nodes advertise 1000 mbits: never oversubscribed.
+    assert all(bw <= 1000 for bw in per_node_bw.values())
+    assert sum(len(v) for v in placed.values()) + len(failed) >= 5
