@@ -228,13 +228,21 @@ Interned& interned() {
 
 // cls.__new__(cls) + inst.__dict__ = d (steals nothing; returns new ref).
 PyObject* make_instance(PyObject* cls, PyObject* d) {
-  Interned& I = interned();
-  PyObject* new_fn = PyObject_GetAttr(cls, I.dunder_new);
-  if (!new_fn) return nullptr;
-  PyObject* inst = PyObject_CallFunctionObjArgs(new_fn, cls, nullptr);
-  Py_DECREF(new_fn);
+  // Plain-Python heap classes (no custom __new__/__slots__ — true for
+  // the dataclasses this serves): allocate directly and install the
+  // attribute dict, skipping the __new__ descriptor machinery.
+  PyTypeObject* tp = (PyTypeObject*)cls;
+  PyObject* inst = tp->tp_alloc(tp, 0);
   if (!inst) return nullptr;
-  if (PyObject_SetAttr(inst, I.dunder_dict, d) < 0) {
+  PyObject** dictptr = _PyObject_GetDictPtr(inst);
+  if (dictptr) {
+    PyObject* old = *dictptr;
+    Py_INCREF(d);
+    *dictptr = d;
+    Py_XDECREF(old);
+    return inst;
+  }
+  if (PyObject_SetAttr(inst, interned().dunder_dict, d) < 0) {
     Py_DECREF(inst);
     return nullptr;
   }
