@@ -113,11 +113,28 @@ class BatchEvalRunner:
                 self._process_leftovers(leftovers)
             return
 
-        # Harmonize pad shapes across lanes, stack, one dispatch.
         g_max = max(a.g_pad for _, _, a in pending)
         p_max = max(a.p_pad for _, _, a in pending)
         statics = pending[0][2].statics
         B = len(pending)
+        rounds_ok = all(a.rounds_eligible for _, _, a in pending)
+        k_cap = max(a.k_cap for _, _, a in pending)
+        rounds = max(a.rounds for _, _, a in pending)
+
+        # Executor policy (same trade as JaxBinPackScheduler.
+        # choose_host_executor): a fused dispatch pays one device round
+        # trip + a [B, G, N] upload; below this op-count the numpy kernels
+        # finish before the request would even reach the device.  The
+        # host path reads each lane's arrays directly — no stacking.
+        steps = rounds * g_max if rounds_ok else p_max
+        fused_cost = B * steps * statics.n_real
+        if fused_cost <= JaxBinPackScheduler.HOST_SINGLE_SHOT_COST:
+            self._finish_fused_host(pending, rounds_ok, k_cap, rounds)
+            if leftovers:
+                self._process_leftovers(leftovers)
+            return
+
+        # Harmonize pad shapes across lanes, stack, one dispatch.
         feasible = np.zeros((B, g_max, statics.n_pad), dtype=bool)
         asks = np.zeros((B, g_max, pending[0][2].asks.shape[1]),
                         dtype=np.float32)
@@ -137,23 +154,6 @@ class BatchEvalRunner:
 
         penalty = np.asarray([a.penalty for _, _, a in pending],
                              dtype=np.float32)
-        rounds_ok = all(a.rounds_eligible for _, _, a in pending)
-        k_cap = max(a.k_cap for _, _, a in pending)
-        rounds = max(a.rounds for _, _, a in pending)
-
-        # Executor policy (same trade as JaxBinPackScheduler.
-        # choose_host_executor): a fused dispatch pays one device round
-        # trip + a [B, G, N] upload; below this op-count the numpy kernels
-        # finish before the request would even reach the device.
-        steps = rounds * g_max if rounds_ok else p_max
-        fused_cost = B * steps * statics.n_real
-        if fused_cost <= JaxBinPackScheduler.HOST_SINGLE_SHOT_COST:
-            self._finish_fused_host(pending, rounds_ok, feasible, asks,
-                                    distinct, counts, group_idx, valid,
-                                    job_counts, k_cap, rounds)
-            if leftovers:
-                self._process_leftovers(leftovers)
-            return
 
         capacity_d, reserved_d = statics.device_capacity_reserved()
         # All fused lanes share the same snapshot base usage (fast-path
@@ -189,12 +189,12 @@ class BatchEvalRunner:
         if leftovers:
             self._process_leftovers(leftovers)
 
-    def _finish_fused_host(self, pending, rounds_ok, feasible, asks,
-                           distinct, counts, group_idx, valid, job_counts,
-                           k_cap, rounds) -> None:
+    def _finish_fused_host(self, pending, rounds_ok, k_cap,
+                           rounds) -> None:
         """Host-executor twin of the fused dispatch: every lane plans
         against the same snapshot base usage via the numpy kernels, one
-        lane at a time (each lane's kernel is vectorized over nodes)."""
+        lane at a time (each lane's kernel is vectorized over nodes),
+        reading the lanes' own arrays — no [B, G, N] stacking."""
         from nomad_tpu.ops.binpack_host import (place_rounds_host,
                                                 place_sequence_host)
         from .jax_binpack import rounds_to_placements
@@ -202,21 +202,21 @@ class BatchEvalRunner:
         statics = pending[0][2].statics
         base_usage = pending[0][2].view.usage  # host array
         n_real = statics.n_real
-        for b, (sched, place, args) in enumerate(pending):
+        for sched, place, args in pending:
             if rounds_ok:
                 chosen_s, score_s, _u = place_rounds_host(
                     statics.capacity, statics.reserved, base_usage,
-                    job_counts[b], feasible[b], asks[b], distinct[b],
-                    counts[b], float(args.penalty), k_cap=k_cap,
-                    rounds=rounds, n_real=n_real)
+                    args.view.job_counts, args.feasible_h, args.asks,
+                    args.distinct, args.counts, float(args.penalty),
+                    k_cap=k_cap, rounds=rounds, n_real=n_real)
                 chosen, scores = rounds_to_placements(
                     args, chosen_s, score_s)
             else:
                 chosen, scores, _u = place_sequence_host(
                     statics.capacity, statics.reserved, base_usage,
-                    job_counts[b], feasible[b], asks[b], distinct[b],
-                    group_idx[b], valid[b], float(args.penalty),
-                    n_real=n_real)
+                    args.view.job_counts, args.feasible_h, args.asks,
+                    args.distinct, args.group_idx, args.valid,
+                    float(args.penalty), n_real=n_real)
             sched.finish_deferred(place, args, chosen, scores)
             self._finish(sched)
 
