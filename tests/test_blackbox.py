@@ -1,0 +1,125 @@
+"""Black-box rig: the real agent process driven over HTTP.
+
+The fourth test rig from the reference's strategy (SURVEY §4:
+testutil/server.go forks the built binary, waits for /v1/agent/self,
+then API tests drive the HTTP surface).  Everything else in tests/ runs
+in-process; this spawns ``python -m nomad_tpu.cli agent -dev`` as a real
+subprocess and exercises submit -> schedule -> run -> reload -> graceful
+shutdown end to end across the process boundary.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+JOB = {"job": {
+    "id": "bb", "name": "bb", "type": "service",
+    "datacenters": ["dc1"],
+    "task_groups": [{
+        "name": "tg", "count": 2,
+        "tasks": [{"name": "sleep", "driver": "raw_exec",
+                   "config": {"command": "/bin/sleep", "args": "300"},
+                   "resources": {"cpu": 50, "memory_mb": 16}}]}]}}
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _http(method: str, url: str, body=None, timeout=10):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read() or b"null")
+
+
+@pytest.fixture
+def agent_proc(tmp_path):
+    port = _free_port()
+    rpc_port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    cfg = tmp_path / "agent.hcl"
+    cfg.write_text('log_level = "WARN"\n')
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "nomad_tpu.cli", "agent", "-dev",
+         "-http-port", str(port), "-rpc-port", str(rpc_port),
+         "-data-dir", str(tmp_path / "data"),
+         "-config", str(cfg)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    base = f"http://127.0.0.1:{port}"
+    deadline = time.monotonic() + 60
+    last = None
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"agent died at boot:\n{proc.stdout.read()}")
+        try:
+            last = _http("GET", base + "/v1/agent/self", timeout=2)
+            break
+        except Exception:
+            time.sleep(0.2)
+    else:
+        proc.kill()
+        raise AssertionError(f"agent never served HTTP; last={last}")
+    yield proc, base
+    if proc.poll() is None:
+        proc.kill()
+        proc.wait(10)
+
+
+def test_blackbox_job_lifecycle(agent_proc):
+    proc, base = agent_proc
+    resp = _http("PUT", base + "/v1/jobs", JOB)
+    eval_id = resp["eval_id"]
+
+    def wait_for(fn, msg, timeout=30):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if fn():
+                return
+            time.sleep(0.2)
+        raise AssertionError(f"timeout: {msg}")
+
+    wait_for(lambda: _http(
+        "GET", f"{base}/v1/evaluation/{eval_id}")["status"] == "complete",
+        "eval complete")
+    wait_for(lambda: len([
+        a for a in _http("GET", base + "/v1/job/bb/allocations")
+        if a["client_status"] == "running"]) == 2, "2 allocs running")
+
+    # SIGHUP config reload across the process boundary.
+    proc.send_signal(signal.SIGHUP)
+    time.sleep(1.0)
+    assert proc.poll() is None, "agent must survive SIGHUP"
+    self_doc = _http("GET", base + "/v1/agent/self")
+    assert self_doc["stats"]["nomad"]["leader"] == "true"
+
+    # Stop the job; allocs wind down.
+    _http("DELETE", base + "/v1/job/bb")
+    wait_for(lambda: all(
+        a["desired_status"] == "stop"
+        for a in _http("GET", base + "/v1/job/bb/allocations")),
+        "job stopped")
+
+    # Graceful shutdown on SIGTERM.
+    proc.send_signal(signal.SIGTERM)
+    assert proc.wait(20) == 0
+    out = proc.stdout.read()
+    assert "shutting down" in out
