@@ -216,6 +216,18 @@ def cmd_agent(args) -> int:
         "leave" if cfg.leave_on_term else "stop"))
     if hasattr(signal, "SIGHUP"):
         signal.signal(signal.SIGHUP, _reload)
+
+    def _dump_metrics(*_sig):
+        # SIGUSR1: dump the in-memory telemetry sink (reference
+        # go-metrics InmemSignal, command.go setupTelemetry).
+        from nomad_tpu.utils.metrics import metrics
+
+        print("==> metrics snapshot:")
+        print(json.dumps(metrics.inmem.snapshot(), indent=2,
+                         default=str))
+
+    if hasattr(signal, "SIGUSR1"):
+        signal.signal(signal.SIGUSR1, _dump_metrics)
     while not stop:
         time.sleep(0.2)
     if stop[0] == "leave":

@@ -106,8 +106,10 @@ def test_blackbox_job_lifecycle(agent_proc):
 
     # SIGHUP config reload across the process boundary.
     proc.send_signal(signal.SIGHUP)
+    # SIGUSR1 metrics dump (reference go-metrics InmemSignal).
+    proc.send_signal(signal.SIGUSR1)
     time.sleep(1.0)
-    assert proc.poll() is None, "agent must survive SIGHUP"
+    assert proc.poll() is None, "agent must survive SIGHUP/SIGUSR1"
     self_doc = _http("GET", base + "/v1/agent/self")
     assert self_doc["stats"]["nomad"]["leader"] == "true"
 
@@ -123,3 +125,4 @@ def test_blackbox_job_lifecycle(agent_proc):
     assert proc.wait(20) == 0
     out = proc.stdout.read()
     assert "shutting down" in out
+    assert "metrics snapshot" in out
