@@ -2,13 +2,17 @@
 
 Capability parity with /root/reference/nomad/rpc.go:20-158 + nomad/pool.go:
 the server's single TCP port serves multiple planes, demuxed by the first
-byte of each connection (0x01 nomad RPC, 0x02 raft hand-off, 0x04 TLS —
-the TLS byte wraps the stream and re-demuxes the inner byte, exactly the
-reference's recursive handleConn at rpc.go:73-117); RPC frames are
-length-prefixed msgpack maps; clients keep pooled connections.  yamux
-multiplexing is replaced by plain framed TCP (one in-flight request per
-pooled connection, pool grows on demand) — same contract, simpler
-substrate.
+byte of each connection (0x01 nomad RPC, 0x02 raft hand-off, 0x03
+multiplexed RPC, 0x04 TLS — the TLS byte wraps the stream and re-demuxes
+the inner byte, exactly the reference's recursive handleConn at
+rpc.go:73-117); RPC frames are length-prefixed msgpack maps.
+
+The 0x03 plane is the yamux equivalent: many logical request/response
+streams share one connection per peer, identified by ``seq``, with
+replies written as handlers finish (out of order), so long blocking
+queries never monopolize a connection.  ConnPool defaults to one mux
+session per peer; the 0x01 plane (one in-flight request per pooled
+connection) remains for simple clients.
 
 Frame format (both directions): 4-byte big-endian length + msgpack body.
 Request body:  {"seq": int, "method": "Service.Method", "args": {...}}
@@ -30,9 +34,14 @@ logger = logging.getLogger("nomad_tpu.server.rpc")
 
 RPC_NOMAD = 0x01
 RPC_RAFT = 0x02
+RPC_MUX = 0x03   # multiplexed: concurrent requests, out-of-order replies
 RPC_TLS = 0x04
 
 MAX_FRAME = 128 * 1024 * 1024
+
+# Per-connection concurrency bound for the mux plane (the reference's
+# yamux accept backlog plays the same role).
+MUX_MAX_INFLIGHT = 128
 
 
 def server_tls_context(cert_file: str, key_file: str,
@@ -110,15 +119,20 @@ class RPCServer:
         self._lock = threading.Lock()
 
         outer = self
+        self._active: set = set()
 
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:
                 sock = self.request
+                with outer._lock:
+                    outer._active.add(sock)
                 try:
                     outer._demux(sock, tls_ok=True)
                 except (ConnectionError, OSError, ssl.SSLError):
                     pass
                 finally:
+                    with outer._lock:
+                        outer._active.discard(sock)
                     try:
                         sock.close()
                     except OSError:
@@ -157,6 +171,20 @@ class RPCServer:
     def shutdown(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        # Sever established connections too (long-poll/mux sessions would
+        # otherwise outlive the listener and talk to a dead server).
+        with self._lock:
+            active = list(self._active)
+            self._active.clear()
+        for sock in active:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     # -- serving ----------------------------------------------------------
     def _demux(self, sock, tls_ok: bool) -> None:
@@ -173,6 +201,8 @@ class RPCServer:
             return
         if first[0] == RPC_NOMAD:
             self._serve_rpc(sock)
+        elif first[0] == RPC_MUX:
+            self._serve_mux(sock)
         elif first[0] == RPC_RAFT:
             if self._raft_handler is not None:
                 self._raft_handler(sock)
@@ -213,6 +243,50 @@ class RPCServer:
                 logger.debug("rpc %s failed: %s", method, e)
                 send_frame(sock, {"seq": seq, "error": str(e),
                                   "result": None})
+
+
+    def _serve_mux(self, sock: socket.socket) -> None:
+        """Multiplexed plane (the reference's yamux, rpc.go:139-158, in
+        role): many logical request/response streams share one TCP
+        connection.  Each request runs in its own worker and replies are
+        written as they finish — keyed by ``seq``, possibly out of
+        order — so a 300s blocking query never stalls the connection's
+        other streams."""
+        wlock = threading.Lock()
+        gate = threading.Semaphore(MUX_MAX_INFLIGHT)
+
+        def worker(req) -> None:
+            try:
+                seq = req.get("seq", 0)
+                method = req.get("method", "")
+                handler = self._handlers.get(method)
+                if handler is None:
+                    resp = {"seq": seq,
+                            "error": f"unknown method {method!r}",
+                            "result": None}
+                else:
+                    try:
+                        resp = {"seq": seq, "error": None,
+                                "result": handler(req.get("args") or {})}
+                    except Exception as e:
+                        logger.debug("rpc %s failed: %s", method, e)
+                        resp = {"seq": seq, "error": str(e),
+                                "result": None}
+                try:
+                    with wlock:
+                        send_frame(sock, resp)
+                except (ConnectionError, OSError):
+                    pass  # peer gone; readers notice on their next recv
+            finally:
+                gate.release()
+
+        while True:
+            req = recv_frame(sock)
+            if req is None:
+                return
+            gate.acquire()
+            threading.Thread(target=worker, args=(req,),
+                             daemon=True).start()
 
 
 class RPCError(Exception):
@@ -270,23 +344,134 @@ class _PooledConn:
             pass
 
 
+class MuxConn:
+    """One multiplexed connection: concurrent callers share the socket,
+    a reader thread routes replies to waiters by ``seq`` (the client
+    half of the 0x03 plane — the reference's yamux session)."""
+
+    def __init__(self, address: tuple,
+                 tls_context: Optional[ssl.SSLContext] = None,
+                 server_hostname: str = "") -> None:
+        self.sock = socket.create_connection(address, timeout=330)
+        if tls_context is not None:
+            self.sock.sendall(bytes([RPC_TLS]))
+            self.sock = tls_context.wrap_socket(
+                self.sock,
+                server_hostname=server_hostname or address[0]
+                if tls_context.check_hostname else None)
+        self.sock.sendall(bytes([RPC_MUX]))
+        self.sock.settimeout(None)  # reader blocks; callers use events
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._waiters: dict = {}   # seq -> [event, response]
+        self._broken: Optional[Exception] = None
+        self._reader = threading.Thread(target=self._read_loop,
+                                        daemon=True, name="rpc-mux-read")
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        err: Exception = ConnectionError("connection closed by server")
+        try:
+            while True:
+                resp = recv_frame(self.sock)
+                if resp is None:
+                    break
+                with self._lock:
+                    waiter = self._waiters.pop(resp.get("seq"), None)
+                if waiter is not None:
+                    waiter[1] = resp
+                    waiter[0].set()
+        except (ConnectionError, OSError, ValueError) as e:
+            err = e
+        with self._lock:
+            self._broken = err
+            waiters, self._waiters = list(self._waiters.values()), {}
+        for waiter in waiters:
+            waiter[0].set()
+
+    def call(self, method: str, args: dict,
+             timeout: Optional[float] = None):
+        waiter = [threading.Event(), None]
+        with self._lock:
+            if self._broken is not None:
+                raise _SendError(str(self._broken))
+            self._seq += 1
+            seq = self._seq
+            self._waiters[seq] = waiter
+            try:
+                send_frame(self.sock, {"seq": seq, "method": method,
+                                       "args": args})
+            except (ConnectionError, OSError) as e:
+                self._waiters.pop(seq, None)
+                raise _SendError(str(e)) from e
+        if not waiter[0].wait(timeout if timeout is not None
+                              else DEFAULT_CALL_TIMEOUT):
+            with self._lock:
+                self._waiters.pop(seq, None)
+            raise TimeoutError(f"rpc {method} timed out")
+        resp = waiter[1]
+        if resp is None:  # reader died
+            raise ConnectionError(str(self._broken))
+        if resp.get("error"):
+            raise RPCError(resp["error"])
+        return resp.get("result")
+
+    @property
+    def broken(self) -> bool:
+        return self._broken is not None
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
 class ConnPool:
-    """Pooled msgpack-RPC client connections per server address
-    (reference nomad/pool.go).  With a ``tls_context`` every pooled
-    connection rides the server's 0x04 TLS plane."""
+    """Client connections per server address (reference nomad/pool.go).
+    Default is one multiplexed session per peer (the 0x03 plane — the
+    reference's pooled yamux sessions); ``multiplex=False`` falls back
+    to plain pooled one-in-flight connections.  With a ``tls_context``
+    every connection rides the server's 0x04 TLS plane."""
 
     def __init__(self, max_per_host: int = 4,
                  tls_context: Optional[ssl.SSLContext] = None,
-                 server_hostname: str = "") -> None:
+                 server_hostname: str = "",
+                 multiplex: bool = True) -> None:
         self.max_per_host = max_per_host
         self.tls_context = tls_context
         self.server_hostname = server_hostname
+        self.multiplex = multiplex
         self._lock = threading.Lock()
         self._pools: dict = {}   # address -> [idle _PooledConn]
+        self._sessions: dict = {}  # address -> MuxConn
+
+    def _session(self, address: tuple) -> MuxConn:
+        with self._lock:
+            sess = self._sessions.get(address)
+            if sess is not None and not sess.broken:
+                return sess
+            if sess is not None:
+                sess.close()
+            sess = MuxConn(address, tls_context=self.tls_context,
+                           server_hostname=self.server_hostname)
+            self._sessions[address] = sess
+            return sess
+
+    def _call_mux(self, address: tuple, method: str, args: dict,
+                  timeout: Optional[float]):
+        sess = self._session(address)
+        try:
+            return sess.call(method, args, timeout)
+        except _SendError:
+            # Session died before the request left: one fresh session.
+            return self._session(address).call(method, args, timeout)
 
     def call(self, address: tuple, method: str, args: dict,
              timeout: Optional[float] = None):
         address = (address[0], address[1])
+        if self.multiplex:
+            return self._call_mux(address, method, args, timeout)
         conn = self._checkout(address)
         try:
             result = conn.call(method, args, timeout)
@@ -340,3 +525,6 @@ class ConnPool:
                 for conn in pool:
                     conn.close()
             self._pools.clear()
+            for sess in self._sessions.values():
+                sess.close()
+            self._sessions.clear()

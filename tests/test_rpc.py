@@ -366,3 +366,107 @@ class TestTLS:
         finally:
             a.shutdown()
             b.shutdown()
+
+
+class TestMuxPlane:
+    """The 0x03 multiplexed plane (yamux equivalent)."""
+
+    def test_out_of_order_responses_share_one_connection(self):
+        """A slow handler must not stall other streams on the same
+        session: fast responses arrive while the slow one is pending."""
+        rpc = RPCServer()
+        release = threading.Event()
+
+        def slow(args):
+            release.wait(10)
+            return {"who": "slow"}
+
+        rpc.register("T.slow", slow)
+        rpc.register("T.fast", lambda args: {"who": "fast"})
+        rpc.start()
+        try:
+            pool = ConnPool()  # multiplex by default
+            results = {}
+
+            def call_slow():
+                results["slow"] = pool.call(rpc.address, "T.slow", {})
+
+            t = threading.Thread(target=call_slow)
+            t.start()
+            time.sleep(0.1)  # slow request is in flight on the session
+            for i in range(5):
+                assert pool.call(rpc.address, "T.fast", {})["who"] == \
+                    "fast"
+            # All of that rode ONE session (and one TCP connection).
+            assert len(pool._sessions) == 1 and not pool._pools
+            release.set()
+            t.join(10)
+            assert results["slow"]["who"] == "slow"
+            pool.shutdown()
+        finally:
+            rpc.shutdown()
+
+    def test_concurrent_mux_calls(self):
+        rpc = RPCServer()
+        rpc.register("T.echo", lambda args: {"n": args["n"]})
+        rpc.start()
+        try:
+            pool = ConnPool()
+            out = [None] * 32
+            def call(i):
+                out[i] = pool.call(rpc.address, "T.echo", {"n": i})["n"]
+            threads = [threading.Thread(target=call, args=(i,))
+                       for i in range(32)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(10)
+            assert out == list(range(32))
+            pool.shutdown()
+        finally:
+            rpc.shutdown()
+
+    def test_mux_session_reconnects_after_server_restart(self):
+        rpc = RPCServer()
+        rpc.register("T.ping", lambda args: "pong")
+        rpc.start()
+        pool = ConnPool()
+        assert pool.call(rpc.address, "T.ping", {}) == "pong"
+        address = rpc.address
+        rpc.shutdown()
+        time.sleep(0.1)
+        rpc2 = RPCServer(host=address[0], port=address[1])
+        rpc2.register("T.ping", lambda args: "pong2")
+        rpc2.start()
+        try:
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                try:
+                    assert pool.call(address, "T.ping", {}) == "pong2"
+                    break
+                except (ConnectionError, OSError):
+                    time.sleep(0.1)
+            else:
+                raise AssertionError("mux session never reconnected")
+            pool.shutdown()
+        finally:
+            rpc2.shutdown()
+
+    def test_mux_errors_propagate(self):
+        rpc = RPCServer()
+
+        def boom(args):
+            raise ValueError("kaboom")
+
+        rpc.register("T.boom", boom)
+        rpc.start()
+        try:
+            pool = ConnPool()
+            with pytest.raises(RPCError, match="kaboom"):
+                pool.call(rpc.address, "T.boom", {})
+            # Session stays healthy after an application error.
+            rpc.register("T.ok", lambda args: 1)
+            assert pool.call(rpc.address, "T.ok", {}) == 1
+            pool.shutdown()
+        finally:
+            rpc.shutdown()
