@@ -91,6 +91,12 @@ class BatchEvalRunner:
         return sched
 
     def process(self, evals: list[Evaluation]) -> None:
+        from nomad_tpu.utils.gctune import gc_pause
+
+        with gc_pause():
+            self._process(evals)
+
+    def _process(self, evals: list[Evaluation]) -> None:
         from nomad_tpu.ops.binpack import place_sequence_batch
 
         this_round, leftovers = self._split_rounds(evals)

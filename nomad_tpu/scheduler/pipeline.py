@@ -49,6 +49,12 @@ class PipelinedEvalRunner(BatchEvalRunner):
         self.latencies: list[float] = []
 
     def process(self, evals: list) -> None:
+        from nomad_tpu.utils.gctune import gc_pause
+
+        with gc_pause():
+            self._process_pipelined(evals)
+
+    def _process_pipelined(self, evals: list) -> None:
         this_round, leftovers = self._split_rounds(evals)
         window: deque = deque()
         for ev in this_round:

@@ -1,7 +1,7 @@
 """Ranking iterators: bin-packing + job anti-affinity.
 
 Capability parity with /root/reference/scheduler/rank.go.  `score_fit` here
-is the scalar path; nomad_tpu/ops/score.py is the vectorized device path.
+is the scalar path; nomad_tpu/ops/binpack.py is the vectorized device path.
 """
 from __future__ import annotations
 

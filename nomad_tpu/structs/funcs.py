@@ -3,7 +3,7 @@
 Capability parity with /root/reference/nomad/structs/funcs.go.  `score_fit`
 (Google BestFit-v3: 20 - (10^freeCpuFrac + 10^freeMemFrac), clamped [0, 18])
 is the exact function the device-side scheduler vectorizes over the fleet
-tensor in nomad_tpu/ops/score.py — this scalar version is the golden
+tensor in nomad_tpu/ops/binpack.py — this scalar version is the golden
 reference for parity tests.
 """
 from __future__ import annotations
