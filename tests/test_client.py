@@ -225,6 +225,80 @@ def test_rkt_driver_fingerprint_absent_without_binary(monkeypatch,
     assert "driver.rkt" not in node.attributes
 
 
+@pytest.fixture
+def fake_bin(tmp_path, monkeypatch):
+    """Install fake binaries on PATH; returns (bindir, invocation log)."""
+    bindir = tmp_path / "fakebin"
+    bindir.mkdir()
+    log = tmp_path / "invocations.log"
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+
+    def install(name: str, body: str = ""):
+        exe = bindir / name
+        exe.write_text(f'#!/bin/sh\necho "{name} $@" >> {log}\n{body}\n')
+        exe.chmod(0o755)
+        return exe
+
+    return install, log
+
+
+def test_java_driver_fingerprint_and_start(tmp_path, fake_bin):
+    install, log = fake_bin
+    install("java",
+            'if [ "$1" = "-version" ]; then '
+            'echo \'openjdk version "21.0.2" 2024\' >&2; fi')
+    from nomad_tpu.client.driver import BUILTIN_DRIVERS
+
+    node = Node(attributes={"kernel.name": "linux"})
+    assert BUILTIN_DRIVERS["java"].fingerprint(ClientConfig(), node)
+    assert node.attributes["driver.java"] == "1"
+    assert node.attributes["driver.java.version"] == "21.0.2"
+
+    ad = AllocDir(str(tmp_path / "alloc"))
+    task = Task(name="jvm", driver="java",
+                config={"jar_path": "/srv/app.jar",
+                        "jvm_options": "-Xmx128m", "args": "serve"},
+                resources=Resources(cpu=100, memory_mb=256))
+    ad.build([task])
+    drv = BUILTIN_DRIVERS["java"](ExecContext(ad, "alloc-j"))
+    handle = drv.start(task)
+    assert handle.wait(10) == 0
+    line = [l for l in log.read_text().splitlines() if "-jar" in l][-1]
+    assert line == "java -Xmx128m -jar /srv/app.jar serve"
+
+
+def test_qemu_driver_fingerprint_and_start(tmp_path, fake_bin):
+    install, log = fake_bin
+    install("qemu-system-x86_64",
+            'if [ "$1" = "--version" ]; then '
+            'echo "QEMU emulator version 8.2.1"; fi')
+    from nomad_tpu.client.driver import BUILTIN_DRIVERS
+
+    node = Node(attributes={"kernel.name": "linux"})
+    assert BUILTIN_DRIVERS["qemu"].fingerprint(ClientConfig(), node)
+    assert node.attributes["driver.qemu.version"] == "8.2.1"
+
+    ad = AllocDir(str(tmp_path / "alloc"))
+    task = Task(name="vm", driver="qemu",
+                config={"image_path": "/srv/disk.img",
+                        "accelerator": "tcg",
+                        "port_map": {"ssh": 22}},
+                resources=Resources(
+                    cpu=500, memory_mb=512,
+                    networks=[NetworkResource(
+                        ip="10.0.0.1", dynamic_ports=["ssh"],
+                        reserved_ports=[31022])]))
+    # map_dynamic_ports pairs labels with assigned reserved ports.
+    ad.build([task])
+    drv = BUILTIN_DRIVERS["qemu"](ExecContext(ad, "alloc-q"))
+    handle = drv.start(task)
+    assert handle.wait(10) == 0
+    line = [l for l in log.read_text().splitlines()
+            if "qemu-system" in l][-1]
+    assert "-m 512M" in line and "file=/srv/disk.img" in line
+    assert "hostfwd=tcp::31022-:22" in line
+
+
 @pytest.mark.skipif(os.geteuid() != 0, reason="requires root")
 def test_exec_driver_drops_privileges(tmp_path):
     """Root exec tasks run as nobody after chroot (reference
