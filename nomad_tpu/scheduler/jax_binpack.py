@@ -88,15 +88,19 @@ _NET_STATIC, _NET_FACTORIES = _proto_of(NetworkResource)
 
 # Native bulk finish (native/port_alloc.cpp bulk_finish): available only
 # when the C extension built AND every AllocMetric factory is a plain dict
-# (the C side creates dicts directly).
-def _native_bulk():
-    from nomad_tpu.utils.native import HAS_NATIVE, native
+# (the C side creates dicts directly).  Resolved once — the answer can't
+# change within a process.
+_NATIVE_BULK_CACHE: list = []
 
-    if not HAS_NATIVE or not hasattr(native, "bulk_finish"):
-        return None
-    if any(fac is not dict for _n, fac in _METRIC_FACTORIES):
-        return None  # pragma: no cover - metric factories are dicts
-    return native
+
+def _native_bulk():
+    if not _NATIVE_BULK_CACHE:
+        from nomad_tpu.utils.native import HAS_NATIVE, native
+
+        ok = HAS_NATIVE and hasattr(native, "bulk_finish") and \
+            all(fac is dict for _n, fac in _METRIC_FACTORIES)
+        _NATIVE_BULK_CACHE.append(native if ok else None)
+    return _NATIVE_BULK_CACHE[0]
 
 
 _METRIC_FACTORY_NAMES = tuple(n for n, _f in _METRIC_FACTORIES)

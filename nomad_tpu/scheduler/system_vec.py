@@ -20,6 +20,8 @@ assignment, so plans are exactly as valid as the sequential scheduler's
 """
 from __future__ import annotations
 
+import time
+
 from random import randrange as _randrange
 
 import numpy as np
@@ -49,8 +51,6 @@ from .util import task_group_constraints
 
 class VectorSystemScheduler(SystemScheduler, FastPlacementMixin):
     def _compute_placements(self, place: list) -> None:
-        import time
-
         start = time.perf_counter()
         statics = fleet_cache.statics_for(self.state)
         view = mirror_for(statics).view_at(self.state, self.plan,

@@ -301,20 +301,29 @@ class _SendError(ConnectionError):
 DEFAULT_CALL_TIMEOUT = 330.0  # > blocking-query max
 
 
+def _dial(address: tuple, plane: int,
+          tls_context: Optional[ssl.SSLContext] = None,
+          server_hostname: str = "") -> socket.socket:
+    """Connect and select a plane: optional outer TLS byte in the clear,
+    handshake, then the inner plane byte rides encrypted (reference
+    rpc.go:73-117)."""
+    sock = socket.create_connection(address, timeout=330)
+    if tls_context is not None:
+        sock.sendall(bytes([RPC_TLS]))
+        sock = tls_context.wrap_socket(
+            sock,
+            server_hostname=server_hostname or address[0]
+            if tls_context.check_hostname else None)
+    sock.sendall(bytes([plane]))
+    return sock
+
+
 class _PooledConn:
     def __init__(self, address: tuple,
                  tls_context: Optional[ssl.SSLContext] = None,
                  server_hostname: str = "") -> None:
-        self.sock = socket.create_connection(address, timeout=330)
-        if tls_context is not None:
-            # Outer TLS byte in the clear, then handshake, then the inner
-            # plane byte rides encrypted (reference rpc.go:73-117).
-            self.sock.sendall(bytes([RPC_TLS]))
-            self.sock = tls_context.wrap_socket(
-                self.sock,
-                server_hostname=server_hostname or address[0]
-                if tls_context.check_hostname else None)
-        self.sock.sendall(bytes([RPC_NOMAD]))
+        self.sock = _dial(address, RPC_NOMAD, tls_context,
+                          server_hostname)
         self.lock = threading.Lock()
         self.seq = 0
 
@@ -352,14 +361,7 @@ class MuxConn:
     def __init__(self, address: tuple,
                  tls_context: Optional[ssl.SSLContext] = None,
                  server_hostname: str = "") -> None:
-        self.sock = socket.create_connection(address, timeout=330)
-        if tls_context is not None:
-            self.sock.sendall(bytes([RPC_TLS]))
-            self.sock = tls_context.wrap_socket(
-                self.sock,
-                server_hostname=server_hostname or address[0]
-                if tls_context.check_hostname else None)
-        self.sock.sendall(bytes([RPC_MUX]))
+        self.sock = _dial(address, RPC_MUX, tls_context, server_hostname)
         self.sock.settimeout(None)  # reader blocks; callers use events
         self._lock = threading.Lock()
         self._seq = 0

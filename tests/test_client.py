@@ -168,27 +168,13 @@ def test_task_runner_reattach(tmp_path):
     tr.destroy()
 
 
-@pytest.fixture
-def fake_rkt(tmp_path, monkeypatch):
-    """A stand-in rkt binary: prints versions, records invocations."""
-    bindir = tmp_path / "bin"
-    bindir.mkdir()
-    log = tmp_path / "rkt-invocations.log"
-    rkt = bindir / "rkt"
-    rkt.write_text(
-        "#!/bin/sh\n"
-        f'echo "$@" >> {log}\n'
-        'if [ "$1" = "version" ]; then\n'
-        '  echo "rkt Version: 1.30.0"\n'
-        '  echo "appc Version: 0.8.11"\n'
-        "fi\n")
-    rkt.chmod(0o755)
-    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
-    return log
-
-
 @pytest.mark.skipif(os.geteuid() != 0, reason="rkt driver is root-only")
-def test_rkt_driver_fingerprint_and_start(tmp_path, fake_rkt):
+def test_rkt_driver_fingerprint_and_start(tmp_path, fake_bin):
+    install, fake_log = fake_bin
+    install("rkt",
+            'if [ "$1" = "version" ]; then '
+            'echo "rkt Version: 1.30.0"; '
+            'echo "appc Version: 0.8.11"; fi')
     from nomad_tpu.client.driver import BUILTIN_DRIVERS
 
     node = Node(attributes={"kernel.name": "linux"})
@@ -206,8 +192,8 @@ def test_rkt_driver_fingerprint_and_start(tmp_path, fake_rkt):
     drv = BUILTIN_DRIVERS["rkt"](ExecContext(ad, "alloc-rkt"))
     handle = drv.start(task)
     assert handle.wait(10) == 0
-    line = [l for l in fake_rkt.read_text().splitlines()
-            if "run" in l][-1]
+    line = [l for l in fake_log.read_text().splitlines()
+            if " run " in l][-1]
     assert "--insecure-skip-verify" in line
     assert "run --mds-register=false coreos.com/etcd:v2.0.4" in line
     assert "--exec=/etcd" in line and line.endswith("-- --version")
