@@ -266,7 +266,7 @@ def main() -> None:
         h1.state.upsert_job(h1.next_index(), j)
     bench_pipelined_stream(h1, jobs1, depth=args.depth)  # warm caches
     dev_s, dev_lats, dev_placed = bench_pipelined_stream(
-        h1, jobs1, depth=args.depth, repeats=2)
+        h1, jobs1, depth=args.depth, repeats=3)
     seq_s, seq_lats, seq_placed = bench_sequential_stream(
         h1, jobs1, "service")
     assert dev_placed == seq_placed, (dev_placed, seq_placed)
@@ -288,7 +288,7 @@ def main() -> None:
         h2.state.upsert_job(h2.next_index(), j)
     bench_pipelined_stream(h2, jobs2, depth=args.depth)  # warm caches
     dev_s, dev_lats, dev_placed = bench_pipelined_stream(
-        h2, jobs2, depth=args.depth, repeats=2)
+        h2, jobs2, depth=args.depth, repeats=3)
     seq_s, seq_lats, seq_placed = bench_sequential_stream(
         h2, jobs2, "batch")
     assert dev_placed == seq_placed, (dev_placed, seq_placed)
@@ -341,7 +341,7 @@ def main() -> None:
     # work, so evals/sec is bound by per-eval host time, not the RTT.
     bench_pipelined_stream(h4, jobs4, depth=args.depth)  # warm caches
     dev_s, dev_lats, _ = bench_pipelined_stream(
-        h4, jobs4, depth=args.depth, repeats=2)
+        h4, jobs4, depth=args.depth, repeats=3)
     seq_s, seq_lats, _ = bench_sequential_stream(h4, jobs4, "service")
     configs["4_binpack_10kn_x_1ktg"] = {
         "evals_per_sec": round(len(jobs4) / dev_s, 3),

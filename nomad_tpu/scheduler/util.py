@@ -56,13 +56,23 @@ class DiffResult:
 
 
 def materialize_task_groups(job: Optional[Job]) -> dict:
-    """Count-expand task groups to named instances job.tg[i]."""
-    out: dict = {}
+    """Count-expand task groups to named instances job.tg[i].
+
+    Memoized per (job object, modify_index): store-resident jobs are
+    immutable by contract and every store write copies, so re-evals of
+    the same job version (node-update storms re-evaluate every affected
+    job) reuse the expansion.  Callers treat the mapping as read-only
+    (diff_allocs only reads it)."""
     if job is None:
-        return out
+        return {}
+    cached = job.__dict__.get("_materialized")
+    if cached is not None and cached[0] == job.modify_index:
+        return cached[1]
+    out: dict = {}
     for tg in job.task_groups:
         for i in range(tg.count):
             out[f"{job.name}.{tg.name}[{i}]"] = tg
+    job.__dict__["_materialized"] = (job.modify_index, out)
     return out
 
 
