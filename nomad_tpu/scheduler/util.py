@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import random
 from dataclasses import dataclass, field
+from types import MappingProxyType
 from typing import Callable, Optional
 
 from nomad_tpu.structs import (
@@ -72,8 +73,11 @@ def materialize_task_groups(job: Optional[Job]) -> dict:
     for tg in job.task_groups:
         for i in range(tg.count):
             out[f"{job.name}.{tg.name}[{i}]"] = tg
-    job.__dict__["_materialized"] = (job.modify_index, out)
-    return out
+    # Read-only view: a caller mutation would otherwise poison every
+    # later eval of this job version through the shared cache.
+    view = MappingProxyType(out)
+    job.__dict__["_materialized"] = (job.modify_index, view)
+    return view
 
 
 def diff_allocs(job: Optional[Job], tainted_nodes: dict, required: dict,

@@ -473,6 +473,21 @@ def test_materialize_task_groups():
     assert materialize_task_groups(None) == {}
 
 
+def test_materialize_task_groups_memoized_per_version():
+    job = mock.job()
+    out = materialize_task_groups(job)
+    # Cache hit: identical object for the same job version.
+    assert materialize_task_groups(job) is out
+    # The shared mapping is read-only (mutation would poison the cache).
+    with pytest.raises(TypeError):
+        out["rogue"] = None
+    # A new job version recomputes.
+    job.task_groups[0].count = 3
+    job.modify_index += 1
+    out2 = materialize_task_groups(job)
+    assert out2 is not out and len(out2) == 3
+
+
 def test_diff_allocs_buckets():
     job = mock.job()
     required = materialize_task_groups(job)
