@@ -84,6 +84,94 @@ def agent_proc(tmp_path):
         proc.wait(10)
 
 
+def _spawn_agent(tmp_path, tag, *argv):
+    http_port = _free_port()
+    rpc_port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "nomad_tpu.cli", "agent",
+         "-http-port", str(http_port), "-rpc-port", str(rpc_port),
+         "-data-dir", str(tmp_path / f"data-{tag}")] + list(argv),
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    return proc, f"http://127.0.0.1:{http_port}", rpc_port
+
+
+def _wait_http(proc, base, timeout=60):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(f"agent died:\n{proc.stdout.read()}")
+        try:
+            return _http("GET", base + "/v1/agent/self", timeout=2)
+        except Exception:
+            time.sleep(0.2)
+    raise AssertionError("agent never served HTTP")
+
+
+def test_blackbox_two_process_cluster(tmp_path):
+    """A server-only agent and a client-only agent as separate OS
+    processes: registration, heartbeats, long-poll alloc delivery, and
+    task execution all cross a real process + network boundary."""
+    server = client = None
+    try:
+        server, server_base, server_rpc = _spawn_agent(
+            tmp_path, "srv", "-server")
+        _wait_http(server, server_base)
+        cli_cfg = tmp_path / "client.hcl"
+        cli_cfg.write_text(
+            'client {\n'
+            '  options {\n'
+            '    "driver.raw_exec.enable" = "1"\n'
+            '    "fingerprint.skip_accel" = "1"\n'
+            '  }\n'
+            '}\n')
+        client, client_base, _ = _spawn_agent(
+            tmp_path, "cli", "-client",
+            "-servers", f"127.0.0.1:{server_rpc}",
+            "-config", str(cli_cfg))
+        _wait_http(client, client_base)
+
+        def wait_for(fn, msg, timeout=45):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if fn():
+                    return
+                time.sleep(0.3)
+            raise AssertionError(f"timeout: {msg}")
+
+        # Client node registers with the server over real RPC.
+        wait_for(lambda: any(
+            n["status"] == "ready"
+            for n in _http("GET", server_base + "/v1/nodes")),
+            "client node ready")
+
+        # A raw_exec task needs the option enabled: client agents enable
+        # it via config; dev-mode defaults don't apply here, so use a
+        # job the exec fallback can run.
+        job = dict(JOB)
+        resp = _http("PUT", server_base + "/v1/jobs", job)
+        wait_for(lambda: _http(
+            "GET",
+            f"{server_base}/v1/evaluation/{resp['eval_id']}"
+        )["status"] == "complete", "eval complete")
+        wait_for(lambda: any(
+            a["client_status"] == "running"
+            for a in _http("GET", server_base + "/v1/job/bb/allocations")),
+            "alloc running on remote client")
+
+        # The client's own HTTP agent-self sees its allocs.
+        self_doc = _http("GET", client_base + "/v1/agent/self")
+        assert self_doc["stats"]["client"]["allocs"] >= 1
+    finally:
+        for proc in (client, server):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(10)
+
+
 def test_blackbox_job_lifecycle(agent_proc):
     proc, base = agent_proc
     resp = _http("PUT", base + "/v1/jobs", JOB)
