@@ -10,6 +10,8 @@ from __future__ import annotations
 import logging
 import os
 import tempfile
+import threading
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -69,6 +71,7 @@ class AgentConfig:
     client_options: dict = field(default_factory=dict)
     node_class: str = ""
     meta: dict = field(default_factory=dict)
+    retry_join: list = field(default_factory=list)  # gossip addrs
     # Config-file parity fields (reference command/agent/config.go)
     log_level: str = "INFO"
     enable_debug: bool = False
@@ -138,14 +141,50 @@ class Agent:
             cfg.data_dir = self.config.server_data_dir
         elif self.config.data_dir and not self.config.dev_mode:
             cfg.data_dir = os.path.join(self.config.data_dir, "server")
+        # Gossip membership for server agents (reference: serf always
+        # runs on servers).  Dev mode binds an ephemeral port so several
+        # local agents never collide on the default serf port.
+        cfg.enable_gossip = True
+        cfg.gossip_port = 0 if self.config.dev_mode \
+            else self.config.serf_port
+        cfg.server_name = self.config.name or ""
+        cfg.bootstrap_expect = max(1, self.config.bootstrap_expect)
         if self.config.raft_peers:
             cfg.raft_mode = "net"
             cfg.raft_peers = list(self.config.raft_peers)
+        elif cfg.bootstrap_expect > 1:
+            # Gossip-bootstrapped cluster: networked raft with deferred
+            # elections until bootstrap_expect servers are visible.
+            cfg.raft_mode = "net"
         self.server = Server(cfg)
-        if not self.config.raft_peers:
+        if not self.config.raft_peers and cfg.bootstrap_expect <= 1:
             # Single-server (or dev) mode: become leader immediately
             # (reference StartAsLeader / bootstrap_expect=1).
             self.server.establish_leadership()
+        if self.config.retry_join:
+            threading.Thread(target=self._retry_join, daemon=True,
+                             name="agent-retry-join").start()
+
+    def _retry_join(self) -> None:
+        """Keep trying the configured gossip addresses until a join
+        lands or the agent shuts down (reference command.go retry-join:
+        indefinite by default)."""
+        gossip = getattr(self.server, "gossip", None)
+        if gossip is None:
+            return
+        targets = [tuple(t) for t in self.config.retry_join]
+        while not self.server._shutdown.is_set():
+            for target in targets:
+                try:
+                    gossip.join(target)
+                except Exception:
+                    logger.warning("retry-join to %s failed", target,
+                                   exc_info=True)
+            if len(gossip.members()) > 1:
+                logger.info("retry-join succeeded (%d members)",
+                            len(gossip.members()))
+                return
+            time.sleep(1.0)
 
     def _setup_client(self) -> None:
         from nomad_tpu.structs import Node

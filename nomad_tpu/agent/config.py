@@ -165,6 +165,9 @@ def apply_to_agent_config(cfg: "AgentConfig", tree: dict) -> "AgentConfig":
                     value["enabled_schedulers"])
             if "data_dir" in value:
                 cfg.server_data_dir = value["data_dir"]
+            if "retry_join" in value:
+                cfg.retry_join = [_addr(s)
+                                  for s in _as_list(value["retry_join"])]
         elif key == "telemetry":
             cfg.telemetry = dict(value)
         elif key == "atlas":

@@ -89,6 +89,8 @@ def main(argv=None) -> int:
     p.add_argument("-bind", default="127.0.0.1")
     p.add_argument("-http-port", type=int, default=4646)
     p.add_argument("-rpc-port", type=int, default=4647)
+    p.add_argument("-serf-port", type=int, default=4648,
+                   help="gossip port for server agents (0 = ephemeral)")
     p.add_argument("-servers", default="",
                    help="comma-separated server RPC addrs (client mode)")
     p.add_argument("-config", action="append", default=[],
@@ -170,6 +172,7 @@ def cmd_agent(args) -> int:
             bind_addr=args.bind,
             http_port=args.http_port,
             rpc_port=args.rpc_port,
+            serf_port=args.serf_port,
         )
         if args.servers:
             for part in args.servers.split(","):

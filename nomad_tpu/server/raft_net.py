@@ -88,7 +88,8 @@ class NetRaft:
                  election_timeout: tuple = (0.15, 0.30),
                  heartbeat_interval: float = 0.05,
                  snapshot_threshold: int = 8192,
-                 data_dir: Optional[str] = None) -> None:
+                 data_dir: Optional[str] = None,
+                 defer_elections: bool = False) -> None:
         self.fsm = fsm
         self.rpc = rpc_server
         self.pool = conn_pool
@@ -115,6 +116,7 @@ class NetRaft:
         self._futures: dict = {}   # log index -> ApplyFuture
         self._stop = threading.Event()
         self._election_deadline = 0.0
+        self._elections_enabled = not defer_elections
         self._snap_blob: Optional[bytes] = None
         self._snap_index = 0
         self._snap_term = 0
@@ -157,6 +159,14 @@ class NetRaft:
                 if index == self._last_index() + 1:
                     self._log.append({"term": term, "index": index,
                                       "data": data})
+
+        # Deferral applies to FIRST boots only: a node that restored
+        # persisted raft state belongs to an already-bootstrapped cluster
+        # and must be able to elect with whatever quorum survives a
+        # restart (reference maybeBootstrap: skip when LastIndex != 0).
+        if not self._elections_enabled and (
+                self._term > 0 or self._last_index() > 0):
+            self._elections_enabled = True
 
         # Ordered leadership notifications.
         self._notify: list = []
@@ -320,7 +330,28 @@ class NetRaft:
         e = self._entry_at(index)
         return e["term"] if e else None
 
+    def enable_elections(self) -> None:
+        """Arm the election timer of a deferred (bootstrap-expect) node.
+
+        Until called, the node is passive: it votes and accepts appends
+        (so it can be absorbed into an already-formed cluster) but never
+        becomes a candidate — the gossip layer calls this once the
+        expected server count is visible, so no server can elect itself
+        leader of a one-node cluster and commit entries that a later
+        join would silently discard (reference bootstrap-expect,
+        command/agent/command.go + nomad/serf.go maybeBootstrap)."""
+        with self._lock:
+            if not self._elections_enabled:
+                self._elections_enabled = True
+                self._reset_election_timer()
+
+    def elections_enabled(self) -> bool:
+        return self._elections_enabled
+
     def _reset_election_timer(self) -> None:
+        if not self._elections_enabled:
+            self._election_deadline = float("inf")
+            return
         lo, hi = self.election_timeout
         self._election_deadline = time.monotonic() + random.uniform(lo, hi)
 

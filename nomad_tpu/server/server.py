@@ -143,13 +143,21 @@ class Server:
 
         if self.config.raft_mode == "net":
             from .raft_net import NetRaft
+            # bootstrap-expect > 1 with no static peer list: stay passive
+            # (no self-election) until gossip shows the expected server
+            # count, so a booting server can never commit entries as the
+            # leader of its own one-node cluster (reference serf.go
+            # maybeBootstrap).
+            defer = self.config.bootstrap_expect > 1 and \
+                not self.config.raft_peers and self.config.enable_gossip
             self.raft = NetRaft(
                 self.fsm, self.rpc_server, self.conn_pool,
                 peers=self.config.raft_peers,
                 election_timeout=self.config.raft_election_timeout,
                 heartbeat_interval=self.config.raft_heartbeat_interval,
                 snapshot_threshold=self.config.raft_snapshot_threshold,
-                data_dir=self.config.data_dir)
+                data_dir=self.config.data_dir,
+                defer_elections=defer)
             self.raft.notify_leadership(self._on_leadership_change)
         else:
             log_store = snapshots = None
@@ -212,6 +220,15 @@ class Server:
         add_peer = getattr(self.raft, "add_peer", None)
         if rpc and callable(add_peer):
             add_peer((rpc[0], rpc[1]))
+        # bootstrap-expect: arm elections once the expected quorum of
+        # same-region servers is visible (self + peers).
+        enable = getattr(self.raft, "enable_elections", None)
+        if callable(enable) and not self.raft.elections_enabled() and \
+                len(self.raft.peer_addresses()) >= \
+                self.config.bootstrap_expect:
+            logger.info("bootstrap-expect %d reached; enabling elections",
+                        self.config.bootstrap_expect)
+            enable()
 
     def _gossip_fail(self, member) -> None:
         if member.tags.get("role") != "nomad-server":

@@ -49,35 +49,11 @@ def _http(method: str, url: str, body=None, timeout=10):
 
 @pytest.fixture
 def agent_proc(tmp_path):
-    port = _free_port()
-    rpc_port = _free_port()
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["JAX_PLATFORMS"] = "cpu"
     cfg = tmp_path / "agent.hcl"
     cfg.write_text('log_level = "WARN"\n')
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "nomad_tpu.cli", "agent", "-dev",
-         "-http-port", str(port), "-rpc-port", str(rpc_port),
-         "-data-dir", str(tmp_path / "data"),
-         "-config", str(cfg)],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-        text=True)
-    base = f"http://127.0.0.1:{port}"
-    deadline = time.monotonic() + 60
-    last = None
-    while time.monotonic() < deadline:
-        if proc.poll() is not None:
-            raise AssertionError(
-                f"agent died at boot:\n{proc.stdout.read()}")
-        try:
-            last = _http("GET", base + "/v1/agent/self", timeout=2)
-            break
-        except Exception:
-            time.sleep(0.2)
-    else:
-        proc.kill()
-        raise AssertionError(f"agent never served HTTP; last={last}")
+    proc, base, _rpc = _spawn_agent(tmp_path, "dev", "-dev",
+                                    "-config", str(cfg))
+    _wait_http(proc, base)
     yield proc, base
     if proc.poll() is None:
         proc.kill()
@@ -93,6 +69,7 @@ def _spawn_agent(tmp_path, tag, *argv):
     proc = subprocess.Popen(
         [sys.executable, "-m", "nomad_tpu.cli", "agent",
          "-http-port", str(http_port), "-rpc-port", str(rpc_port),
+         "-serf-port", "0",  # ephemeral: parallel agents never collide
          "-data-dir", str(tmp_path / f"data-{tag}")] + list(argv),
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True)

@@ -95,3 +95,105 @@ def test_gossip_reconciles_raft_peers():
     finally:
         for s in servers:
             s.shutdown()
+
+
+def test_bootstrap_expect_defers_elections_until_quorum():
+    """bootstrap_expect > 1: no server may elect itself before gossip
+    shows the expected count (the reference's maybeBootstrap) — a lone
+    booting server must never commit entries to a one-node cluster that
+    a later join would discard."""
+    cfg = dict(raft_mode="net", raft_election_timeout=(0.05, 0.10),
+               raft_heartbeat_interval=0.02, num_schedulers=1,
+               enable_gossip=True, bootstrap_expect=3)
+    servers = [Server(ServerConfig(**cfg)) for _ in range(2)]
+    try:
+        # Two of three: still passive, nobody becomes leader.
+        servers[1].gossip.join(servers[0].gossip.addr)
+        time.sleep(0.8)
+        assert not any(s.raft.is_leader() for s in servers)
+        assert not any(s.raft.elections_enabled() for s in servers)
+
+        # Third server arrives: quorum visible, elections arm, one wins.
+        servers.append(Server(ServerConfig(**cfg)))
+        servers[2].gossip.join(servers[0].gossip.addr)
+        wait_until(lambda: sum(1 for s in servers
+                               if s.raft.is_leader()) == 1,
+                   msg="single leader after bootstrap quorum")
+
+        # The cluster is fully functional: writes replicate everywhere.
+        import nomad_tpu.mock as mock
+
+        leader = next(s for s in servers if s.raft.is_leader())
+        node = mock.node()
+        leader.node_register(node)
+        wait_until(lambda: all(
+            s.fsm.state.node_by_id(node.id) is not None
+            for s in servers), msg="replication after bootstrap")
+    finally:
+        for s in servers:
+            s.shutdown()
+
+
+def test_agent_bootstrap_expect_cluster(tmp_path):
+    """Three server agents with bootstrap_expect=3 + retry_join form one
+    raft cluster through the agent layer (reference `nomad agent -server
+    -bootstrap-expect 3 -retry-join ...`)."""
+    from nomad_tpu.agent import Agent, AgentConfig
+
+    agents = []
+    try:
+        first = Agent(AgentConfig(
+            server_enabled=True, dev_mode=False, bootstrap_expect=3,
+            http_port=0, rpc_port=0, serf_port=0,
+            num_schedulers=1))
+        agents.append(first)
+        seed = first.server.gossip.addr
+        for _ in range(2):
+            agents.append(Agent(AgentConfig(
+                server_enabled=True, dev_mode=False, bootstrap_expect=3,
+                http_port=0, rpc_port=0, serf_port=0,
+                num_schedulers=1, retry_join=[seed])))
+        wait_until(lambda: all(
+            len(a.server.raft.peer_addresses()) == 3 for a in agents),
+            timeout=20, msg="full gossip->raft membership")
+        wait_until(lambda: sum(
+            1 for a in agents if a.server.raft.is_leader()) == 1,
+            timeout=20, msg="agent cluster leader")
+    finally:
+        for a in agents:
+            a.shutdown()
+
+
+def test_bootstrap_deferral_skipped_after_restart(tmp_path):
+    """A restarted server with persisted raft state must NOT defer
+    elections: survivors of a bootstrapped cluster may hold raft quorum
+    without gossip ever showing bootstrap_expect members again
+    (code-review regression; reference maybeBootstrap skips when
+    LastIndex != 0)."""
+    import json as _json
+    import os as _os
+
+    def mk(data_dir):
+        return ServerConfig(
+            raft_mode="net", raft_election_timeout=(0.05, 0.10),
+            raft_heartbeat_interval=0.02, num_schedulers=1,
+            enable_gossip=True, bootstrap_expect=3,
+            data_dir=str(data_dir))
+
+    # Fresh boot: passive until quorum is visible.
+    fresh = Server(mk(tmp_path / "fresh"))
+    try:
+        assert not fresh.raft.elections_enabled()
+    finally:
+        fresh.shutdown()
+
+    # Prior raft state on disk (a persisted term): elections stay armed.
+    veteran_dir = tmp_path / "veteran"
+    _os.makedirs(veteran_dir / "raft")
+    with open(veteran_dir / "raft" / "meta.json", "w") as fh:
+        _json.dump({"term": 3, "voted_for": None}, fh)
+    veteran = Server(mk(veteran_dir))
+    try:
+        assert veteran.raft.elections_enabled()
+    finally:
+        veteran.shutdown()
