@@ -470,3 +470,38 @@ class TestMuxPlane:
             pool.shutdown()
         finally:
             rpc.shutdown()
+
+
+def test_many_blocking_queries_share_one_mux_session(srv, pool):
+    """Concurrent blocking queries park server-side on ONE mux session;
+    a single write wakes them all (the reference needs a yamux stream
+    per query — here they're seq-multiplexed frames)."""
+    import nomad_tpu.mock as mock
+
+    srv.node_register(mock.node(0))  # nonzero base index
+    base = pool.call(srv.rpc_address(), "Node.List", {})["index"]
+    results = []
+    errors = []
+
+    def blocker(i):
+        try:
+            resp = pool.call(srv.rpc_address(), "Node.List",
+                             {"min_query_index": base,
+                              "max_query_time": 10.0})
+            results.append((i, resp["index"]))
+        except Exception as e:  # pragma: no cover - fail loudly below
+            errors.append(e)
+
+    threads = [threading.Thread(target=blocker, args=(i,))
+               for i in range(16)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)  # all parked server-side
+    assert not results
+    srv.node_register(mock.node())
+    for t in threads:
+        t.join(15)
+    assert not errors and len(results) == 16
+    assert all(idx > base for _i, idx in results)
+    # All sixteen rode one multiplexed session.
+    assert len(pool._sessions) == 1
