@@ -146,6 +146,46 @@ PyObject* add_all(PyObject*, PyObject* args) {
   return PyBool_FromLong(collide);
 }
 
+// format_uuids(data: bytes) -> list[str]
+//
+// Formats len(data)/16 UUID strings ("8-4-4-4-12" lowercase hex) from raw
+// entropy bytes.  The Python twin (structs/model.py generate_uuids) hex()s
+// the same buffer and slices; this builds each 36-char ASCII string
+// directly.  The scheduler mints one UUID per placement (1k/eval), so the
+// slicing loop was visible in profiles.
+PyObject* format_uuids(PyObject*, PyObject* args) {
+  const char* data;
+  Py_ssize_t len;
+  if (!PyArg_ParseTuple(args, "y#", &data, &len)) return nullptr;
+  if (len % 16 != 0) {
+    PyErr_SetString(PyExc_ValueError, "data length must be a multiple of 16");
+    return nullptr;
+  }
+  static const char hexdig[] = "0123456789abcdef";
+  // Dash positions in the 36-char output (after hex nibbles 8,12,16,20).
+  Py_ssize_t n = len / 16;
+  PyObject* out = PyList_New(n);
+  if (!out) return nullptr;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* s = PyUnicode_New(36, 127);
+    if (!s) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    Py_UCS1* w = PyUnicode_1BYTE_DATA(s);
+    const unsigned char* b =
+        reinterpret_cast<const unsigned char*>(data) + i * 16;
+    Py_ssize_t o = 0;
+    for (Py_ssize_t j = 0; j < 16; j++) {
+      if (j == 4 || j == 6 || j == 8 || j == 10) w[o++] = '-';
+      w[o++] = hexdig[b[j] >> 4];
+      w[o++] = hexdig[b[j] & 0xF];
+    }
+    PyList_SET_ITEM(out, i, s);  // steals
+  }
+  return out;
+}
+
 // ---------------------------------------------------------------------------
 // bulk_finish: the scheduler finish loop's happy path in C.
 //
@@ -186,7 +226,6 @@ struct Interned {
   PyObject* coalesced = nullptr;
   PyObject* dunder_new = nullptr;
   PyObject* dunder_dict = nullptr;
-  PyObject* has_allocs = nullptr;
   PyObject* proposed_allocs = nullptr;
   PyObject* binpack_suffix = nullptr;
   bool ok = false;
@@ -218,7 +257,6 @@ Interned& interned() {
     s.coalesced = PyUnicode_InternFromString("coalesced_failures");
     s.dunder_new = PyUnicode_InternFromString("__new__");
     s.dunder_dict = PyUnicode_InternFromString("__dict__");
-    s.has_allocs = PyUnicode_InternFromString("has_allocs_on_node");
     s.proposed_allocs = PyUnicode_InternFromString("proposed_allocs");
     s.binpack_suffix = PyUnicode_InternFromString(".binpack");
     s.ok = true;
@@ -379,7 +417,7 @@ int node_base(PyObject* net_base, PyObject* base_fn, PyObject* ch_key,
 }
 
 // bulk_finish(place, group_idx, chosen, scores, uuids, slots, nodes,
-//             node_net, net_base, base_fn, state, ctx, plan_nu, plan_na,
+//             node_net, net_base, base_fn, allocs_idx, ctx, plan_nu, plan_na,
 //             failed_list, alloc_proto, metric_proto, metric_factories,
 //             alloc_cls, metric_cls, res_cls, net_cls,
 //             statuses, port_lcg, min_port, max_port)
@@ -390,7 +428,8 @@ int node_base(PyObject* net_base, PyObject* base_fn, PyObject* ch_key,
 // statuses = (run, pending, failed, client_failed, failed_desc).
 PyObject* bulk_finish(PyObject*, PyObject* args) {
   PyObject *place, *group_idx, *chosen, *scores, *uuids, *slots, *nodes;
-  PyObject *node_net, *net_base, *base_fn, *state, *ctx, *plan_nu, *plan_na;
+  PyObject *node_net, *net_base, *base_fn, *allocs_idx, *ctx, *plan_nu,
+      *plan_na;
   PyObject *failed_list, *alloc_proto, *metric_proto, *metric_factories;
   PyObject *alloc_cls, *metric_cls, *res_cls, *net_cls, *statuses;
   long long lcg;  // 64-bit: lcg*1103515245 overflows a 32-bit long
@@ -398,7 +437,7 @@ PyObject* bulk_finish(PyObject*, PyObject* args) {
   if (!PyArg_ParseTuple(
           args, "OOOOOOOOOOOOOOOOOOOOOOOLll", &place, &group_idx, &chosen,
           &scores, &uuids, &slots, &nodes, &node_net, &net_base, &base_fn,
-          &state, &ctx, &plan_nu, &plan_na, &failed_list, &alloc_proto,
+          &allocs_idx, &ctx, &plan_nu, &plan_na, &failed_list, &alloc_proto,
           &metric_proto, &metric_factories, &alloc_cls, &metric_cls,
           &res_cls, &net_cls, &statuses, &lcg, &min_port,
           &max_port)) {
@@ -518,20 +557,23 @@ PyObject* bulk_finish(PyObject*, PyObject* args) {
           goto fail;
         }
         long bw = PyLong_AsLong(PyTuple_GET_ITEM(base, 1));
-        // Probe for proposed allocs needing the exact walk.
-        PyObject* has =
-            PyObject_CallMethodObjArgs(state, I.has_allocs, node_id,
-                                       nullptr);
-        if (!has) {
-          Py_DECREF(used);
-          Py_DECREF(ch_key);
-          Py_DECREF(node_id);
-          Py_DECREF(tg_key);
-          Py_DECREF(tg);
-          goto fail;
+        // Probe for proposed allocs needing the exact walk: direct
+        // lookup in the store's allocs-by-node index (node_id ->
+        // alloc-id collection; snapshots copy-on-write so the borrowed
+        // dict is stable for the eval).
+        int busy;
+        {
+          PyObject* entry = PyDict_GetItemWithError(allocs_idx, node_id);
+          if (!entry && PyErr_Occurred()) {
+            Py_DECREF(used);
+            Py_DECREF(ch_key);
+            Py_DECREF(node_id);
+            Py_DECREF(tg_key);
+            Py_DECREF(tg);
+            goto fail;
+          }
+          busy = entry ? PyObject_IsTrue(entry) : 0;
         }
-        int busy = PyObject_IsTrue(has);
-        Py_DECREF(has);
         if (busy == 0) {
           int c1 = PyDict_Contains(plan_nu, node_id);
           int c2 = c1 == 0 ? PyDict_Contains(plan_na, node_id) : c1;
@@ -915,6 +957,8 @@ PyMethodDef methods[] = {
      "Add ports to a used-port set; returns True on any collision."},
     {"bulk_finish", bulk_finish, METH_VARARGS,
      "Scheduler finish-loop happy path: bulk alloc construction."},
+    {"format_uuids", format_uuids, METH_VARARGS,
+     "Format UUID strings from raw entropy bytes (16 per UUID)."},
     {nullptr, nullptr, 0, nullptr},
 };
 
@@ -926,5 +970,14 @@ PyModuleDef module = {
 }  // namespace
 
 PyMODINIT_FUNC PyInit__nomad_native(void) {
-  return PyModule_Create(&module);
+  PyObject* m = PyModule_Create(&module);
+  if (m == nullptr) return nullptr;
+  // Bumped on any signature/behavior change of an existing function so a
+  // stale prebuilt .so (same names, old ABI) is detected by the loader
+  // (nomad_tpu/utils/native.py) instead of crashing mid-eval.
+  if (PyModule_AddIntConstant(m, "ABI_VERSION", 2) < 0) {
+    Py_DECREF(m);
+    return nullptr;
+  }
+  return m;
 }

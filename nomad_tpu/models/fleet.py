@@ -29,6 +29,7 @@ exact per-device/port assignment runs host-side after selection
 """
 from __future__ import annotations
 
+import itertools
 import threading
 
 from dataclasses import dataclass, field
@@ -65,6 +66,9 @@ def _pad_to(n: int) -> int:
     return p
 
 
+_FLEET_GEN = itertools.count()
+
+
 @dataclass
 class FleetStatics:
     """Node-static tensors + host mirrors, cached per nodes-table generation."""
@@ -91,6 +95,10 @@ class FleetStatics:
     # None: the node-static half of the fast network assigner
     # (scheduler/jax_binpack.py _node_net_init).
     net_base: dict = field(default_factory=dict)
+    # Process-unique generation id: lets per-job prep caches key on the
+    # fleet generation WITHOUT holding a strong ref that would pin
+    # evicted generations (and their device buffers) alive.
+    gen: int = field(default_factory=lambda: next(_FLEET_GEN))
     # Lazily attached incremental usage mirror (see mirror_for()).
     mirror: Optional["UsageMirror"] = None
 

@@ -142,7 +142,8 @@ class GenericScheduler:
         allocs = filter_terminal_allocs(allocs)
 
         tainted = tainted_nodes(self.state, allocs)
-        diff = diff_allocs(self.job, tainted, groups, allocs)
+        diff = diff_allocs(self.job, tainted, groups, allocs,
+                           cache_fresh=True)
 
         for tup in diff.stop:
             self.plan.append_update(tup.alloc, ALLOC_DESIRED_STATUS_STOP,

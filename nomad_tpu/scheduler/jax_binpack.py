@@ -147,7 +147,13 @@ class DeviceArgs:
                  "n_groups", "n_place",
                  # rounds-mode plan (see ops/binpack.py place_rounds):
                  "counts", "slot_placements", "k_cap", "rounds",
-                 "rounds_eligible")
+                 "rounds_eligible",
+                 # finish-loop derivations shared via the prep cache:
+                 # fast_all = every slot takes the O(1) network path;
+                 # group_l = group_idx[:n_place].tolist(); slots_c is a
+                 # one-element holder lazily filled with the native
+                 # bulk-finish slot table (built on first finish).
+                 "fast_all", "group_l", "slots_c")
 
     def __init__(self, **kw) -> None:
         for k, v in kw.items():
@@ -544,6 +550,28 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
             view = build_usage(statics, self._proposed_allocs_all(),
                                job_id=self.job.id)
 
+        # Prep template cache: everything below is a pure function of
+        # (job version, place list, fleet statics, batch flag).  The
+        # fresh-placement diff (util.diff_allocs cache_fresh) hands out
+        # an identity-stable place list per job version, so re-evals of
+        # the same job against the same fleet (eval storms, plan-retry
+        # attempts, node-update re-evals) skip the 1k-group derivation
+        # entirely.  Cached fields are shared READ-ONLY across evals.
+        job = self.job
+        tmpl = job.__dict__.get("_prep_cache")
+        if tmpl is not None and tmpl[0] == job.modify_index \
+                and tmpl[1] == statics.gen and tmpl[2] is place \
+                and tmpl[3] == self.batch:
+            # Feasibility is re-fetched from the CURRENT statics'
+            # device_cache (kw carries only the key): caching the
+            # [host, device] entry on the job would pin evicted fleet
+            # generations' HBM buffers for the job's lifetime.
+            feas = statics.device_cache.get(tmpl[4])
+            if feas is not None:
+                return DeviceArgs(statics=statics, view=view, start=start,
+                                  feasible_d=feas, feasible_h=feas[0],
+                                  **tmpl[5])
+
         # Dedupe task groups by *semantic* key (constraints + drivers + dc +
         # ask): count-expanded groups collapse to one mask row, keeping the
         # device feasibility matrix tiny and its upload cacheable.  The
@@ -559,7 +587,6 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
         slot_of_tg: dict = {}      # id(tg) -> slot
         asks_rows: list = []
         distinct_rows: list = []
-        job = self.job
         job_sem_key = (id(job), job.modify_index)
         # Job-level pieces of the semantic key, derived once per eval (the
         # per-TG loop below is the host hot path at 1k groups/job).
@@ -669,15 +696,24 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
                 break
             rounds = max(rounds, need)
 
-        return DeviceArgs(
-            statics=statics, view=view, feasible_d=cached,
-            feasible_h=feasible_h, asks=asks, distinct=distinct,
+        kw = dict(
+            asks=asks, distinct=distinct,
             group_idx=group_idx, valid=valid, sizes=sizes,
             slot_of_tg=slot_of_tg, penalty=penalty, g_pad=g_pad,
-            p_pad=p_pad, start=start, net_plans=net_plans, counts=counts,
+            p_pad=p_pad, net_plans=net_plans, counts=counts,
             n_groups=len(groups), n_place=len(place),
             slot_placements=slot_placements, k_cap=k_cap, rounds=rounds,
-            rounds_eligible=eligible)
+            rounds_eligible=eligible,
+            fast_all=all(np_[0] for np_ in net_plans),
+            group_l=group_idx[:len(place)].tolist(), slots_c=[None])
+        # Keyed on the fleet GENERATION, not the statics object: a strong
+        # statics ref here would pin evicted generations (device
+        # feasibility buffers included) for as long as the job lives.
+        # Same reason the feasibility entry is cached by KEY.
+        job.__dict__["_prep_cache"] = (job.modify_index, statics.gen, place,
+                                       self.batch, feas_key, kw)
+        return DeviceArgs(statics=statics, view=view, start=start,
+                          feasible_d=cached, feasible_h=feasible_h, **kw)
 
     def finish_deferred(self, place: list, args: DeviceArgs,
                         chosen: np.ndarray, scores: np.ndarray) -> None:
@@ -746,34 +782,39 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
         # tests/test_native_finish.py.
         start_p = 0
         native = _native_bulk()
-        if native is not None and \
-                all(np_[0] for np_ in net_plans[:args.n_groups]):
-            slots_c = []
-            for g in range(args.n_groups):
-                _fast, plan_tasks = net_plans[g]
-                tasks_c = []
-                for tname, res, ask in plan_tasks:
-                    if res is None:
-                        res_proto = dict(_RES_STATIC)
-                    else:
-                        res_proto = dict(
-                            _RES_STATIC, cpu=res.cpu,
-                            memory_mb=res.memory_mb,
-                            disk_mb=res.disk_mb, iops=res.iops)
-                    net_c = None
-                    if ask is not None:
-                        net_c = (int(ask.mbits),
-                                 dict(_NET_STATIC, mbits=ask.mbits),
-                                 list(ask.dynamic_ports))
-                    tasks_c.append((tname, res_proto, net_c))
-                slots_c.append((sizes[g], tasks_c))
-            group_l = args.group_idx[:len(place)].tolist()
+        if native is not None and args.fast_all:
+            slots_c = args.slots_c[0]
+            if slots_c is None:
+                # Built once per (job version, fleet) and shared through
+                # the prep cache — the slot table only depends on the
+                # deduped net plans and sizes.
+                slots_c = []
+                for g in range(args.n_groups):
+                    _fast, plan_tasks = net_plans[g]
+                    tasks_c = []
+                    for tname, res, ask in plan_tasks:
+                        if res is None:
+                            res_proto = dict(_RES_STATIC)
+                        else:
+                            res_proto = dict(
+                                _RES_STATIC, cpu=res.cpu,
+                                memory_mb=res.memory_mb,
+                                disk_mb=res.disk_mb, iops=res.iops)
+                        net_c = None
+                        if ask is not None:
+                            net_c = (int(ask.mbits),
+                                     dict(_NET_STATIC, mbits=ask.mbits),
+                                     list(ask.dynamic_ports))
+                        tasks_c.append((tname, res_proto, net_c))
+                    slots_c.append((sizes[g], tasks_c))
+                args.slots_c[0] = slots_c
+            group_l = args.group_l
             place_l = place if type(place) is list else list(place)
             start_p, self._port_lcg, fmap = native.bulk_finish(
                 place_l, group_l, chosen_l, scores_l, uuids, slots_c,
                 nodes_arr, self._node_net, statics.net_base,
                 self._net_base_for,
-                self.state, self.ctx, plan.node_update,
+                self.state.allocs_node_index(), self.ctx, plan.node_update,
                 plan.node_allocation, plan.failed_allocs,
                 alloc_proto, metric_proto, _METRIC_FACTORY_NAMES,
                 Allocation, AllocMetric, Resources, NetworkResource,

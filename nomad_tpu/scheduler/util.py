@@ -81,8 +81,34 @@ def materialize_task_groups(job: Optional[Job]) -> dict:
 
 
 def diff_allocs(job: Optional[Job], tainted_nodes: dict, required: dict,
-                allocs: list) -> DiffResult:
-    """Set-difference target vs existing allocs into five outcome buckets."""
+                allocs: list, cache_fresh: bool = False) -> DiffResult:
+    """Set-difference target vs existing allocs into five outcome buckets.
+
+    ``cache_fresh=True`` (generic scheduler only): when there are no
+    existing allocs the diff is pure placement and deterministic per job
+    version, so the AllocTuple list is memoized on the job object (store
+    jobs are immutable; re-evals of the same version — eval storms,
+    plan-retry attempts — reuse it).  The cached tuples are shared and
+    READ-ONLY; diff_system_allocs must not use this path (it stamps
+    per-node targets onto its place tuples)."""
+    if cache_fresh and not allocs and job is not None:
+        cached = job.__dict__.get("_fresh_place")
+        if cached is not None and cached[0] == job.modify_index \
+                and cached[1] is required:
+            place = cached[2]
+        else:
+            # A TUPLE, so any future caller that tries to mutate the
+            # shared diff (evict_and_place appends, truncation) fails
+            # loudly instead of silently poisoning the per-version cache.
+            # Mutating paths require existing allocs and never take this
+            # branch.
+            place = tuple(AllocTuple(name, tg)
+                          for name, tg in required.items())
+            job.__dict__["_fresh_place"] = (job.modify_index, required,
+                                            place)
+        result = DiffResult()
+        result.place = place
+        return result
     result = DiffResult()
     existing = set()
     for exist in allocs:

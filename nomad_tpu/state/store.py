@@ -170,6 +170,15 @@ class _ReadMixin:
         nodes."""
         return bool(self._t.allocs_by_node.get(node_id))
 
+    def allocs_node_index(self) -> dict:
+        """The raw node_id -> alloc-id-collection index, READ-ONLY.
+
+        Handed to the native bulk finish (native/port_alloc.cpp) so the
+        per-node emptiness probe is a C dict lookup instead of a Python
+        call per placement.  Safe to borrow for an eval: writers copy
+        shared indexes before mutating (copy-on-write, _writable_index)."""
+        return self._t.allocs_by_node
+
     def allocs_by_job(self, job_id: str) -> list:
         table = self._t.tables["allocs"]
         return [table[i] for i in self._t.allocs_by_job.get(job_id, ())]

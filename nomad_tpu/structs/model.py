@@ -84,9 +84,22 @@ def generate_uuid() -> str:
     return f"{h[:8]}-{h[8:12]}-{h[12:16]}-{h[16:20]}-{h[20:]}"
 
 
+_FORMAT_UUIDS_CACHE: list = []
+
+
 def generate_uuids(n: int) -> list:
     """n random UUID strings from ONE urandom read (bulk minting for the
-    scheduler finish path)."""
+    scheduler finish path).  Formatting runs in C when the native
+    extension is built (native/port_alloc.cpp format_uuids — same
+    entropy source, same output)."""
+    if not _FORMAT_UUIDS_CACHE:
+        from nomad_tpu.utils.native import HAS_NATIVE, native
+        _FORMAT_UUIDS_CACHE.append(
+            native.format_uuids if HAS_NATIVE and
+            hasattr(native, "format_uuids") else None)
+    fmt = _FORMAT_UUIDS_CACHE[0]
+    if fmt is not None:
+        return fmt(_os.urandom(16 * n))
     h = _os.urandom(16 * n).hex()
     out = []
     for i in range(0, 32 * n, 32):
@@ -703,7 +716,16 @@ class PlanResult(_Struct):
                 and not self.failed_allocs)
 
     def full_commit(self, plan: Plan) -> tuple[bool, int, int]:
-        expected = sum(len(v) for v in plan.node_allocation.values())
-        actual = sum(len(self.node_allocation.get(k, []))
-                     for k in plan.node_allocation)
+        pna = plan.node_allocation
+        expected = sum(map(len, pna.values()))
+        if self.node_allocation is pna:
+            # Result shares the plan's dict (nothing was trimmed):
+            # committed in full by construction.
+            return True, expected, expected
+        sna = self.node_allocation
+        actual = 0
+        for k in pna:
+            v = sna.get(k)
+            if v is not None:
+                actual += len(v)
         return actual == expected, expected, actual

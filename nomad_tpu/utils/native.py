@@ -43,7 +43,38 @@ def _try_build() -> None:
         raise
 
 
+# The ABI version this checkout's Python code expects; must match
+# native/port_alloc.cpp's exported ABI_VERSION.  A same-name signature
+# change is invisible to hasattr() probes, so a stale prebuilt .so would
+# otherwise crash mid-eval.
+EXPECTED_ABI = 2
+
+
+def _stale(repo: str) -> bool:
+    """Is the built .so older than its source?  Rebuild-before-import
+    keeps an already-built checkout working across signature changes
+    (the in-process module object cannot be reloaded once imported)."""
+    import sysconfig
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    so = os.path.join(repo, f"_nomad_native{suffix}")
+    src = os.path.join(repo, "native", "port_alloc.cpp")
+    try:
+        return os.path.getmtime(so) < os.path.getmtime(src)
+    except OSError:
+        return False  # missing .so: normal import-failure path rebuilds
+
+
+_repo = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 try:
+    if _stale(_repo):
+        try:  # pragma: no cover - toolchainless host
+            _try_build()
+        except Exception:
+            # Import whatever exists anyway: a comment-only source touch
+            # leaves the on-disk .so ABI-compatible and the gate below
+            # accepts it; a genuinely old ABI is rejected there.
+            pass
     import _nomad_native as native  # type: ignore
 
     HAS_NATIVE = True
@@ -56,3 +87,18 @@ except ImportError:
     except Exception:
         native = None
         HAS_NATIVE = False
+
+if HAS_NATIVE and getattr(native, "ABI_VERSION", 0) != EXPECTED_ABI:
+    # An already-imported C extension cannot be reloaded in-process:
+    # rebuild now so the NEXT process start imports a matching build,
+    # and run this process on the pure-Python fallbacks.
+    try:  # pragma: no cover - stale prebuilt .so
+        _try_build()
+    except Exception:
+        pass
+    logger.warning(
+        "native extension ABI %s != expected %s (stale build); rebuilt "
+        "for next start, using pure-Python fallbacks now",
+        getattr(native, "ABI_VERSION", 0), EXPECTED_ABI)
+    native = None
+    HAS_NATIVE = False
