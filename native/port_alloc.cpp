@@ -21,6 +21,24 @@ namespace {
 
 thread_local std::mt19937 rng{std::random_device{}()};
 
+// GC-untrack a freshly built, final-state object (no-op if untracked).
+//
+// Every object bulk_finish creates is acyclic BY CONSTRUCTION: allocs /
+// metrics / resources / offers form trees whose only outbound edges go
+// to long-lived store objects (job, strings) that never point back
+// (nomad_tpu/state/store.py's immutability contract).  Refcounting alone
+// reclaims them; leaving them GC-tracked only makes every young-gen
+// collection scan the full burst (~1M objects per 64-eval storm, ~0.5 s
+// of scanning that finds zero garbage) and re-scan the store's alloc
+// table forever after.  Untracking is applied strictly AFTER an object's
+// last mutation — CPython re-tracks dicts on insertion of container
+// values, so ordering matters for dicts (instances and lists stay
+// untracked once untracked).  tests/test_gc_untrack.py asserts these
+// objects are still reclaimed by refcount alone.
+inline void gc_untrack(PyObject* o) {
+  if (o != nullptr) PyObject_GC_UnTrack(o);
+}
+
 // assign_ports(used: set[int], reserved: sequence[int], n_dynamic: int,
 //              min_port: int, max_port: int, attempts: int)
 //   -> list[int] | None
@@ -183,6 +201,7 @@ PyObject* format_uuids(PyObject*, PyObject* args) {
     }
     PyList_SET_ITEM(out, i, s);  // steals
   }
+  gc_untrack(out);  // strings only: acyclic
   return out;
 }
 
@@ -619,6 +638,8 @@ PyObject* bulk_finish(PyObject*, PyObject* args) {
         PyObject* devo = PyTuple_GET_ITEM(base, 4);
         Py_INCREF(devo);
         PyList_SET_ITEM(st, 4, devo);
+        gc_untrack(used);  // port ints only
+        gc_untrack(st);    // [set, int, int, str, str]
         int rc2 = PyDict_SetItem(node_net, ch_key, st);
         Py_DECREF(st);  // dict holds it now
         if (rc2 < 0) {
@@ -675,6 +696,7 @@ PyObject* bulk_finish(PyObject*, PyObject* args) {
         }
         if (net == Py_None) {
           PyObject* empty = PyList_New(0);
+          gc_untrack(empty);
           if (!empty || PyDict_SetItem(rd, I.networks, empty) < 0) {
             Py_XDECREF(empty);
             Py_DECREF(rd);
@@ -737,6 +759,7 @@ PyObject* bulk_finish(PyObject*, PyObject* args) {
             task_fail = true;
             break;
           }
+          gc_untrack(ports);  // ints only
           PyObject* nd = PyDict_Copy(net_proto);
           PyObject* labels_copy = nd ? PySequence_List(labels) : nullptr;
           if (!labels_copy ||
@@ -751,8 +774,10 @@ PyObject* bulk_finish(PyObject*, PyObject* args) {
             task_fail = true;
             break;
           }
+          gc_untrack(labels_copy);  // strings only
           Py_DECREF(labels_copy);
           Py_DECREF(ports);
+          gc_untrack(nd);  // final: offer.__dict__
           PyObject* offer = make_instance(net_cls, nd);
           Py_DECREF(nd);
           if (!offer) {
@@ -760,6 +785,7 @@ PyObject* bulk_finish(PyObject*, PyObject* args) {
             task_fail = true;
             break;
           }
+          gc_untrack(offer);
           PyObject* offer_list = PyList_New(1);
           if (!offer_list) {
             Py_DECREF(offer);
@@ -768,6 +794,7 @@ PyObject* bulk_finish(PyObject*, PyObject* args) {
             break;
           }
           PyList_SET_ITEM(offer_list, 0, offer);  // steals
+          gc_untrack(offer_list);
           int rc3 = PyDict_SetItem(rd, I.networks, offer_list);
           Py_DECREF(offer_list);
           if (rc3 < 0) {
@@ -776,6 +803,7 @@ PyObject* bulk_finish(PyObject*, PyObject* args) {
             break;
           }
         }
+        gc_untrack(rd);  // final: Resources.__dict__
         PyObject* res_inst = make_instance(res_cls, rd);
         Py_DECREF(rd);
         if (!res_inst || PyDict_SetItem(out_trs, tname, res_inst) < 0) {
@@ -783,6 +811,7 @@ PyObject* bulk_finish(PyObject*, PyObject* args) {
           task_fail = true;
           break;
         }
+        gc_untrack(res_inst);
         Py_DECREF(res_inst);
       }
       if (task_fail) {
@@ -802,6 +831,7 @@ PyObject* bulk_finish(PyObject*, PyObject* args) {
         goto fail;
       }
       PyList_SetItem(st, 1, new_bw);  // steals
+      gc_untrack(out_trs);  // final: alloc.task_resources
     }
 
     // --- metric + alloc construction --------------------------------
@@ -829,10 +859,12 @@ PyObject* bulk_finish(PyObject*, PyObject* args) {
         Py_DECREF(tg);
         goto fail;
       }
+      gc_untrack(sd);
       Py_DECREF(sd);
       Py_DECREF(sv);
       Py_DECREF(key);
     }
+    gc_untrack(md);  // final: AllocMetric.__dict__
     PyObject* metric = make_instance(metric_cls, md);
     Py_DECREF(md);
     if (!metric) {
@@ -895,6 +927,8 @@ PyObject* bulk_finish(PyObject*, PyObject* args) {
       Py_DECREF(tg);
       goto fail;
     }
+    gc_untrack(metric);
+    gc_untrack(ad);  // final: Allocation.__dict__
     PyObject* alloc = make_instance(alloc_cls, ad);
     Py_DECREF(ad);
     if (!alloc) {
@@ -904,6 +938,7 @@ PyObject* bulk_finish(PyObject*, PyObject* args) {
       goto fail;
     }
 
+    gc_untrack(alloc);
     if (node_id) {
       PyObject* lst = PyDict_GetItemWithError(plan_na, node_id);
       if (!lst) {
@@ -915,6 +950,7 @@ PyObject* bulk_finish(PyObject*, PyObject* args) {
           goto fail;
         }
         lst = PyList_New(0);
+        gc_untrack(lst);  // holds only (untracked) allocs
         if (!lst || PyDict_SetItem(plan_na, node_id, lst) < 0) {
           Py_XDECREF(lst);
           Py_DECREF(alloc);

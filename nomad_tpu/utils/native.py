@@ -15,10 +15,12 @@ import sys
 
 logger = logging.getLogger("nomad_tpu.utils.native")
 
+_repo = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
 
 def _try_build() -> None:
-    repo = os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
+    repo = _repo
     script = os.path.join(repo, "native", "build.py")
     src = os.path.join(repo, "native", "port_alloc.cpp")
     marker = os.path.join(repo, "native", ".build_failed")
@@ -64,8 +66,6 @@ def _stale(repo: str) -> bool:
         return False  # missing .so: normal import-failure path rebuilds
 
 
-_repo = os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
 try:
     if _stale(_repo):
         try:  # pragma: no cover - toolchainless host
@@ -94,11 +94,12 @@ if HAS_NATIVE and getattr(native, "ABI_VERSION", 0) != EXPECTED_ABI:
     # and run this process on the pure-Python fallbacks.
     try:  # pragma: no cover - stale prebuilt .so
         _try_build()
-    except Exception:
-        pass
+        _refreshed = "rebuilt for next start"
+    except Exception as _e:
+        _refreshed = f"rebuild failed ({_e}); next start will retry"
     logger.warning(
-        "native extension ABI %s != expected %s (stale build); rebuilt "
-        "for next start, using pure-Python fallbacks now",
-        getattr(native, "ABI_VERSION", 0), EXPECTED_ABI)
+        "native extension ABI %s != expected %s (stale build); %s, "
+        "using pure-Python fallbacks now",
+        getattr(native, "ABI_VERSION", 0), EXPECTED_ABI, _refreshed)
     native = None
     HAS_NATIVE = False

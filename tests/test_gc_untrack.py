@@ -1,0 +1,110 @@
+"""GC-untracking contract for the native finish path.
+
+native/port_alloc.cpp bulk_finish untracks every object it creates
+(allocs, metrics, resources, offers, their dicts/lists) so young-gen
+collections never scan scheduling bursts.  That is only sound if the
+objects are acyclic — reclaimed by refcounting alone, with no reliance
+on the cycle collector.  These tests pin both halves of the contract:
+
+  1. produced objects are NOT gc-tracked;
+  2. dropping the last reference frees them with gc DISABLED
+     (weakrefs die without a collect), proving no cycles pass through
+     them (a cycle through an untracked object would leak forever).
+"""
+from __future__ import annotations
+
+import gc
+import weakref
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.scheduler import Harness
+from nomad_tpu.structs import (
+    EVAL_TRIGGER_JOB_REGISTER,
+    Evaluation,
+    NetworkResource,
+    Resources,
+    Task,
+    TaskGroup,
+    generate_uuid,
+)
+from nomad_tpu.utils.native import HAS_NATIVE
+
+pytestmark = pytest.mark.skipif(not HAS_NATIVE,
+                                reason="native extension unavailable")
+
+
+def _run_eval(n_nodes=32, n_groups=8):
+    h = Harness()
+    for i in range(n_nodes):
+        h.state.upsert_node(h.next_index(), mock.node(i))
+    job = mock.job()
+    job.task_groups = [
+        TaskGroup(name=f"tg-{g}", count=1, tasks=[Task(
+            name="web", driver="exec",
+            resources=Resources(
+                cpu=100, memory_mb=64,
+                networks=[NetworkResource(mbits=5,
+                                          dynamic_ports=["http"])]),
+        )]) for g in range(n_groups)]
+    h.state.upsert_job(h.next_index(), job)
+    ev = Evaluation(id=generate_uuid(), priority=job.priority,
+                    type=job.type, triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+                    job_id=job.id)
+    h.process("jax-binpack", ev)
+    plan = h.plans[-1]
+    allocs = [a for placed in plan.node_allocation.values() for a in placed]
+    assert len(allocs) == n_groups
+    return h, plan, allocs
+
+
+def test_native_allocs_untracked():
+    h, plan, allocs = _run_eval()
+    for a in allocs:
+        assert not gc.is_tracked(a), "Allocation should be GC-untracked"
+        assert not gc.is_tracked(a.__dict__)
+        assert not gc.is_tracked(a.metrics)
+        assert not gc.is_tracked(a.metrics.__dict__)
+        for tr in a.task_resources.values():
+            assert not gc.is_tracked(tr)
+            for net in tr.networks:
+                assert not gc.is_tracked(net)
+                assert not gc.is_tracked(net.reserved_ports)
+        assert not gc.is_tracked(a.task_resources)
+
+
+def test_refcount_reclaims_without_collector():
+    """The acyclicity proof: with gc disabled, dropping the plan frees
+    every alloc (weakrefs die) — no cycle passes through the untracked
+    objects, so nothing can leak."""
+    h, plan, allocs = _run_eval()
+    refs = [weakref.ref(a) for a in allocs]
+    refs += [weakref.ref(a.metrics) for a in allocs]
+    was_enabled = gc.isenabled()
+    gc.disable()
+    try:
+        del allocs
+        h.plans.clear()
+        plan.node_allocation.clear()
+        plan.failed_allocs.clear()
+        del plan, h
+        dead = sum(1 for r in refs if r() is None)
+        assert dead == len(refs), f"{len(refs) - dead} objects survived " \
+            "refcount-only teardown: a cycle passes through an untracked " \
+            "object"
+    finally:
+        if was_enabled:
+            gc.enable()
+
+
+def test_mutating_untracked_alloc_retracks_dict():
+    """Inserting a container value into an untracked dict re-tracks the
+    dict (CPython semantics the untracking design relies on): later
+    client-side mutations get cycle-collector coverage again for the
+    dict they touch."""
+    h, plan, allocs = _run_eval()
+    a = allocs[0]
+    assert not gc.is_tracked(a.__dict__)
+    a.task_states = {"web": ["started"]}  # container value
+    assert gc.is_tracked(a.__dict__)
