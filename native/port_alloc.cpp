@@ -439,26 +439,33 @@ int node_base(PyObject* net_base, PyObject* base_fn, PyObject* ch_key,
 //             node_net, net_base, base_fn, allocs_idx, ctx, plan_nu, plan_na,
 //             failed_list, alloc_proto, metric_proto, metric_factories,
 //             alloc_cls, metric_cls, res_cls, net_cls,
-//             statuses, port_lcg, min_port, max_port)
+//             statuses, coalesce_all, port_lcg, min_port, max_port)
 //   -> (n_done, port_lcg, failed_map)
 //
 // slots[g] = (size_obj, tasks) with tasks = list of
 //   (task_name, res_proto_dict, None | (mbits, net_proto, dyn_labels)).
 // statuses = (run, pending, failed, client_failed, failed_desc).
+// coalesce_all: 1 = a task group's first failure swallows ALL its later
+// placements (generic-scheduler semantics: placements of one TG are
+// interchangeable, reference scheduler/generic_sched.go failedTGAllocs);
+// 0 = coalesce only placements with no chosen node (system semantics:
+// placements are node-pinned, one node failing says nothing about the
+// others).
 PyObject* bulk_finish(PyObject*, PyObject* args) {
   PyObject *place, *group_idx, *chosen, *scores, *uuids, *slots, *nodes;
   PyObject *node_net, *net_base, *base_fn, *allocs_idx, *ctx, *plan_nu,
       *plan_na;
   PyObject *failed_list, *alloc_proto, *metric_proto, *metric_factories;
   PyObject *alloc_cls, *metric_cls, *res_cls, *net_cls, *statuses;
+  int coalesce_all;
   long long lcg;  // 64-bit: lcg*1103515245 overflows a 32-bit long
   long min_port, max_port;
   if (!PyArg_ParseTuple(
-          args, "OOOOOOOOOOOOOOOOOOOOOOOLll", &place, &group_idx, &chosen,
+          args, "OOOOOOOOOOOOOOOOOOOOOOOiLll", &place, &group_idx, &chosen,
           &scores, &uuids, &slots, &nodes, &node_net, &net_base, &base_fn,
           &allocs_idx, &ctx, &plan_nu, &plan_na, &failed_list, &alloc_proto,
           &metric_proto, &metric_factories, &alloc_cls, &metric_cls,
-          &res_cls, &net_cls, &statuses, &lcg, &min_port,
+          &res_cls, &net_cls, &statuses, &coalesce_all, &lcg, &min_port,
           &max_port)) {
     return nullptr;
   }
@@ -485,13 +492,19 @@ PyObject* bulk_finish(PyObject*, PyObject* args) {
       goto fail;
     }
 
-    // Coalesce onto a prior failure of the same task group.
+    long g = PyLong_AsLong(PyList_GET_ITEM(group_idx, p));
+    long ch = PyLong_AsLong(PyList_GET_ITEM(chosen, p));
+
+    // Coalesce onto a prior failure of the same task group (all
+    // placements under generic semantics; only chosen-less ones under
+    // node-pinned system semantics — see coalesce_all above).
     PyObject* prior = PyDict_GetItemWithError(failed_map, tg_key);
     if (!prior && PyErr_Occurred()) {
       Py_DECREF(tg_key);
       Py_DECREF(tg);
       goto fail;
     }
+    if (prior && !coalesce_all && ch >= 0) prior = nullptr;
     if (prior) {
       PyObject* m = PyObject_GetAttr(prior, I.metrics);
       PyObject* c = m ? PyObject_GetAttr(m, I.coalesced) : nullptr;
@@ -513,8 +526,6 @@ PyObject* bulk_finish(PyObject*, PyObject* args) {
       continue;
     }
 
-    long g = PyLong_AsLong(PyList_GET_ITEM(group_idx, p));
-    long ch = PyLong_AsLong(PyList_GET_ITEM(chosen, p));
     PyObject* slot = PyList_GET_ITEM(slots, g);
     PyObject* size_obj = PyTuple_GET_ITEM(slot, 0);
     PyObject* tasks = PyTuple_GET_ITEM(slot, 1);
@@ -1011,7 +1022,7 @@ PyMODINIT_FUNC PyInit__nomad_native(void) {
   // Bumped on any signature/behavior change of an existing function so a
   // stale prebuilt .so (same names, old ABI) is detected by the loader
   // (nomad_tpu/utils/native.py) instead of crashing mid-eval.
-  if (PyModule_AddIntConstant(m, "ABI_VERSION", 2) < 0) {
+  if (PyModule_AddIntConstant(m, "ABI_VERSION", 3) < 0) {
     Py_DECREF(m);
     return nullptr;
   }

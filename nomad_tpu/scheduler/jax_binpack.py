@@ -63,22 +63,7 @@ from .stack import (
 from .util import ready_nodes_in_dcs, task_group_constraints
 
 
-def _proto_of(cls) -> tuple[dict, list]:
-    """Split a dataclass into (static-default dict, default_factory list)
-    for template-based construction: the finish loop builds thousands of
-    identical-shaped objects per eval, and ``cls.__new__`` + one dict copy
-    is ~3x cheaper than the generated ``__init__`` while staying in sync
-    with the dataclass definition automatically."""
-    import dataclasses
-
-    static, factories = {}, []
-    for f in dataclasses.fields(cls):
-        if f.default_factory is not dataclasses.MISSING:  # type: ignore
-            factories.append((f.name, f.default_factory))
-        else:
-            static[f.name] = None if f.default is dataclasses.MISSING \
-                else f.default
-    return static, factories
+from nomad_tpu.structs.model import proto_of as _proto_of
 
 
 _ALLOC_STATIC, _ALLOC_FACTORIES = _proto_of(Allocation)
@@ -104,6 +89,33 @@ def _native_bulk():
 
 
 _METRIC_FACTORY_NAMES = tuple(n for n, _f in _METRIC_FACTORIES)
+
+
+def build_slots_c(slot_plans) -> list:
+    """Slot table for the native bulk finish (native/port_alloc.cpp):
+    one (size_obj, [(task_name, res_proto_dict, net_c), ...]) entry per
+    slot, where net_c is None or (mbits, net_proto_dict, dyn_labels).
+    ``slot_plans`` yields (size, plan_tasks) pairs (see _net_plan_for).
+    Shared by the generic and system schedulers so the layout the C
+    side consumes has exactly one producer."""
+    slots_c = []
+    for size, plan_tasks in slot_plans:
+        tasks_c = []
+        for tname, res, ask in plan_tasks:
+            if res is None:
+                res_proto = dict(_RES_STATIC)
+            else:
+                res_proto = dict(
+                    _RES_STATIC, cpu=res.cpu, memory_mb=res.memory_mb,
+                    disk_mb=res.disk_mb, iops=res.iops)
+            net_c = None
+            if ask is not None:
+                net_c = (int(ask.mbits),
+                         dict(_NET_STATIC, mbits=ask.mbits),
+                         list(ask.dynamic_ports))
+            tasks_c.append((tname, res_proto, net_c))
+        slots_c.append((size, tasks_c))
+    return slots_c
 
 
 def _net_plan_for(tg):
@@ -788,25 +800,9 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
                 # Built once per (job version, fleet) and shared through
                 # the prep cache — the slot table only depends on the
                 # deduped net plans and sizes.
-                slots_c = []
-                for g in range(args.n_groups):
-                    _fast, plan_tasks = net_plans[g]
-                    tasks_c = []
-                    for tname, res, ask in plan_tasks:
-                        if res is None:
-                            res_proto = dict(_RES_STATIC)
-                        else:
-                            res_proto = dict(
-                                _RES_STATIC, cpu=res.cpu,
-                                memory_mb=res.memory_mb,
-                                disk_mb=res.disk_mb, iops=res.iops)
-                        net_c = None
-                        if ask is not None:
-                            net_c = (int(ask.mbits),
-                                     dict(_NET_STATIC, mbits=ask.mbits),
-                                     list(ask.dynamic_ports))
-                        tasks_c.append((tname, res_proto, net_c))
-                    slots_c.append((sizes[g], tasks_c))
+                slots_c = build_slots_c(
+                    (sizes[g], net_plans[g][1])
+                    for g in range(args.n_groups))
                 args.slots_c[0] = slots_c
             group_l = args.group_l
             place_l = place if type(place) is list else list(place)
@@ -821,6 +817,7 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
                 (ALLOC_DESIRED_STATUS_RUN, ALLOC_CLIENT_STATUS_PENDING,
                  ALLOC_DESIRED_STATUS_FAILED, ALLOC_CLIENT_STATUS_FAILED,
                  "failed to find a node for placement"),
+                1,  # coalesce_all: generic TG placements interchangeable
                 self._port_lcg, MIN_DYNAMIC_PORT, MAX_DYNAMIC_PORT)
             failed_tg.update(fmap)
 

@@ -111,9 +111,10 @@ class SystemScheduler:
             return False
         return True
 
-    def _compute_job_allocs(self) -> None:
-        allocs = self.state.allocs_by_job(self.eval.job_id)
-        allocs = filter_terminal_allocs(allocs)
+    def _compute_job_allocs(self, allocs: Optional[list] = None) -> None:
+        if allocs is None:
+            allocs = filter_terminal_allocs(
+                self.state.allocs_by_job(self.eval.job_id))
         tainted = tainted_nodes(self.state, allocs)
 
         diff = diff_system_allocs(self.job, self.nodes, tainted, allocs)

@@ -133,25 +133,86 @@ def diff_allocs(job: Optional[Job], tainted_nodes: dict, required: dict,
     return result
 
 
+_ALLOC_STUB_STATIC: dict = {}
+_ALLOC_STUB_FACTORIES: list = []
+
+
+def _node_alloc_stub(node_id: str) -> Allocation:
+    """Template-built Allocation carrying only a target node (the marker
+    diff_system_allocs pins placements with) — ``__new__`` + dict copy,
+    ~3x cheaper than the generated ``__init__`` at 1k nodes/eval."""
+    if not _ALLOC_STUB_STATIC:
+        from nomad_tpu.structs.model import proto_of
+
+        static, factories = proto_of(Allocation)
+        _ALLOC_STUB_STATIC.update(static)
+        _ALLOC_STUB_FACTORIES.extend(factories)
+    a = Allocation.__new__(Allocation)
+    d = dict(_ALLOC_STUB_STATIC, node_id=node_id)
+    for name, fac in _ALLOC_STUB_FACTORIES:
+        d[name] = fac()
+    a.__dict__ = d
+    return a
+
+
 def diff_system_allocs(job: Job, nodes: list, tainted_nodes: dict,
                        allocs: list) -> DiffResult:
-    """Per-node diff for system jobs; place tuples carry the target node."""
-    node_allocs: dict = {}
-    for alloc in allocs:
-        node_allocs.setdefault(alloc.node_id, []).append(alloc)
-    for node in nodes:
-        node_allocs.setdefault(node.id, [])
+    """Per-node diff for system jobs; place tuples carry the target node.
 
+    Flat single-pass form of "run diff_allocs once per node": same
+    buckets in the same (node-major, first-encounter) order, without one
+    DiffResult + AllocTuple churn per node — at 1k nodes the per-node
+    objects dominated the whole system eval.  Migrations don't apply to
+    system jobs: a tainted node's allocs just stop."""
     required = materialize_task_groups(job)
     result = DiffResult()
-    for node_id, nallocs in node_allocs.items():
-        diff = diff_allocs(job, tainted_nodes, required, nallocs)
-        for tup in diff.place:
-            tup.alloc = Allocation(node_id=node_id)
-        # Migrations don't apply to system jobs: a tainted node just stops.
-        diff.stop += diff.migrate
-        diff.migrate = []
-        result.append(diff)
+    place, stop = result.place, result.stop
+    update, ignore = result.update, result.ignore
+
+    # Node order: alloc-bearing nodes in first-encounter order, then the
+    # remaining provided nodes (dict-insertion semantics of the previous
+    # per-node implementation, preserved so rolling-update limits truncate
+    # the same allocs).
+    allocs_by_node: dict = {}
+    order: list = []
+    for alloc in allocs:
+        lst = allocs_by_node.get(alloc.node_id)
+        if lst is None:
+            allocs_by_node[alloc.node_id] = lst = []
+            order.append(alloc.node_id)
+        lst.append(alloc)
+    for node in nodes:
+        if node.id not in allocs_by_node:
+            allocs_by_node[node.id] = []
+            order.append(node.id)
+
+    required_items = list(required.items())
+    job_mi = job.modify_index if job is not None else None
+    for node_id in order:
+        nallocs = allocs_by_node[node_id]
+        if not nallocs:
+            # Fresh node: everything required is missing.
+            for name, tg in required_items:
+                place.append(AllocTuple(name, tg,
+                                        _node_alloc_stub(node_id)))
+            continue
+        existing = set()
+        tainted = tainted_nodes.get(node_id)
+        for alloc in nallocs:
+            name = alloc.name
+            existing.add(name)
+            tg = required.get(name)
+            if tg is None or tainted:
+                stop.append(AllocTuple(name, tg, alloc))
+            elif job_mi is not None and alloc.job is not None and \
+                    job_mi != alloc.job.modify_index:
+                update.append(AllocTuple(name, tg, alloc))
+            else:
+                ignore.append(AllocTuple(name, tg, alloc))
+        for name, tg in required_items:
+            if name not in existing:
+                place.append(AllocTuple(name, tg,
+                                        _node_alloc_stub(node_id)))
     return result
 
 

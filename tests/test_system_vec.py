@@ -164,3 +164,30 @@ def test_system_vec_node_update_migrates():
     plan = h.plans[-1]
     stopped = [a for allocs in plan.node_update.values() for a in allocs]
     assert any(a.node_id == nodes[0].id for a in stopped)
+
+
+def test_system_parity_count_gt_one_tight_node():
+    """System TG with count > 1 on nodes that fit only one copy: the
+    batched fit must not check both copies against pre-accumulation
+    usage (regression: numpy fancy-index add collapsed the duplicate
+    node rows, oversubscribing every node)."""
+    def job_fn():
+        j = mock.system_job()
+        tg = j.task_groups[0]
+        tg.count = 2
+        # One copy fits a mock node (4000 cpu / 8192 mb); two do not.
+        tg.tasks[0].resources = Resources(cpu=2500, memory_mb=5000)
+        return j
+
+    (h1, p1), (h2, p2) = run_both(5, job_fn)
+    s1, f1 = plan_summary(p1)
+    s2, f2 = plan_summary(p2)
+    assert s1 == s2
+    assert f1 == f2
+    # Every node fits exactly one copy (mock nodes have limited cpu/mem).
+    for node_id, placed in s1.items():
+        node = h1.state.node_by_id(node_id)
+        allocs = [a for al in p1.node_allocation.values() for a in al
+                  if a.node_id == node_id]
+        fit, _dim, _util = allocs_fit(node, allocs)
+        assert fit, f"oversubscribed node {node_id}: {placed}"
