@@ -76,11 +76,28 @@ class AllocDir:
                     os.path.join(root, d))]:
                 s = os.path.join(root, name)
                 d = os.path.join(target, name)
-                if os.path.lexists(d):
-                    continue
                 if os.path.islink(s):
-                    os.symlink(os.readlink(s), d)
+                    # Re-embed refreshes retargeted links; a same-target
+                    # link is left alone.
+                    link = os.readlink(s)
+                    if os.path.lexists(d):
+                        if os.path.islink(d) and os.readlink(d) == link:
+                            continue
+                        if os.path.isdir(d) and not os.path.islink(d):
+                            continue  # don't replace a populated dir
+                        os.unlink(d)
+                    os.symlink(link, d)
                 else:
+                    # Dest is a symlink (dangling or not — lstat, don't
+                    # follow) or a directory where the source now has a
+                    # regular file: clear it so the refresh lands.
+                    if os.path.islink(d):
+                        os.unlink(d)
+                    elif os.path.isdir(d):
+                        shutil.rmtree(d, ignore_errors=True)
+                    # _embed_file refreshes stale copies itself
+                    # ((size, mtime) comparison; hardlinks short-circuit
+                    # on inode equality).
                     self._embed_file(s, d)
 
     def log_path(self, task_name: str, kind: str) -> str:

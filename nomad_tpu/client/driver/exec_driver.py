@@ -53,9 +53,12 @@ class ExecDriver(Driver):
             return self.spawn(task, argv, kind="exec")
 
         task_dir = self.ctx.alloc_dir.task_dirs[task.name]
+        # Resolve the run-as user FIRST: an unknown user fails in
+        # microseconds, before paying chroot population or leaving a
+        # cgroup dir behind.
+        uid, gid = self._drop_identity(task)
         self._populate_chroot(task)
         cgroup = self._make_cgroup(task)
-        uid, gid = self._drop_identity(task)
 
         # Re-exec through a shim that joins the cgroup, chroots, then drops
         # privileges (setgid/setgroups/setuid — reference executor drops to
@@ -83,18 +86,29 @@ class ExecDriver(Driver):
 
         Defaults to ``nobody`` (reference exec_linux.go); the task config's
         ``user`` overrides it; ``user = "root"`` keeps root.  Returns
-        (-1, -1) when the drop is disabled or the user is unknown.
+        (-1, -1) when the drop is disabled (explicit root, or no pwd
+        database on the platform); raises RuntimeError for an unknown
+        user — fail closed, never silently run as root.
         """
         user = task.config.get("user") or "nobody"
         if user == "root":
             return -1, -1
         try:
             import pwd
-
-            ent = pwd.getpwnam(user)
-        except (KeyError, ImportError):
-            logger.warning("exec user %r not found; keeping root", user)
+        except ImportError:  # pragma: no cover - non-POSIX host
+            logger.warning("no pwd database on this platform; exec "
+                           "privilege drop unavailable, keeping root")
             return -1, -1
+        try:
+            ent = pwd.getpwnam(user)
+        except KeyError:
+            # Fail CLOSED: chroot contents are hardlinked host inodes, so
+            # silently running as root would hand a typo'd `user` write
+            # access to host system files.  Root must be asked for by
+            # name (user = "root").
+            raise RuntimeError(
+                f"exec task user {user!r} does not exist on this node; "
+                "set user = \"root\" explicitly to run as root")
         # chown the task dir so the dropped user can write its cwd/logs.
         task_dir = self.ctx.alloc_dir.task_dirs[task.name]
         try:

@@ -7,7 +7,7 @@ from __future__ import annotations
 import random
 from dataclasses import dataclass, field
 from types import MappingProxyType
-from typing import Callable, Optional
+from typing import Callable, Mapping, Optional
 
 from nomad_tpu.structs import (
     ALLOC_DESIRED_STATUS_RUN,
@@ -24,6 +24,7 @@ from nomad_tpu.structs import (
     TaskGroup,
     should_drain_node,
 )
+from nomad_tpu.structs.model import proto_of
 
 from .interfaces import SetStatusError
 
@@ -56,14 +57,17 @@ class DiffResult:
         self.ignore += other.ignore
 
 
-def materialize_task_groups(job: Optional[Job]) -> dict:
+def materialize_task_groups(job: Optional[Job]) -> Mapping:
     """Count-expand task groups to named instances job.tg[i].
 
-    Memoized per (job object, modify_index): store-resident jobs are
-    immutable by contract and every store write copies, so re-evals of
-    the same job version (node-update storms re-evaluate every affected
-    job) reuse the expansion.  Callers treat the mapping as read-only
-    (diff_allocs only reads it)."""
+    Returns a READ-ONLY Mapping (MappingProxyType), memoized per
+    (job object, modify_index): store-resident jobs are immutable by
+    contract and every store write copies, so re-evals of the same job
+    version (node-update storms re-evaluate every affected job) reuse
+    the expansion.  The proxy also makes the shared cache
+    mutation-proof — callers needing a private mutable copy must
+    dict() it.  Identity-stable per job version, which the fresh-diff
+    caches key on (diff_allocs cache_fresh)."""
     if job is None:
         return {}
     cached = job.__dict__.get("_materialized")
@@ -133,20 +137,13 @@ def diff_allocs(job: Optional[Job], tainted_nodes: dict, required: dict,
     return result
 
 
-_ALLOC_STUB_STATIC: dict = {}
-_ALLOC_STUB_FACTORIES: list = []
+_ALLOC_STUB_STATIC, _ALLOC_STUB_FACTORIES = proto_of(Allocation)
 
 
 def _node_alloc_stub(node_id: str) -> Allocation:
     """Template-built Allocation carrying only a target node (the marker
     diff_system_allocs pins placements with) — ``__new__`` + dict copy,
     ~3x cheaper than the generated ``__init__`` at 1k nodes/eval."""
-    if not _ALLOC_STUB_STATIC:
-        from nomad_tpu.structs.model import proto_of
-
-        static, factories = proto_of(Allocation)
-        _ALLOC_STUB_STATIC.update(static)
-        _ALLOC_STUB_FACTORIES.extend(factories)
     a = Allocation.__new__(Allocation)
     d = dict(_ALLOC_STUB_STATIC, node_id=node_id)
     for name, fac in _ALLOC_STUB_FACTORIES:

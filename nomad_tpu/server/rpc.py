@@ -227,6 +227,12 @@ class RPCServer:
             req = recv_frame(sock)
             if req is None:
                 return
+            if not isinstance(req, dict):
+                # Malformed frame: this peer doesn't speak the protocol;
+                # drop the connection rather than guess at a reply seq.
+                logger.warning("dropping connection: non-dict RPC frame "
+                               "(%s)", type(req).__name__)
+                return
             seq = req.get("seq", 0)
             method = req.get("method", "")
             handler = self._handlers.get(method)
@@ -283,6 +289,13 @@ class RPCServer:
         while True:
             req = recv_frame(sock)
             if req is None:
+                return
+            if not isinstance(req, dict):
+                # Validate BEFORE spawning: a worker dying on a malformed
+                # frame would never reply, leaving the caller blocked for
+                # its full timeout.  Drop the connection instead.
+                logger.warning("dropping mux connection: non-dict frame "
+                               "(%s)", type(req).__name__)
                 return
             gate.acquire()
             threading.Thread(target=worker, args=(req,),
@@ -363,7 +376,8 @@ class MuxConn:
                  server_hostname: str = "") -> None:
         self.sock = _dial(address, RPC_MUX, tls_context, server_hostname)
         self.sock.settimeout(None)  # reader blocks; callers use events
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()    # waiter table + seq state
+        self._wlock = threading.Lock()   # socket writes ONLY
         self._seq = 0
         self._waiters: dict = {}   # seq -> [event, response]
         self._broken: Optional[Exception] = None
@@ -394,18 +408,25 @@ class MuxConn:
     def call(self, method: str, args: dict,
              timeout: Optional[float] = None):
         waiter = [threading.Event(), None]
+        # seq allocation + waiter registration under the state lock;
+        # the actual send under a separate write lock — a slow/large
+        # send must not block the reader thread from delivering other
+        # streams' completed responses (head-of-line liveness: raft
+        # heartbeats share sessions with bulk transfers).
         with self._lock:
             if self._broken is not None:
                 raise _SendError(str(self._broken))
             self._seq += 1
             seq = self._seq
             self._waiters[seq] = waiter
-            try:
+        try:
+            with self._wlock:
                 send_frame(self.sock, {"seq": seq, "method": method,
                                        "args": args})
-            except (ConnectionError, OSError) as e:
+        except (ConnectionError, OSError) as e:
+            with self._lock:
                 self._waiters.pop(seq, None)
-                raise _SendError(str(e)) from e
+            raise _SendError(str(e)) from e
         if not waiter[0].wait(timeout if timeout is not None
                               else DEFAULT_CALL_TIMEOUT):
             with self._lock:

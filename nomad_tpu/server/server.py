@@ -130,6 +130,18 @@ class Server:
         self.conn_pool = ConnPool(
             tls_context=client_tls,
             server_hostname=self.config.tls_server_name)
+        # Raft gets its own NON-multiplexed pool: on a shared mux
+        # session one large frame (plan/snapshot transfer, up to
+        # MAX_FRAME) written under the session's write lock would stall
+        # every RequestVote/AppendEntries queued behind it (1s timeouts
+        # -> election churn).  Dedicated plain connections keep
+        # election/heartbeat latency independent of bulk RPC traffic —
+        # the reference likewise hands raft its own conn type
+        # (rpcRaft) off the shared listener.
+        self.raft_pool = ConnPool(
+            tls_context=client_tls,
+            server_hostname=self.config.tls_server_name,
+            multiplex=False)
         self.rpc_server = None
         if self.config.enable_rpc or self.config.raft_mode == "net":
             from .endpoints import Endpoints
@@ -151,7 +163,7 @@ class Server:
             defer = self.config.bootstrap_expect > 1 and \
                 not self.config.raft_peers and self.config.enable_gossip
             self.raft = NetRaft(
-                self.fsm, self.rpc_server, self.conn_pool,
+                self.fsm, self.rpc_server, self.raft_pool,
                 peers=self.config.raft_peers,
                 election_timeout=self.config.raft_election_timeout,
                 heartbeat_interval=self.config.raft_heartbeat_interval,
@@ -383,6 +395,7 @@ class Server:
         if self.rpc_server is not None:
             self.rpc_server.shutdown()
         self.conn_pool.shutdown()
+        self.raft_pool.shutdown()
 
     def _restore_eval_broker(self) -> None:
         """Broker is volatile; state is durable.  Re-enqueue all

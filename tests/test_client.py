@@ -470,3 +470,54 @@ def test_agent_restart_does_not_resurrect_completed_allocs(tmp_path):
     time.sleep(0.3)
     with open(tmp_path / "count") as fh:
         assert fh.read().count("ran") == 1
+
+
+@pytest.mark.skipif(os.geteuid() != 0, reason="requires root")
+def test_exec_driver_unknown_user_fails_closed(tmp_path):
+    """A typo'd `user` must fail the task start, not silently run as
+    root (chroot contents are hardlinked host inodes)."""
+    from nomad_tpu.client.driver import BUILTIN_DRIVERS
+
+    ad = AllocDir(str(tmp_path / "alloc"))
+    task = Task(name="typo", driver="exec",
+                config={"command": "/usr/bin/id", "args": "-u",
+                        "user": "no-such-user-xyz"},
+                resources=Resources(cpu=100, memory_mb=64))
+    ad.build([task])
+    drv = BUILTIN_DRIVERS["exec"](ExecContext(ad, "alloc-typo"))
+    with pytest.raises(RuntimeError, match="does not exist"):
+        drv.start(task)
+
+
+def test_alloc_dir_reembed_refreshes_stale_entries(tmp_path):
+    """Re-embedding picks up changed files and retargeted symlinks
+    (previously any existing dest was skipped forever)."""
+    src = tmp_path / "srcdir"
+    src.mkdir()
+    (src / "config").write_text("v1")
+    (src / "current").symlink_to("config")
+
+    ad = AllocDir(str(tmp_path / "alloc"))
+    task = Task(name="t", driver="exec", config={},
+                resources=Resources(cpu=100, memory_mb=64))
+    ad.build([task])
+    dest = os.path.join(ad.task_dirs["t"], "embedded")
+    ad.embed("t", {str(src): "embedded"})
+    assert open(os.path.join(dest, "config")).read() == "v1"
+    assert os.readlink(os.path.join(dest, "current")) == "config"
+
+    # Change content (newer mtime) and retarget the symlink.
+    time.sleep(0.01)
+    (src / "other").write_text("v2-content")
+    cfg = src / "config"
+    cfg.unlink()
+    cfg.write_text("v2")
+    now = time.time() + 5
+    os.utime(cfg, (now, now))
+    cur = src / "current"
+    cur.unlink()
+    cur.symlink_to("other")
+
+    ad.embed("t", {str(src): "embedded"})
+    assert open(os.path.join(dest, "config")).read() == "v2"
+    assert os.readlink(os.path.join(dest, "current")) == "other"

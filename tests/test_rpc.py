@@ -505,3 +505,119 @@ def test_many_blocking_queries_share_one_mux_session(srv, pool):
     assert all(idx > base for _i, idx in results)
     # All sixteen rode one multiplexed session.
     assert len(pool._sessions) == 1
+
+
+class TestMuxRobustness:
+    def test_malformed_frame_drops_mux_connection(self):
+        """A non-dict msgpack frame must drop the connection promptly
+        (not strand a worker and leave callers blocked on timeout)."""
+        import socket
+        import struct
+
+        import msgpack
+
+        from nomad_tpu.server.rpc import RPC_MUX
+
+        rpc = RPCServer()
+        rpc.register("T.ping", lambda args: {"ok": True})
+        rpc.start()
+        try:
+            s = socket.create_connection(rpc.address, timeout=5)
+            s.sendall(bytes([RPC_MUX]))
+            body = msgpack.packb([1, 2, 3])  # a list, not a request dict
+            s.sendall(struct.pack(">I", len(body)) + body)
+            s.settimeout(5)
+            assert s.recv(1) == b""  # server closed, no 330s hang
+            s.close()
+            # The listener is still healthy for well-formed sessions.
+            pool = ConnPool()
+            assert pool.call(rpc.address, "T.ping", {})["ok"] is True
+            pool.shutdown()
+        finally:
+            rpc.shutdown()
+
+    def test_malformed_frame_drops_plain_rpc_connection(self):
+        import socket
+        import struct
+
+        import msgpack
+
+        from nomad_tpu.server.rpc import RPC_NOMAD
+
+        rpc = RPCServer()
+        rpc.start()
+        try:
+            s = socket.create_connection(rpc.address, timeout=5)
+            s.sendall(bytes([RPC_NOMAD]))
+            body = msgpack.packb("nope")
+            s.sendall(struct.pack(">I", len(body)) + body)
+            s.settimeout(5)
+            assert s.recv(1) == b""
+            s.close()
+        finally:
+            rpc.shutdown()
+
+    def test_mux_send_does_not_hold_session_state_lock(self):
+        """While one caller's large frame is mid-send, the reader thread
+        must still deliver completed responses (head-of-line liveness:
+        the waiter-table lock and the write lock are separate)."""
+        from nomad_tpu.server.rpc import MuxConn
+
+        rpc = RPCServer()
+        release = threading.Event()
+
+        def slow(args):
+            release.wait(10)
+            return {"who": "slow"}
+
+        rpc.register("T.slow", slow)
+        rpc.register("T.echo", lambda args: {"n": len(args["blob"])})
+        rpc.start()
+        try:
+            sess = MuxConn(tuple(rpc.address))
+            results = {}
+
+            def call_slow():
+                results["slow"] = sess.call("T.slow", {}, timeout=10)
+
+            t = threading.Thread(target=call_slow)
+            t.start()
+            time.sleep(0.05)
+            # Large frames keep the write lock busy; replies must still
+            # flow for other streams, and the state lock must never be
+            # held across a send (deadlock would fail this in 10s).
+            threads = []
+            for _ in range(4):
+                th = threading.Thread(
+                    target=lambda: results.setdefault(
+                        "echo", sess.call("T.echo",
+                                          {"blob": b"x" * (4 << 20)},
+                                          timeout=10)))
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join(10)
+                assert not th.is_alive()
+            assert results["echo"]["n"] == 4 << 20
+            release.set()
+            t.join(10)
+            assert results["slow"]["who"] == "slow"
+            sess.close()
+        finally:
+            rpc.shutdown()
+
+
+def test_raft_uses_dedicated_non_mux_pool(tmp_path):
+    """Raft traffic must not share the mux session with bulk RPC: one
+    large frame under the session write lock would stall every
+    heartbeat/vote queued behind it (election churn)."""
+    cfg = ServerConfig(data_dir=str(tmp_path / "s1"), raft_mode="net",
+                       enable_rpc=True)
+    srv = Server(cfg)
+    try:
+        assert srv.raft_pool is not srv.conn_pool
+        assert srv.raft_pool.multiplex is False
+        assert srv.conn_pool.multiplex is True
+        assert srv.raft.pool is srv.raft_pool
+    finally:
+        srv.shutdown()
