@@ -33,14 +33,15 @@ logger = logging.getLogger("nomad_tpu.client.alloc_runner")
 class AllocRunner:
     def __init__(self, alloc: Allocation, alloc_root: str,
                  state_dir: str = "",
-                 on_status: Optional[Callable] = None) -> None:
+                 on_status: Optional[Callable] = None,
+                 options: Optional[dict] = None) -> None:
         self.alloc = alloc
         self.alloc_root = alloc_root
         self.state_dir = state_dir
         self.on_status = on_status or (lambda alloc: None)
 
         self.alloc_dir = AllocDir(alloc_root)
-        self.ctx = ExecContext(self.alloc_dir, alloc.id)
+        self.ctx = ExecContext(self.alloc_dir, alloc.id, options=options)
         self.task_runners: dict = {}
         self.task_states: dict = {}
         self._destroy = threading.Event()
@@ -61,7 +62,8 @@ class AllocRunner:
 
     @classmethod
     def restore(cls, alloc_root: str, state_dir: str,
-                on_status: Optional[Callable] = None
+                on_status: Optional[Callable] = None,
+                options: Optional[dict] = None
                 ) -> Optional["AllocRunner"]:
         try:
             with open(os.path.join(state_dir, "state.json")) as fh:
@@ -69,7 +71,8 @@ class AllocRunner:
         except (OSError, ValueError):
             return None
         alloc = Allocation.from_dict(data["alloc"])
-        runner = cls(alloc, alloc_root, state_dir, on_status)
+        runner = cls(alloc, alloc_root, state_dir, on_status,
+                     options=options)
         return runner
 
     # -- lifecycle ---------------------------------------------------------

@@ -160,7 +160,8 @@ class Client:
             state_dir = os.path.join(allocs_dir, alloc_id)
             runner = AllocRunner.restore(
                 self._alloc_root(alloc_id), state_dir,
-                on_status=self._sync_alloc_status)
+                on_status=self._sync_alloc_status,
+                options=self.config.options)
             if runner is None:
                 continue
             if runner.alloc.terminal_status() or \
@@ -276,7 +277,8 @@ class Client:
                     runner = AllocRunner(
                         alloc, self._alloc_root(alloc.id),
                         state_dir=self._alloc_state_dir(alloc.id),
-                        on_status=self._sync_alloc_status)
+                        on_status=self._sync_alloc_status,
+                        options=self.config.options)
                     self.alloc_runners[alloc.id] = runner
                     runner.run()
                 elif alloc.modify_index > runner.alloc.modify_index:

@@ -23,11 +23,21 @@ logger = logging.getLogger("nomad_tpu.client.driver")
 
 class ExecContext:
     """Per-alloc execution context handed to drivers
-    (reference driver.go:96-109)."""
+    (reference driver.go:96-109).  ``options`` carries the client
+    config's free-form kv namespace (reference config.Read/ReadBool —
+    e.g. docker.cleanup.container)."""
 
-    def __init__(self, alloc_dir, alloc_id: str = "") -> None:
+    def __init__(self, alloc_dir, alloc_id: str = "",
+                 options: Optional[dict] = None) -> None:
         self.alloc_dir = alloc_dir      # AllocDir
         self.alloc_id = alloc_id
+        self.options = options or {}
+
+    def read_bool(self, key: str, default: bool = False) -> bool:
+        v = self.options.get(key)
+        if v is None:
+            return default
+        return str(v).strip().lower() in ("1", "t", "true", "yes")
 
 
 class DriverHandle:

@@ -1,10 +1,17 @@
 """docker driver: containerized execution via the docker CLI.
 
-Capability parity with /root/reference/client/driver/docker.go: image
-pull/run with CPU shares + memory limits, port publishing from the task's
-network offer, the shared alloc dir bind-mounted at the reference's
-container paths, and handle = container id (re-attach by id after agent
-restart).  Uses the docker CLI rather than the API socket client.
+Capability parity with /root/reference/client/driver/docker.go:
+pull-if-absent (always re-pull ``:latest``, docker.go:285-330) with the
+handle carrying the resolved image id; container-side port mapping —
+numeric dynamic-port labels map host->label port, non-numeric map 1:1,
+static ports 1:1 (docker.go:185-218), plus an explicit ``port_map``
+config like the qemu driver's; ``network_mode`` pass-through
+(docker.go:169-184); cleanup knobs ``docker.cleanup.container`` /
+``docker.cleanup.image`` from the client options (docker.go:270-282,
+both default true); CPU shares + memory limits; the shared alloc dir
+bind-mounted at the reference's container paths; re-attach by container
+id after agent restart.  Uses the docker CLI rather than the API socket
+client.
 """
 from __future__ import annotations
 
@@ -19,11 +26,26 @@ logger = logging.getLogger("nomad_tpu.client.driver.docker")
 
 
 class DockerHandle(DriverHandle):
-    def __init__(self, container_id: str) -> None:
+    def __init__(self, container_id: str, image_id: str = "",
+                 cleanup_container: bool = True,
+                 cleanup_image: bool = True) -> None:
         self.container_id = container_id
+        self.image_id = image_id
+        self.cleanup_container = cleanup_container
+        self.cleanup_image = cleanup_image
 
     def id(self) -> str:
-        return f"docker:{self.container_id}"
+        # '|' separators: image ids contain ':' (sha256:...).
+        flags = f"{int(self.cleanup_container)}{int(self.cleanup_image)}"
+        return f"docker:{self.container_id}|{self.image_id}|{flags}"
+
+    @classmethod
+    def from_id(cls, payload: str) -> "DockerHandle":
+        parts = payload.split("|")
+        if len(parts) == 3:
+            cid, image_id, flags = parts
+            return cls(cid, image_id, flags[0] == "1", flags[1] == "1")
+        return cls(parts[0])
 
     def wait(self, timeout: Optional[float] = None) -> Optional[int]:
         try:
@@ -52,8 +74,19 @@ class DockerHandle(DriverHandle):
     def kill(self) -> None:
         subprocess.run(["docker", "stop", "-t", "5", self.container_id],
                        capture_output=True)
-        subprocess.run(["docker", "rm", "-f", self.container_id],
-                       capture_output=True)
+        if self.cleanup_container:
+            self._cleanup(["docker", "rm", "-f", self.container_id])
+        if self.cleanup_image and self.image_id:
+            # With cleanup_container=false the kept container still
+            # references the image and docker refuses — surfaced below.
+            self._cleanup(["docker", "rmi", self.image_id])
+
+    @staticmethod
+    def _cleanup(argv: list) -> None:
+        out = subprocess.run(argv, capture_output=True, text=True)
+        if out.returncode != 0:
+            logger.warning("%s failed: %s", " ".join(argv[:2]),
+                           out.stderr.strip())
 
 
 class DockerDriver(Driver):
@@ -76,12 +109,43 @@ class DockerDriver(Driver):
         node.attributes["driver.docker.version"] = out.stdout.strip()
         return True
 
+    @staticmethod
+    def _image_id(image: str) -> Optional[str]:
+        out = subprocess.run(["docker", "image", "inspect", "-f",
+                              "{{.Id}}", image],
+                             capture_output=True, text=True)
+        return out.stdout.strip() if out.returncode == 0 else None
+
+    def _ensure_image(self, image: str) -> str:
+        """Pull-if-absent; ``:latest`` (explicit or implied) is always
+        re-pulled so a stale cache never pins an old version
+        (reference docker.go:285-310).  Returns the image id."""
+        tag = image.rsplit(":", 1)[1] if ":" in image.split("/")[-1] \
+            else "latest"
+        image_id = None if tag == "latest" else self._image_id(image)
+        if image_id is None:
+            pull = subprocess.run(["docker", "pull", image],
+                                  capture_output=True, text=True)
+            if pull.returncode != 0:
+                raise RuntimeError(
+                    f"failed to pull {image!r}: {pull.stderr.strip()}")
+            image_id = self._image_id(image)
+            if image_id is None:
+                raise RuntimeError(
+                    f"failed to determine image id for {image!r}")
+        return image_id
+
     def start(self, task):
         image = task.config.get("image")
         if not image:
             raise ValueError("docker driver requires config.image")
+        image_id = self._ensure_image(image)
+
         argv = ["docker", "run", "-d",
                 "--name", f"nomad-{self.ctx.alloc_id[:8]}-{task.name}"]
+        network_mode = task.config.get("network_mode", "")
+        if network_mode:
+            argv += ["--net", network_mode]
         res = task.resources
         if res.cpu:
             argv += ["--cpu-shares", str(res.cpu)]
@@ -94,10 +158,21 @@ class DockerDriver(Driver):
             argv += ["-v", f"{task_dir}/local:/local"]
         if res.networks:
             net = res.networks[0]
-            for label, port in net.map_dynamic_ports().items():
-                argv += ["-p", f"{port}:{port}"]
+            port_map = task.config.get("port_map", {})
             for port in net.list_static_ports():
                 argv += ["-p", f"{port}:{port}"]
+            for label, host_port in net.map_dynamic_ports().items():
+                # Container-side resolution (docker.go:199-216 + the
+                # port_map convention): explicit port_map first, then a
+                # numeric label names the container port, else 1:1 and
+                # the task reads its NOMAD_PORT_<label> env.
+                if label in port_map:
+                    container = int(port_map[label])
+                elif str(label).isdigit():
+                    container = int(label)
+                else:
+                    container = host_port
+                argv += ["-p", f"{host_port}:{container}"]
         argv.append(image)
         command = task.config.get("command")
         if command:
@@ -109,12 +184,17 @@ class DockerDriver(Driver):
         out = subprocess.run(argv, capture_output=True, text=True)
         if out.returncode != 0:
             raise RuntimeError(f"docker run failed: {out.stderr.strip()}")
-        return DockerHandle(out.stdout.strip())
+        return DockerHandle(
+            out.stdout.strip(), image_id=image_id,
+            cleanup_container=self.ctx.read_bool(
+                "docker.cleanup.container", True),
+            cleanup_image=self.ctx.read_bool("docker.cleanup.image",
+                                             True))
 
     def open(self, handle_id: str) -> DockerHandle:
-        kind, container_id = handle_id.split(":", 1)
-        handle = DockerHandle(container_id)
+        _kind, payload = handle_id.split(":", 1)
+        handle = DockerHandle.from_id(payload)
         if not handle.is_running():
             raise ProcessLookupError(
-                f"container {container_id} is not running")
+                f"container {handle.container_id} is not running")
         return handle

@@ -1,12 +1,16 @@
 """java driver: run a jar under the JVM.
 
 Capability parity with /root/reference/client/driver/java.go: fingerprints
-the JVM version; config carries jar_path/jvm_options/args.
+the JVM version; config carries jar_path (local) or artifact_source /
+jar_source (downloaded into the task dir before launch, reference
+java.go:96-130), plus jvm_options/args and an optional checksum.
 """
 from __future__ import annotations
 
 import shutil
 import subprocess
+
+from nomad_tpu.client.artifact import fetch_task_artifact
 
 from .base import Driver
 
@@ -31,9 +35,17 @@ class JavaDriver(Driver):
         return True
 
     def start(self, task):
-        jar = task.config.get("jar_path") or task.config.get("jar_source")
+        jar = task.config.get("jar_path")
+        source = task.config.get("artifact_source") or \
+            task.config.get("jar_source")
+        if not jar and source:
+            # Deployment path: the jar ships over HTTP into the task's
+            # local dir (reference java.go:96-130), with optional
+            # checksum verification.
+            jar = fetch_task_artifact(self.ctx, task, source)
         if not jar:
-            raise ValueError("java driver requires config.jar_path")
+            raise ValueError(
+                "java driver requires config.jar_path or artifact_source")
         jvm_options = task.config.get("jvm_options", [])
         if isinstance(jvm_options, str):
             jvm_options = jvm_options.split()

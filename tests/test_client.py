@@ -521,3 +521,162 @@ def test_alloc_dir_reembed_refreshes_stale_entries(tmp_path):
     ad.embed("t", {str(src): "embedded"})
     assert open(os.path.join(dest, "config")).read() == "v2"
     assert os.readlink(os.path.join(dest, "current")) == "other"
+
+
+@pytest.fixture
+def artifact_server(tmp_path):
+    """Local HTTP server serving tmp_path/artifacts (no egress here)."""
+    import http.server
+    import threading as _threading
+
+    adir = tmp_path / "artifacts"
+    adir.mkdir()
+
+    class Handler(http.server.SimpleHTTPRequestHandler):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, directory=str(adir), **kw)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    _threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield adir, f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+def test_java_driver_downloads_artifact(tmp_path, fake_bin,
+                                        artifact_server):
+    """jar_source over HTTP lands in the task's local dir before launch
+    (reference client/driver/java.go:96-130)."""
+    import hashlib
+
+    install, log = fake_bin
+    install("java")
+    adir, base = artifact_server
+    (adir / "app.jar").write_bytes(b"PK\x03\x04 fake jar")
+    digest = hashlib.sha256(b"PK\x03\x04 fake jar").hexdigest()
+
+    from nomad_tpu.client.driver import BUILTIN_DRIVERS
+
+    ad = AllocDir(str(tmp_path / "alloc"))
+    task = Task(name="jvm", driver="java",
+                config={"artifact_source": f"{base}/app.jar",
+                        "checksum": f"sha256:{digest}", "args": "serve"},
+                resources=Resources(cpu=100, memory_mb=256))
+    ad.build([task])
+    drv = BUILTIN_DRIVERS["java"](ExecContext(ad, "alloc-dl"))
+    handle = drv.start(task)
+    assert handle.wait(10) == 0
+    local_jar = os.path.join(ad.task_dirs["jvm"], "local", "app.jar")
+    assert open(local_jar, "rb").read() == b"PK\x03\x04 fake jar"
+    line = [l for l in log.read_text().splitlines() if "-jar" in l][-1]
+    assert line == f"java -jar {local_jar} serve"
+
+
+def test_qemu_driver_artifact_url_checksum(tmp_path, fake_bin,
+                                           artifact_server):
+    """?checksum= on the artifact URL is honored (go-getter convention,
+    reference client/driver/qemu.go:95-150)."""
+    import hashlib
+
+    install, log = fake_bin
+    install("qemu-system-x86_64")
+    adir, base = artifact_server
+    (adir / "disk.img").write_bytes(b"qcow2-bytes")
+    digest = hashlib.sha256(b"qcow2-bytes").hexdigest()
+
+    from nomad_tpu.client.driver import BUILTIN_DRIVERS
+
+    ad = AllocDir(str(tmp_path / "alloc"))
+    task = Task(name="vm", driver="qemu",
+                config={"artifact_source":
+                        f"{base}/disk.img?checksum=sha256:{digest}"},
+                resources=Resources(cpu=500, memory_mb=256))
+    ad.build([task])
+    drv = BUILTIN_DRIVERS["qemu"](ExecContext(ad, "alloc-qdl"))
+    handle = drv.start(task)
+    assert handle.wait(10) == 0
+    img = os.path.join(ad.task_dirs["vm"], "local", "disk.img")
+    assert os.path.exists(img)
+    line = [l for l in log.read_text().splitlines()
+            if "qemu-system" in l][-1]
+    assert f"file={img}" in line
+
+
+def test_artifact_checksum_mismatch_fails_task(tmp_path, fake_bin,
+                                               artifact_server):
+    """A bad digest rejects the artifact: no file left behind, start
+    raises (surfaced as a task error by the TaskRunner)."""
+    from nomad_tpu.client.artifact import ArtifactError
+    from nomad_tpu.client.driver import BUILTIN_DRIVERS
+
+    install, _log = fake_bin
+    install("qemu-system-x86_64")
+    adir, base = artifact_server
+    (adir / "disk.img").write_bytes(b"tampered-bytes")
+
+    ad = AllocDir(str(tmp_path / "alloc"))
+    task = Task(name="vm", driver="qemu",
+                config={"artifact_source": f"{base}/disk.img",
+                        "checksum": "sha256:" + "0" * 64},
+                resources=Resources(cpu=500, memory_mb=256))
+    ad.build([task])
+    drv = BUILTIN_DRIVERS["qemu"](ExecContext(ad, "alloc-bad"))
+    with pytest.raises(ArtifactError, match="checksum mismatch"):
+        drv.start(task)
+    assert not os.path.exists(
+        os.path.join(ad.task_dirs["vm"], "local", "disk.img"))
+
+
+def test_artifact_fetch_error_is_task_error(tmp_path, fake_bin,
+                                            artifact_server):
+    from nomad_tpu.client.artifact import ArtifactError
+    from nomad_tpu.client.driver import BUILTIN_DRIVERS
+
+    install, _log = fake_bin
+    install("java")
+    _adir, base = artifact_server
+    ad = AllocDir(str(tmp_path / "alloc"))
+    task = Task(name="jvm", driver="java",
+                config={"artifact_source": f"{base}/missing.jar"},
+                resources=Resources(cpu=100, memory_mb=128))
+    ad.build([task])
+    drv = BUILTIN_DRIVERS["java"](ExecContext(ad, "alloc-404"))
+    with pytest.raises(ArtifactError, match="failed to fetch"):
+        drv.start(task)
+
+
+def test_artifact_keeps_presigned_query(tmp_path, artifact_server):
+    """Only the checksum query parameter is stripped from the download
+    URL — presigned/tokenized query strings survive."""
+    import hashlib
+    import http.server
+    import threading as _threading
+
+    from nomad_tpu.client.artifact import fetch_artifact
+
+    seen = {}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            seen["path"] = self.path
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(b"payload")
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    _threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        digest = hashlib.sha256(b"payload").hexdigest()
+        url = (f"http://127.0.0.1:{httpd.server_address[1]}/f.bin"
+               f"?X-Amz-Signature=tok123&checksum=sha256:{digest}")
+        dest = fetch_artifact(url, str(tmp_path / "dl"))
+        assert open(dest, "rb").read() == b"payload"
+        assert "X-Amz-Signature=tok123" in seen["path"]
+        assert "checksum" not in seen["path"]
+    finally:
+        httpd.shutdown()

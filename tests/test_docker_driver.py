@@ -1,0 +1,154 @@
+"""Docker driver fidelity against a fake docker binary: exact run argv
+for static + dynamic + mapped ports, pull-if-absent (":latest" always
+re-pulled), network_mode, and cleanup knobs (reference
+client/driver/docker.go:169-360)."""
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from nomad_tpu.client.allocdir import AllocDir
+from nomad_tpu.client.driver import BUILTIN_DRIVERS
+from nomad_tpu.client.driver.base import ExecContext
+from nomad_tpu.structs import NetworkResource, Resources, Task
+
+
+@pytest.fixture
+def fake_docker(tmp_path, monkeypatch):
+    """A scripted `docker` CLI: logs every invocation; `image inspect`
+    succeeds only after a `pull` created the image marker."""
+    bindir = tmp_path / "fakebin"
+    bindir.mkdir()
+    state = tmp_path / "docker-state"
+    state.mkdir()
+    log = tmp_path / "invocations.log"
+    exe = bindir / "docker"
+    exe.write_text(f"""#!/bin/sh
+echo "docker $@" >> {log}
+state={state}
+case "$1" in
+  version) echo "24.0.7" ;;
+  image)
+    # image inspect -f {{.Id}} IMG -> image name is $5
+    img=$(echo "$5" | tr '/:' '__')
+    if [ -f "$state/$img" ]; then echo "sha256:id-$img"; else exit 1; fi ;;
+  pull)
+    img=$(echo "$2" | tr '/:' '__')
+    touch "$state/$img" ;;
+  run) echo "cid-12345" ;;
+  stop|rm|rmi) : ;;
+  inspect) echo "true" ;;
+esac
+""")
+    exe.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+    return log
+
+
+def _start(tmp_path, config, resources=None, options=None,
+           name="web") -> tuple:
+    ad = AllocDir(str(tmp_path / f"alloc-{name}"))
+    task = Task(name=name, driver="docker", config=config,
+                resources=resources or Resources(cpu=250, memory_mb=128))
+    ad.build([task])
+    drv = BUILTIN_DRIVERS["docker"](
+        ExecContext(ad, "a1b2c3d4e5f6", options=options))
+    return drv.start(task), ad
+
+
+def _run_line(log) -> str:
+    return [ln for ln in log.read_text().splitlines()
+            if ln.startswith("docker run")][-1]
+
+
+def test_run_argv_static_dynamic_mapped_ports(tmp_path, fake_docker):
+    net = NetworkResource(
+        ip="10.0.0.1",
+        # static 8080; dynamic labels: "http" (no mapping), "6379"
+        # (numeric -> container 6379), "db" (explicit port_map -> 5432)
+        reserved_ports=[8080, 20100, 20200, 20300],
+        dynamic_ports=["http", "6379", "db"])
+    handle, ad = _start(
+        tmp_path,
+        {"image": "redis:7.2", "port_map": {"db": 5432},
+         "command": "redis-server", "args": "--appendonly yes"},
+        Resources(cpu=250, memory_mb=128, networks=[net]))
+    line = _run_line(fake_docker)
+    expected = (
+        "docker run -d --name nomad-a1b2c3d4-web "
+        "--cpu-shares 250 --memory 128m "
+        f"-v {ad.shared_dir}:/alloc -v {ad.task_dirs['web']}/local:/local "
+        "-p 8080:8080 "        # static 1:1
+        "-p 20100:20100 "      # non-numeric label, no mapping: 1:1
+        "-p 20200:6379 "       # numeric label names the container port
+        "-p 20300:5432 "       # explicit port_map wins
+        "redis:7.2 redis-server --appendonly yes")
+    assert line == expected
+    assert handle.container_id == "cid-12345"
+    assert handle.image_id == "sha256:id-redis_7.2"
+
+
+def test_pull_if_absent_and_cache_hit(tmp_path, fake_docker):
+    _start(tmp_path, {"image": "redis:7.2"}, name="a")
+    lines = fake_docker.read_text().splitlines()
+    assert any(ln.startswith("docker pull redis:7.2") for ln in lines)
+    fake_docker.write_text("")
+    _start(tmp_path, {"image": "redis:7.2"}, name="b")
+    lines = fake_docker.read_text().splitlines()
+    # Cached tag: inspect hits, no second pull.
+    assert not any(ln.startswith("docker pull") for ln in lines)
+
+
+def test_latest_always_repulled(tmp_path, fake_docker):
+    _start(tmp_path, {"image": "redis"}, name="a")
+    fake_docker.write_text("")
+    _start(tmp_path, {"image": "redis"}, name="b")
+    lines = fake_docker.read_text().splitlines()
+    assert any(ln.startswith("docker pull redis") for ln in lines), \
+        "implied :latest must re-pull every start"
+
+
+def test_network_mode_passthrough(tmp_path, fake_docker):
+    net = NetworkResource(ip="10.0.0.1", reserved_ports=[20100],
+                          dynamic_ports=["http"])
+    _start(tmp_path, {"image": "redis:7.2", "network_mode": "host"},
+           Resources(cpu=100, memory_mb=64, networks=[net]))
+    line = _run_line(fake_docker)
+    assert "--net host" in line
+
+
+def test_cleanup_knobs_from_client_options(tmp_path, fake_docker):
+    handle, _ad = _start(
+        tmp_path, {"image": "redis:7.2"},
+        options={"docker.cleanup.container": "false",
+                 "docker.cleanup.image": "false"})
+    assert handle.cleanup_container is False
+    assert handle.cleanup_image is False
+    fake_docker.write_text("")
+    handle.kill()
+    lines = fake_docker.read_text().splitlines()
+    assert any(ln.startswith("docker stop") for ln in lines)
+    assert not any(ln.startswith("docker rm ") for ln in lines)
+    assert not any(ln.startswith("docker rmi") for ln in lines)
+
+
+def test_cleanup_defaults_remove_container_and_image(tmp_path,
+                                                     fake_docker):
+    handle, _ad = _start(tmp_path, {"image": "redis:7.2"})
+    fake_docker.write_text("")
+    handle.kill()
+    lines = fake_docker.read_text().splitlines()
+    assert any(ln.startswith("docker rm -f cid-12345") for ln in lines)
+    assert any(ln.startswith("docker rmi sha256:id-redis_7.2")
+               for ln in lines)
+
+
+def test_reattach_roundtrip_carries_image_and_flags(tmp_path,
+                                                    fake_docker):
+    handle, _ad = _start(tmp_path, {"image": "redis:7.2"})
+    drv = BUILTIN_DRIVERS["docker"](ExecContext(None, "x"))
+    re = drv.open(handle.id())
+    assert re.container_id == handle.container_id
+    assert re.image_id == handle.image_id
+    assert re.cleanup_container is True and re.cleanup_image is True
