@@ -221,6 +221,19 @@ class HTTPServer:
         if parts == ["agent", "self"]:
             return 200, {"config": vars(agent.config),
                          "stats": agent.stats()}, None
+        if parts == ["agent", "monitor"]:
+            # Recent agent log lines from the in-process ring
+            # (reference command/agent/log_writer.go: the monitor's
+            # backlog source).  ?lines=N trims to the newest N.
+            writer = getattr(agent, "log_writer", None)
+            if writer is None:
+                raise KeyError("agent log ring not installed "
+                               "(library embedding)")
+            try:
+                n = max(0, int(query.get("lines", "0")))
+            except ValueError:
+                n = 0
+            return 200, {"lines": writer.lines(n)}, None
         if parts == ["agent", "members"]:
             members = []
             if agent.server is not None:

@@ -159,31 +159,46 @@ def main(argv=None) -> int:
 
 def cmd_agent(args) -> int:
     from nomad_tpu.agent import Agent, AgentConfig
+    from nomad_tpu.utils.gated_log import BootLogGate
 
-    if args.dev:
-        cfg = AgentConfig.dev()
-        cfg.http_port = args.http_port
-        cfg.rpc_port = args.rpc_port
-    else:
-        cfg = AgentConfig(
-            server_enabled=args.server,
-            client_enabled=args.client,
-            data_dir=args.data_dir,
-            bind_addr=args.bind,
-            http_port=args.http_port,
-            rpc_port=args.rpc_port,
-            serf_port=args.serf_port,
-        )
-        if args.servers:
-            for part in args.servers.split(","):
-                host, port = part.rsplit(":", 1)
-                cfg.servers.append((host, int(port)))
-    if args.config:
-        from nomad_tpu.agent.config import (apply_to_agent_config,
-                                            load_config_sources)
-        apply_to_agent_config(cfg, load_config_sources(args.config))
+    # Gate boot logs until the final level/sinks are known (config files
+    # parsed, agent constructed) — reference helper/gated-writer +
+    # command/agent/log_writer.go.  Buffered lines replay exactly once.
+    gate = BootLogGate()
 
-    agent = Agent(cfg)
+    try:
+        if args.dev:
+            cfg = AgentConfig.dev()
+            cfg.http_port = args.http_port
+            cfg.rpc_port = args.rpc_port
+        else:
+            cfg = AgentConfig(
+                server_enabled=args.server,
+                client_enabled=args.client,
+                data_dir=args.data_dir,
+                bind_addr=args.bind,
+                http_port=args.http_port,
+                rpc_port=args.rpc_port,
+                serf_port=args.serf_port,
+            )
+            if args.servers:
+                for part in args.servers.split(","):
+                    host, port = part.rsplit(":", 1)
+                    cfg.servers.append((host, int(port)))
+        if args.config:
+            from nomad_tpu.agent.config import (apply_to_agent_config,
+                                                load_config_sources)
+            apply_to_agent_config(cfg, load_config_sources(args.config))
+
+        agent = Agent(cfg)
+    except BaseException:
+        # A failed boot must still surface its buffered logs — they are
+        # exactly what explains the failure.  DEBUG: show everything.
+        gate.open("DEBUG")
+        raise
+    gate.open(cfg.log_level)
+    agent.log_writer = gate.log_writer
+    agent.on_log_level = gate.set_level
     http_host, http_port = agent.http.address
     print(f"==> nomad-tpu agent started")
     print(f"    HTTP: http://{http_host}:{http_port}")

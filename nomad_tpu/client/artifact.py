@@ -85,29 +85,29 @@ def fetch_artifact(source: str, dest_dir: str, checksum: str = "") -> str:
                     open(tmp, "wb") as out:
                 shutil.copyfileobj(resp, out)
             os.replace(tmp, dest)
-        except ArtifactError:
-            raise
         except Exception as e:
             raise ArtifactError(
                 f"failed to fetch artifact {fetch_url!r}: {e}") from e
         finally:
             if os.path.exists(tmp):
                 os.unlink(tmp)
-    elif parsed.scheme == "file":
-        src = parsed.path
+    else:
+        if parsed.scheme == "file":
+            # Percent-decoded filesystem path ("file:///a%20b.jar").
+            src = urllib.request.url2pathname(parsed.path)
+        elif checksum and parsed.query:
+            # Plain path whose ?checksum= query we consumed: the path
+            # component is the file.
+            src = parsed.path
+        else:
+            src = source
+        # Local path (plain or file://): copy into the task dir so the
+        # task owns a stable, chroot-visible instance.
         try:
             shutil.copy2(src, dest)
         except OSError as e:
             raise ArtifactError(
                 f"failed to copy artifact {src!r}: {e}") from e
-    else:
-        # Plain local path: copy into the task dir so the task owns a
-        # stable, chroot-visible instance.
-        try:
-            shutil.copy2(source, dest)
-        except OSError as e:
-            raise ArtifactError(
-                f"failed to copy artifact {source!r}: {e}") from e
 
     if checksum:
         _verify(dest, checksum)

@@ -7,6 +7,15 @@ from typing import Any, Optional
 from nomad_tpu.structs import Node
 
 
+def read_bool_option(options: dict, key: str, default: bool = False) -> bool:
+    """One truthy-string rule for the options kv namespace, shared by
+    ClientConfig and driver ExecContext readers."""
+    v = options.get(key)
+    if v is None:
+        return default
+    return str(v).strip().lower() in ("1", "t", "true", "yes")
+
+
 @dataclass
 class ClientConfig:
     state_dir: str = ""
@@ -26,7 +35,4 @@ class ClientConfig:
         return str(self.options.get(key, default))
 
     def read_bool(self, key: str, default: bool = False) -> bool:
-        v = self.options.get(key)
-        if v is None:
-            return default
-        return str(v).strip().lower() in ("1", "t", "true", "yes")
+        return read_bool_option(self.options, key, default)

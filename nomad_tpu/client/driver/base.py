@@ -34,10 +34,9 @@ class ExecContext:
         self.options = options or {}
 
     def read_bool(self, key: str, default: bool = False) -> bool:
-        v = self.options.get(key)
-        if v is None:
-            return default
-        return str(v).strip().lower() in ("1", "t", "true", "yes")
+        from nomad_tpu.client.config import read_bool_option
+
+        return read_bool_option(self.options, key, default)
 
 
 class DriverHandle:

@@ -1,0 +1,106 @@
+"""Boot-log gating + recent-log ring (reference helper/gated-writer +
+command/agent/log_writer.go)."""
+from __future__ import annotations
+
+import io
+import logging
+
+from nomad_tpu.utils.gated_log import BootLogGate, GatedHandler, LogWriter
+
+
+def test_pre_config_lines_appear_exactly_once_post_setup():
+    stream = io.StringIO()
+    gate = BootLogGate(logger_name="nomad_tpu.test.boot", stream=stream)
+    try:
+        log = logging.getLogger("nomad_tpu.test.boot")
+        log.info("boot line one")
+        log.warning("boot line two")
+        assert stream.getvalue() == ""  # nothing until the gate opens
+
+        gate.open("INFO")
+        out = stream.getvalue()
+        assert out.count("boot line one") == 1
+        assert out.count("boot line two") == 1
+
+        log.info("live line")
+        out = stream.getvalue()
+        # Replay happened once; live lines pass straight through.
+        assert out.count("boot line one") == 1
+        assert out.count("live line") == 1
+    finally:
+        gate.remove()
+
+
+def test_configured_level_filters_buffered_and_live():
+    stream = io.StringIO()
+    gate = BootLogGate(logger_name="nomad_tpu.test.lvl", stream=stream)
+    try:
+        log = logging.getLogger("nomad_tpu.test.lvl")
+        log.debug("buffered debug")
+        log.info("buffered info")
+        gate.open("WARN")
+        log.info("live info")
+        log.warning("live warn")
+        out = stream.getvalue()
+        assert "buffered debug" not in out
+        assert "buffered info" not in out
+        assert "live info" not in out
+        assert "live warn" in out
+    finally:
+        gate.remove()
+
+
+def test_sighup_level_change_refilters(caplog):
+    stream = io.StringIO()
+    gate = BootLogGate(logger_name="nomad_tpu.test.re", stream=stream)
+    try:
+        log = logging.getLogger("nomad_tpu.test.re")
+        gate.open("INFO")
+        log.debug("hidden debug")
+        assert "hidden debug" not in stream.getvalue()
+        gate.set_level("DEBUG")
+        log.debug("visible debug")
+        assert "visible debug" in stream.getvalue()
+    finally:
+        gate.remove()
+
+
+def test_log_writer_ring_and_monitor():
+    writer = LogWriter(maxlen=3)
+    log = logging.getLogger("nomad_tpu.test.ring")
+    log.setLevel(logging.INFO)
+    log.propagate = False
+    log.addHandler(writer)
+    try:
+        for i in range(5):
+            log.info("line %d", i)
+        ring = writer.lines()
+        assert len(ring) == 3
+        assert ring[-1].endswith("line 4")
+        assert writer.lines(1)[0].endswith("line 4")
+
+        seen: list = []
+        unsub = writer.monitor(seen.append)
+        assert len(seen) == 3  # backlog replayed into the monitor
+        log.info("tail line")
+        assert seen[-1].endswith("tail line")
+        unsub()
+        log.info("after unsub")
+        assert not seen[-1].endswith("after unsub")
+    finally:
+        log.removeHandler(writer)
+        log.propagate = True
+
+
+def test_gated_handler_threadsafe_open():
+    gate = GatedHandler()
+    sink = LogWriter()
+    rec = logging.LogRecord("n", logging.INFO, __file__, 1, "msg-%d", (7,),
+                            None)
+    gate.emit(rec)
+    gate.open_gate([sink])
+    assert any("msg-7" in ln for ln in sink.lines())
+    rec2 = logging.LogRecord("n", logging.INFO, __file__, 1, "msg-%d",
+                             (8,), None)
+    gate.emit(rec2)
+    assert any("msg-8" in ln for ln in sink.lines())
