@@ -30,7 +30,9 @@ class LogWriter(logging.Handler):
         self.setFormatter(logging.Formatter(FORMAT))
         self._ring: deque = deque(maxlen=maxlen)
         self._sinks: list = []
-        self._slock = threading.Lock()
+        # Reentrant: a sink that logs through the same logger (error
+        # paths) must not deadlock the pipeline.
+        self._slock = threading.RLock()
 
     def emit(self, record: logging.LogRecord) -> None:
         try:
@@ -55,10 +57,14 @@ class LogWriter(logging.Handler):
         """Attach a live sink; returns an unsubscribe callable.  The
         recent ring is replayed into the sink first, so a monitor sees
         context before the live tail (reference log_writer.go logs +
-        handlers)."""
+        handlers).
+
+        Replay-then-register happens under the (reentrant) lock so a
+        concurrent emit cannot interleave a live line among backlog
+        lines; the cost is that logging threads wait out the (bounded,
+        <= maxlen lines) replay at attach time — sinks must be prompt
+        and never block on remote I/O (buffer and drain elsewhere)."""
         with self._slock:
-            # Replay THEN register, both under the lock: a concurrent
-            # emit cannot interleave a live line among backlog lines.
             for line in self._ring:
                 sink(line)
             self._sinks.append(sink)
@@ -99,12 +105,21 @@ class GatedHandler(logging.Handler):
         self._dispatch(targets, record)
 
     def open_gate(self, targets: list) -> None:
+        """Drain-then-open: buffered records are dispatched BEFORE the
+        gate flips, iterating until the buffer is empty under the lock,
+        so live records emitted by already-running threads during the
+        replay still queue behind the backlog — output stays in
+        chronological order."""
         with self._glock:
             self._targets = list(targets)
-            self._open = True
-            buffered, self._buffer = self._buffer, []
-        for record in buffered:
-            self._dispatch(self._targets, record)
+        while True:
+            with self._glock:
+                buffered, self._buffer = self._buffer, []
+                if not buffered:
+                    self._open = True
+                    return
+            for record in buffered:
+                self._dispatch(self._targets, record)
 
 
 class BootLogGate:
@@ -134,16 +149,21 @@ class BootLogGate:
         stderr_handler = logging.StreamHandler(self._stream or sys.stderr)
         stderr_handler.setFormatter(logging.Formatter(FORMAT))
         stderr_handler.setLevel(numeric)
-        self.log_writer.setLevel(numeric)
+        # The ring stays UNLEVELED: /v1/agent/monitor can serve DEBUG
+        # backlog even when stderr filters at INFO (the logger is held
+        # at DEBUG for exactly this; the extra record construction on
+        # debug sites is the price of always-available monitor detail).
         self.gate.open_gate([stderr_handler, self.log_writer])
 
     def set_level(self, level: str) -> None:
-        """Re-filter the open pipeline (SIGHUP log_level reload)."""
+        """Re-filter the open pipeline (SIGHUP log_level reload).  Only
+        the stderr handler moves; the ring keeps capturing everything."""
         numeric = getattr(logging, str(level).upper(), None)
         if not isinstance(numeric, int):
             return
         for target in self.gate._targets:
-            target.setLevel(numeric)
+            if target is not self.log_writer:
+                target.setLevel(numeric)
 
     def remove(self) -> None:
         """Detach (tests / embedder cleanup)."""
