@@ -65,7 +65,9 @@ class LogWriter(logging.Handler):
         <= maxlen lines) replay at attach time — sinks must be prompt
         and never block on remote I/O (buffer and drain elsewhere)."""
         with self._slock:
-            for line in self._ring:
+            # Snapshot: a reentrant sink that logs (the RLock admits it)
+            # would otherwise mutate the deque mid-iteration.
+            for line in list(self._ring):
                 sink(line)
             self._sinks.append(sink)
 
