@@ -229,11 +229,22 @@ class HTTPServer:
             if writer is None:
                 raise KeyError("agent log ring not installed "
                                "(library embedding)")
+            if "since" in query:
+                # Follow mode: lines after a monotonic offset, plus the
+                # new offset to resume from (append-only contract even
+                # across ring eviction).
+                try:
+                    since = max(0, int(query.get("since", "0")))
+                except ValueError:
+                    since = 0
+                lines, offset = writer.lines_since(since)
+                return 200, {"lines": lines, "offset": offset}, None
             try:
                 n = max(0, int(query.get("lines", "0")))
             except ValueError:
                 n = 0
-            return 200, {"lines": writer.lines(n)}, None
+            return 200, {"lines": writer.lines(n),
+                         "offset": writer.lines_since(0)[1]}, None
         if parts == ["agent", "members"]:
             members = []
             if agent.server is not None:

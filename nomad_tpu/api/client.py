@@ -176,6 +176,20 @@ class APIClient:
         data, _ = self.get("/v1/agent/self")
         return data
 
+    def agent_monitor(self, lines: int = 0) -> list:
+        """Recent agent log lines from the in-process ring
+        (/v1/agent/monitor; reference command/agent/log_writer.go)."""
+        params = {"lines": int(lines)} if lines else None
+        data, _ = self.raw("GET", "/v1/agent/monitor", params)
+        return data.get("lines", [])
+
+    def agent_monitor_since(self, since: int) -> tuple[list, int]:
+        """(lines after monotonic offset ``since``, next offset) —
+        follow-mode polling without re-printing on ring wraps."""
+        data, _ = self.raw("GET", "/v1/agent/monitor",
+                           {"since": int(since)})
+        return data.get("lines", []), int(data.get("offset", 0))
+
     def agent_members(self) -> list:
         data, _ = self.get("/v1/agent/members")
         return data.get("members", [])

@@ -136,6 +136,11 @@ def main(argv=None) -> int:
                        help="view or update the client's server list")
     p.add_argument("-update-servers", dest="update_servers", default="",
                    help="comma-separated host:port list to switch to")
+    p = sub.add_parser("monitor", help="stream recent agent log lines")
+    p.add_argument("-lines", type=int, default=0,
+                   help="newest N lines (0 = full ring)")
+    p.add_argument("-follow", action="store_true",
+                   help="poll for new lines until interrupted")
     sub.add_parser("agent-info", help="agent diagnostics")
     sub.add_parser("version", help="print version")
 
@@ -469,6 +474,29 @@ def cmd_agent_info(args) -> int:
     return 0
 
 
+def cmd_monitor(args) -> int:
+    """Print the agent's recent log ring; with -follow, poll for new
+    lines by monotonic offset (the reference's poll-based monitor
+    pattern, monitor.go — offsets survive ring wraps, no re-prints)."""
+    if args.lines < 0:
+        print("monitor: -lines must be >= 0", file=sys.stderr)
+        return 1
+    client = APIClient(args.address)
+    for line in client.agent_monitor(args.lines):
+        print(line)
+    if not args.follow:
+        return 0
+    _, offset = client.agent_monitor_since(1 << 62)  # current offset only
+    try:
+        while True:
+            time.sleep(1.0)
+            lines, offset = client.agent_monitor_since(offset)
+            for line in lines:
+                print(line)
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_version(args) -> int:
     print(f"nomad-tpu v{__version__}")
     return 0
@@ -489,6 +517,7 @@ COMMANDS = {
     "server-join": cmd_server_join,
     "server-force-leave": cmd_server_force_leave,
     "client-config": cmd_client_config,
+    "monitor": cmd_monitor,
     "agent-info": cmd_agent_info,
     "version": cmd_version,
 }

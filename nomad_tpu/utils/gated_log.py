@@ -29,6 +29,7 @@ class LogWriter(logging.Handler):
         super().__init__()
         self.setFormatter(logging.Formatter(FORMAT))
         self._ring: deque = deque(maxlen=maxlen)
+        self._total = 0  # monotonic count of lines ever appended
         self._sinks: list = []
         # Reentrant: a sink that logs through the same logger (error
         # paths) must not deadlock the pipeline.
@@ -41,6 +42,7 @@ class LogWriter(logging.Handler):
             return
         with self._slock:
             self._ring.append(line)
+            self._total += 1
             sinks = list(self._sinks)
         for sink in sinks:
             try:
@@ -52,6 +54,17 @@ class LogWriter(logging.Handler):
         with self._slock:
             out = list(self._ring)
         return out[-n:] if n else out
+
+    def lines_since(self, since: int) -> tuple[list, int]:
+        """(lines appended after monotonic offset ``since``, current
+        offset) — the follow-mode contract: clients resume from the
+        returned offset and never re-see or miss a line (lines evicted
+        past the ring's maxlen before being read are simply gone)."""
+        with self._slock:
+            total = self._total
+            ring = list(self._ring)
+        avail = min(len(ring), max(0, total - since))
+        return (ring[-avail:] if avail else []), total
 
     def monitor(self, sink: Callable[[str], None]) -> Callable[[], None]:
         """Attach a live sink; returns an unsubscribe callable.  The

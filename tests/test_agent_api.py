@@ -369,3 +369,45 @@ class TestCLI:
         code, out = self.run_cli(dev_agent, "validate", "example.nomad")
         assert code == 0, out
         assert "successful" in out
+
+
+def test_agent_monitor_ring_and_cli(dev_agent, capsys):
+    """/v1/agent/monitor serves the recent-log ring; the monitor CLI
+    prints it (reference command/agent/log_writer.go consumer)."""
+    import logging
+
+    from nomad_tpu.cli.main import main as cli_main
+    from nomad_tpu.utils.gated_log import LogWriter
+
+    agent, client = dev_agent
+    writer = LogWriter()
+    log = logging.getLogger("nomad_tpu.test.monitorcli")
+    log.setLevel(logging.INFO)
+    log.propagate = False
+    log.addHandler(writer)
+    agent.log_writer = writer
+    try:
+        log.info("monitor line alpha")
+        log.info("monitor line beta")
+        lines = client.agent_monitor()
+        assert any("monitor line alpha" in ln for ln in lines)
+        assert any("monitor line beta" in ln for ln in lines)
+        assert len(client.agent_monitor(lines=1)) == 1
+
+        addr = f"http://127.0.0.1:{agent.http.address[1]}"
+        rc = cli_main(["-address", addr, "monitor", "-lines", "2"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "monitor line alpha" in out and "monitor line beta" in out
+    finally:
+        agent.log_writer = None
+        log.removeHandler(writer)
+
+
+def test_agent_monitor_absent_without_ring(dev_agent):
+    """Library embeddings (no CLI boot gate) 404 the monitor endpoint."""
+    agent, client = dev_agent
+    assert agent.log_writer is None
+    with pytest.raises(Exception) as exc:
+        client.agent_monitor()
+    assert "404" in str(exc.value) or "not" in str(exc.value).lower()

@@ -104,3 +104,36 @@ def test_gated_handler_threadsafe_open():
                              (8,), None)
     gate.emit(rec2)
     assert any("msg-8" in ln for ln in sink.lines())
+
+
+def test_log_writer_lines_since_offsets_survive_wrap():
+    """Follow-mode contract: monotonic offsets work across ring
+    eviction — no re-prints, evicted-unread lines simply gone."""
+    import logging as _logging
+
+    writer = LogWriter(maxlen=4)
+    log = _logging.getLogger("nomad_tpu.test.since")
+    log.setLevel(_logging.INFO)
+    log.propagate = False
+    log.addHandler(writer)
+    try:
+        for i in range(3):
+            log.info("w%d", i)
+        lines, off = writer.lines_since(0)
+        assert len(lines) == 3 and off == 3
+        # Nothing new: empty, offset unchanged.
+        lines, off2 = writer.lines_since(off)
+        assert lines == [] and off2 == 3
+        # Wrap the ring: 6 more lines into a 4-slot ring.
+        for i in range(3, 9):
+            log.info("w%d", i)
+        lines, off3 = writer.lines_since(off)
+        assert off3 == 9
+        # 6 appended since offset 3, but only 4 survive the ring.
+        assert [ln[-2:] for ln in lines] == ["w5", "w6", "w7", "w8"]
+        # Duplicate message text cannot confuse offsets.
+        log.info("w8")
+        lines, off4 = writer.lines_since(off3)
+        assert len(lines) == 1 and off4 == 10
+    finally:
+        log.removeHandler(writer)
