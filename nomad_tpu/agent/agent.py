@@ -99,9 +99,11 @@ class Agent:
         self.server: Optional[Server] = None
         self.client: Optional[Client] = None
         self.http = None
-        # Recent-log ring (utils/gated_log.LogWriter), installed by the
-        # CLI boot gate; None for library embedders.
+        # Recent-log ring (utils/gated_log.LogWriter) + level-change
+        # hook, installed by the CLI boot gate; None for library
+        # embedders.
         self.log_writer = None
+        self.on_log_level = None
         # Apply the configured level only when nothing else set one —
         # embedders who configured logging themselves keep their setting.
         if logging.getLogger("nomad_tpu").level == logging.NOTSET:
@@ -259,11 +261,10 @@ class Agent:
 
     # -- reload --------------------------------------------------------------
     def _apply_log_level(self, level: str) -> None:
-        on_log_level = getattr(self, "on_log_level", None)
-        if on_log_level is not None:
+        if self.on_log_level is not None:
             # CLI boot-gate pipeline: levels live on its handlers (the
             # logger stays at DEBUG so the ring can capture everything).
-            on_log_level(level)
+            self.on_log_level(level)
             return
         numeric = getattr(logging, str(level).upper(), None)
         if isinstance(numeric, int):

@@ -243,8 +243,9 @@ class HTTPServer:
                 n = max(0, int(query.get("lines", "0")))
             except ValueError:
                 n = 0
-            return 200, {"lines": writer.lines(n),
-                         "offset": writer.lines_since(0)[1]}, None
+            lines, offset = writer.lines_since(0)  # one lock acquisition
+            return 200, {"lines": lines[-n:] if n else lines,
+                         "offset": offset}, None
         if parts == ["agent", "members"]:
             members = []
             if agent.server is not None:

@@ -482,15 +482,24 @@ def cmd_monitor(args) -> int:
         print("monitor: -lines must be >= 0", file=sys.stderr)
         return 1
     client = APIClient(args.address)
-    for line in client.agent_monitor(args.lines):
+    # One request serves both modes: the (server-trimmed) ring snapshot
+    # to print and the offset -follow resumes from.
+    data, _ = client.raw("GET", "/v1/agent/monitor",
+                         {"lines": args.lines} if args.lines else None)
+    for line in data.get("lines", []):
         print(line)
     if not args.follow:
         return 0
-    _, offset = client.agent_monitor_since(1 << 62)  # current offset only
+    offset = int(data.get("offset", 0))
     try:
         while True:
             time.sleep(1.0)
-            lines, offset = client.agent_monitor_since(offset)
+            try:
+                lines, offset = client.agent_monitor_since(offset)
+            except (OSError, APIError):
+                # Transient (agent reload/restart): the monotonic offset
+                # lets the stream resume where it left off.
+                continue
             for line in lines:
                 print(line)
     except KeyboardInterrupt:

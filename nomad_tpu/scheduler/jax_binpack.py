@@ -91,6 +91,33 @@ def _native_bulk():
 _METRIC_FACTORY_NAMES = tuple(n for n, _f in _METRIC_FACTORIES)
 
 
+def run_bulk_finish(native, sched, place, group_l, chosen_l, scores_l,
+                    uuids, slots_c, alloc_proto, metric_proto,
+                    coalesce_all: int):
+    """One marshalling point for native.bulk_finish (the C finish-loop
+    happy path), shared by the generic and system schedulers.  ``sched``
+    supplies the per-eval placement state (_node_net/_net_base_for/
+    _port_lcg via FastPlacementMixin, plan, state, ctx).  Returns
+    (resume index, failed-TG map); updates sched._port_lcg."""
+    plan = sched.plan
+    statics = sched._statics
+    start_p, sched._port_lcg, fmap = native.bulk_finish(
+        place if type(place) is list else list(place),
+        group_l, chosen_l, scores_l, uuids, slots_c,
+        statics.nodes, sched._node_net, statics.net_base,
+        sched._net_base_for,
+        sched.state.allocs_node_index(), sched.ctx, plan.node_update,
+        plan.node_allocation, plan.failed_allocs,
+        alloc_proto, metric_proto, _METRIC_FACTORY_NAMES,
+        Allocation, AllocMetric, Resources, NetworkResource,
+        (ALLOC_DESIRED_STATUS_RUN, ALLOC_CLIENT_STATUS_PENDING,
+         ALLOC_DESIRED_STATUS_FAILED, ALLOC_CLIENT_STATUS_FAILED,
+         "failed to find a node for placement"),
+        coalesce_all, sched._port_lcg, MIN_DYNAMIC_PORT,
+        MAX_DYNAMIC_PORT)
+    return start_p, fmap
+
+
 def build_slots_c(slot_plans) -> list:
     """Slot table for the native bulk finish (native/port_alloc.cpp):
     one (size_obj, [(task_name, res_proto_dict, net_c), ...]) entry per
@@ -804,21 +831,10 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
                     (sizes[g], net_plans[g][1])
                     for g in range(args.n_groups))
                 args.slots_c[0] = slots_c
-            group_l = args.group_l
-            place_l = place if type(place) is list else list(place)
-            start_p, self._port_lcg, fmap = native.bulk_finish(
-                place_l, group_l, chosen_l, scores_l, uuids, slots_c,
-                nodes_arr, self._node_net, statics.net_base,
-                self._net_base_for,
-                self.state.allocs_node_index(), self.ctx, plan.node_update,
-                plan.node_allocation, plan.failed_allocs,
-                alloc_proto, metric_proto, _METRIC_FACTORY_NAMES,
-                Allocation, AllocMetric, Resources, NetworkResource,
-                (ALLOC_DESIRED_STATUS_RUN, ALLOC_CLIENT_STATUS_PENDING,
-                 ALLOC_DESIRED_STATUS_FAILED, ALLOC_CLIENT_STATUS_FAILED,
-                 "failed to find a node for placement"),
-                1,  # coalesce_all: generic TG placements interchangeable
-                self._port_lcg, MIN_DYNAMIC_PORT, MAX_DYNAMIC_PORT)
+            start_p, fmap = run_bulk_finish(
+                native, self, place, args.group_l, chosen_l, scores_l,
+                uuids, slots_c, alloc_proto, metric_proto,
+                coalesce_all=1)  # generic TG placements interchangeable
             failed_tg.update(fmap)
 
         for p in range(start_p, len(place)):

@@ -44,21 +44,18 @@ from nomad_tpu.structs import (
     ALLOC_DESIRED_STATUS_RUN,
     AllocMetric,
     Allocation,
-    NetworkResource,
-    Resources,
     generate_uuids,
 )
-from nomad_tpu.structs.model import MAX_DYNAMIC_PORT, MIN_DYNAMIC_PORT
 
 from .jax_binpack import (
     _ALLOC_STATIC,
     _METRIC_FACTORIES,
-    _METRIC_FACTORY_NAMES,
     _METRIC_STATIC,
     FastPlacementMixin,
     _native_bulk,
     _net_plan_for,
     build_slots_c,
+    run_bulk_finish,
 )
 from .system import SystemScheduler
 from .util import task_group_constraints
@@ -260,21 +257,10 @@ class VectorSystemScheduler(SystemScheduler, FastPlacementMixin):
                     for _tg, _mask, _dist, _ask, size, (_f, plan_tasks)
                     in slots)
                 slots_c_holder[0] = slots_c
-            start_p, self._port_lcg, fmap = native.bulk_finish(
-                place if type(place) is list else list(place),
-                group_l, chosen_l, scores_l, uuids, slots_c,
-                nodes_arr, self._node_net, statics.net_base,
-                self._net_base_for,
-                self.state.allocs_node_index(), self.ctx,
-                plan.node_update, plan.node_allocation,
-                plan.failed_allocs,
-                alloc_proto, metric_proto, _METRIC_FACTORY_NAMES,
-                Allocation, AllocMetric, Resources, NetworkResource,
-                (ALLOC_DESIRED_STATUS_RUN, ALLOC_CLIENT_STATUS_PENDING,
-                 ALLOC_DESIRED_STATUS_FAILED, ALLOC_CLIENT_STATUS_FAILED,
-                 "failed to find a node for placement"),
-                0,  # node-pinned: coalesce only chosen-less placements
-                self._port_lcg, MIN_DYNAMIC_PORT, MAX_DYNAMIC_PORT)
+            start_p, fmap = run_bulk_finish(
+                native, self, place, group_l, chosen_l, scores_l,
+                uuids, slots_c, alloc_proto, metric_proto,
+                coalesce_all=0)  # node-pinned: coalesce chosen-less only
             failed_tg.update(fmap)
             for failed in fmap.values():
                 failed.metrics.nodes_filtered = 1
