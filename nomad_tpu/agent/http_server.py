@@ -229,21 +229,18 @@ class HTTPServer:
             if writer is None:
                 raise KeyError("agent log ring not installed "
                                "(library embedding)")
-            if "since" in query:
-                # Follow mode: lines after a monotonic offset, plus the
-                # new offset to resume from (append-only contract even
-                # across ring eviction).
+
+            def _qint(key):
                 try:
-                    since = max(0, int(query.get("since", "0")))
+                    return max(0, int(query.get(key, "0")))
                 except ValueError:
-                    since = 0
-                lines, offset = writer.lines_since(since)
-                return 200, {"lines": lines, "offset": offset}, None
-            try:
-                n = max(0, int(query.get("lines", "0")))
-            except ValueError:
-                n = 0
-            lines, offset = writer.lines_since(0)  # one lock acquisition
+                    return 0
+            # ?since=offset -> lines after that monotonic offset
+            # (follow mode; offsets survive ring eviction);
+            # ?lines=N -> trim to the newest N.  The returned offset
+            # resumes a follow stream from exactly this response.
+            lines, offset = writer.lines_since(_qint("since"))
+            n = _qint("lines")
             return 200, {"lines": lines[-n:] if n else lines,
                          "offset": offset}, None
         if parts == ["agent", "members"]:

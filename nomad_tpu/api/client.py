@@ -179,15 +179,17 @@ class APIClient:
     def agent_monitor(self, lines: int = 0) -> list:
         """Recent agent log lines from the in-process ring
         (/v1/agent/monitor; reference command/agent/log_writer.go)."""
-        params = {"lines": int(lines)} if lines else None
-        data, _ = self.raw("GET", "/v1/agent/monitor", params)
-        return data.get("lines", [])
+        return self.agent_monitor_since(0, lines)[0]
 
-    def agent_monitor_since(self, since: int) -> tuple[list, int]:
-        """(lines after monotonic offset ``since``, next offset) —
-        follow-mode polling without re-printing on ring wraps."""
-        data, _ = self.raw("GET", "/v1/agent/monitor",
-                           {"since": int(since)})
+    def agent_monitor_since(self, since: int,
+                            lines: int = 0) -> tuple[list, int]:
+        """(lines after monotonic offset ``since`` — newest ``lines``
+        of them when nonzero — and the next offset): follow-mode
+        polling without re-printing on ring wraps."""
+        params: dict = {"since": int(since)}
+        if lines:
+            params["lines"] = int(lines)
+        data, _ = self.raw("GET", "/v1/agent/monitor", params)
         return data.get("lines", []), int(data.get("offset", 0))
 
     def agent_members(self) -> list:

@@ -484,13 +484,11 @@ def cmd_monitor(args) -> int:
     client = APIClient(args.address)
     # One request serves both modes: the (server-trimmed) ring snapshot
     # to print and the offset -follow resumes from.
-    data, _ = client.raw("GET", "/v1/agent/monitor",
-                         {"lines": args.lines} if args.lines else None)
-    for line in data.get("lines", []):
+    lines, offset = client.agent_monitor_since(0, args.lines)
+    for line in lines:
         print(line)
     if not args.follow:
         return 0
-    offset = int(data.get("offset", 0))
     try:
         while True:
             time.sleep(1.0)

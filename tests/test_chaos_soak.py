@@ -156,3 +156,70 @@ def test_chaos_storm_with_heartbeat_expiry(seed):
     finally:
         pool.shutdown()
         srv.shutdown()
+
+
+def test_leader_failover_mid_storm():
+    """Raft-failover chaos: the leader dies while a storm is in flight;
+    the new leader restores the eval broker from replicated state,
+    finishes every evaluation, and the committed allocations still
+    satisfy exact fit (plans commit atomically through raft, so a
+    half-processed storm can never leave torn placements)."""
+    from tests.test_raft_net import (
+        make_cluster,
+        wait_for_leader,
+        wait_until,
+    )
+
+    servers = make_cluster(3)
+    try:
+        leader = wait_for_leader(servers)
+        nodes = [mock.node(i) for i in range(10)]
+        for node in nodes:
+            leader.node_register(node)
+
+        rng = np.random.default_rng(11)
+        eval_ids = []
+        for _ in range(8):
+            job = _storm_job(rng, 6)
+            _, eid = leader.job_register(job)
+            eval_ids.append(eid)
+
+        # Kill the leader immediately: the storm is mid-flight.
+        # (Server.shutdown tears down raft + RPC too.)
+        survivors = [s for s in servers if s is not leader]
+        leader.shutdown()
+        for s in survivors:
+            s.raft.remove_peer(leader.rpc_address())
+
+        new_leader = wait_for_leader(survivors, timeout=10)
+
+        # Every raft-committed eval must reach a terminal status under
+        # the new leader (broker restored from replicated state).
+        def all_terminal():
+            state = new_leader.fsm.state
+            evs = [state.eval_by_id(eid) for eid in eval_ids]
+            return all(e is not None and e.status in TERMINAL
+                       for e in evs)
+        wait_until(all_terminal, timeout=30,
+                   msg="storm evals terminal on the new leader")
+
+        # Committed placements satisfy exact fit on every node, on every
+        # survivor's replica.
+        for s in survivors:
+            state = s.fsm.state
+            for node in nodes:
+                live = [a for a in state.allocs_by_node(node.id)
+                        if not a.terminal_status() and a.node_id]
+                fit, dim, _ = allocs_fit(state.node_by_id(node.id), live)
+                assert fit, f"node {node.id} oversubscribed on {dim}"
+        # Replicas agree on the alloc set.
+        def alloc_ids(s):
+            return frozenset(a.id for a in s.fsm.state.allocs())
+        wait_until(lambda: alloc_ids(survivors[0]) == alloc_ids(
+            survivors[1]), msg="replicas agree on allocs")
+    finally:
+        for s in servers:
+            try:
+                s.shutdown()
+            except Exception:
+                pass
