@@ -95,6 +95,35 @@ def place_sequence_host(capacity, reserved, usage0, job_counts0, feasible,
     return chosen, scores, usage_full
 
 
+def _topk_exact(masked: np.ndarray, k: int) -> np.ndarray:
+    """Indices of the k largest scores, ties broken by LOWER index —
+    exactly lax.top_k's contract — in O(n + k log k).
+
+    A plain argpartition can't be used directly: when ties straddle the
+    k boundary it picks an arbitrary subset (and homogeneous fleets tie
+    constantly).  Packing the score and the inverted index into one
+    int64 key makes the order total, so argpartition selects the same
+    SET top_k would and a small sort of that slice gives the same
+    ORDER.  The float->int map is the standard monotone transform
+    (IEEE-754 totally ordered as sign-flipped integers)."""
+    n = len(masked)
+    if k <= 0:
+        return np.empty(0, dtype=np.intp)
+    if k >= n:
+        return np.argsort(-masked, kind="stable")
+    # -0.0 == +0.0 as floats (tie -> index order) but their bit
+    # patterns differ; +0.0 normalizes both to one key.
+    masked = masked + np.float32(0.0)
+    bits = masked.view(np.int32).astype(np.int64)
+    u = np.where(bits >= 0, bits + np.int64(0x80000000), ~bits)
+    # Center the 32-bit ordered value into signed range BEFORE the
+    # shift so the packed key cannot overflow int64.
+    key = ((u - np.int64(0x80000000)) << np.int64(32)) \
+        | np.arange(n - 1, -1, -1, dtype=np.int64)
+    sel = np.argpartition(key, n - k)[n - k:]
+    return sel[np.argsort(-key[sel])]
+
+
 def place_rounds_host(capacity, reserved, usage0, jc0, feasible, asks,
                       distinct, counts, penalty, k_cap: int, rounds: int,
                       n_real: int = 0):
@@ -128,12 +157,7 @@ def place_rounds_host(capacity, reserved, usage0, jc0, feasible, asks,
             masked = scorer.masked_scores(usage, jc, ask,
                                           feasible[s, :n],
                                           bool(distinct[s]), penalty)
-            # top-k, ties broken by lower node index (lax.top_k parity):
-            # stable sort of the negated scores keeps index order on ties.
-            # (An argpartition prefilter would be O(n) but selects tied
-            # boundary elements arbitrarily — homogeneous fleets tie
-            # constantly, so exact order matters more than the log factor.)
-            order = np.argsort(-masked, kind="stable")[:k_cap]
+            order = _topk_exact(masked, k_cap)
             vals = masked[order]
             take = (pos[:len(order)] < remaining) & (vals > NEG_INF / 2)
             idx = order[take]
