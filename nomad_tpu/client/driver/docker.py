@@ -127,6 +127,16 @@ class DockerDriver(Driver):
             pull = subprocess.run(["docker", "pull", image],
                                   capture_output=True, text=True)
             if pull.returncode != 0:
+                # Unreachable/rate-limited registry: a locally cached
+                # image still runs (matters most for ":latest", whose
+                # freshness pull is best-effort, not a correctness
+                # requirement).
+                cached = self._image_id(image)
+                if cached is not None:
+                    logger.warning(
+                        "pull of %r failed (%s); using cached image %s",
+                        image, pull.stderr.strip(), cached)
+                    return cached
                 raise RuntimeError(
                     f"failed to pull {image!r}: {pull.stderr.strip()}")
             image_id = self._image_id(image)

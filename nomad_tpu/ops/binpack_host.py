@@ -21,6 +21,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from nomad_tpu.structs.funcs import score_fit_vec
+
 NEG_INF = -1.0e30
 DIM_CPU = 0
 DIM_MEM = 1
@@ -44,12 +46,10 @@ class _HostScorer:
                       penalty):
         util = self.base + usage + ask
         fit = (util <= self.capacity).all(axis=-1)
-        free_cpu = 1.0 - util[:, DIM_CPU] / self.safe_cpu
-        free_mem = 1.0 - util[:, DIM_MEM] / self.safe_mem
-        score = 20.0 - (np.power(np.float32(10.0), free_cpu)
-                        + np.power(np.float32(10.0), free_mem))
-        np.clip(score, 0.0, 18.0, out=score)
-        score[~self.valid_node] = 0.0
+        score = score_fit_vec(
+            util[:, DIM_CPU], util[:, DIM_MEM], None, None,
+            valid=self.valid_node, safe_cpu=self.safe_cpu,
+            safe_mem=self.safe_mem)
         score -= penalty * job_counts
         ok = feasible & fit
         if distinct:

@@ -46,6 +46,7 @@ from nomad_tpu.structs import (
     Allocation,
     generate_uuids,
 )
+from nomad_tpu.structs.funcs import score_fit_vec
 
 from .jax_binpack import (
     _ALLOC_STATIC,
@@ -179,17 +180,13 @@ class VectorSystemScheduler(SystemScheduler, FastPlacementMixin):
                 ok &= jc[nis] == 0
             util = reserved[nis] + usage[nis] + ask_vec
             ok &= (util <= capacity[nis]).all(axis=1)
-            # ScoreFit (BestFit v3) on the same rows the device kernel
-            # uses (structs/funcs score_fit parity).
-            node_cpu = capacity[nis, 0] - reserved[nis, 0]
-            node_mem = capacity[nis, 1] - reserved[nis, 1]
-            good = ok & (node_cpu > 0) & (node_mem > 0)
-            sc = np.zeros(len(sel))
-            safe_cpu = np.where(node_cpu > 0, node_cpu, 1.0)
-            safe_mem = np.where(node_mem > 0, node_mem, 1.0)
-            sc_all = 20.0 - (10.0 ** (1.0 - util[:, 0] / safe_cpu)
-                             + 10.0 ** (1.0 - util[:, 1] / safe_mem))
-            sc[good] = np.clip(sc_all[good], 0.0, 18.0)
+            # ScoreFit (BestFit v3) from the one shared producer
+            # (structs/funcs.score_fit_vec — device kernel parity).
+            sc_all = score_fit_vec(
+                util[:, 0], util[:, 1],
+                capacity[nis, 0] - reserved[nis, 0],
+                capacity[nis, 1] - reserved[nis, 1])
+            sc = np.where(ok, sc_all, 0.0)
             okn = nis[ok]
             usage[okn] += ask_vec
             jc[okn] += 1
@@ -214,13 +211,10 @@ class VectorSystemScheduler(SystemScheduler, FastPlacementMixin):
             util = reserved[ni] + usage[ni] + ask_vec
             if not bool((util <= capacity[ni]).all()):
                 continue
-            node_cpu = capacity[ni, 0] - reserved[ni, 0]
-            node_mem = capacity[ni, 1] - reserved[ni, 1]
-            sc = 0.0
-            if node_cpu > 0 and node_mem > 0:
-                sc = 20.0 - (10.0 ** (1.0 - util[0] / node_cpu)
-                             + 10.0 ** (1.0 - util[1] / node_mem))
-                sc = min(max(sc, 0.0), 18.0)
+            sc = float(score_fit_vec(
+                util[0], util[1],
+                capacity[ni, 0] - reserved[ni, 0],
+                capacity[ni, 1] - reserved[ni, 1]))
             usage[ni] += ask_vec
             jc[ni] += 1
             chosen[sel[k]] = ni

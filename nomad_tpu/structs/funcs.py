@@ -75,3 +75,31 @@ def score_fit(node: Node, util: Resources) -> float:
     total = 10.0 ** free_pct_cpu + 10.0 ** free_pct_mem
     score = 20.0 - total
     return max(0.0, min(18.0, score))
+
+
+def score_fit_vec(util_cpu, util_mem, node_cpu, node_mem, *,
+                  valid=None, safe_cpu=None, safe_mem=None):
+    """Vectorized BestFit-v3 twin of score_fit (numpy arrays in, array
+    out): the ONE producer of the scoring curve for the vector paths
+    (ops/binpack_host._HostScorer, scheduler/system_vec stage 2) —
+    tuning the curve or the [0, 18] clamp happens here and in the
+    scalar above only.  Zero-capacity rows score 0 like the scalar's
+    early return.  Callers on a hot path may pass the node-static
+    pieces precomputed (``valid``/``safe_cpu``/``safe_mem``)."""
+    import numpy as np
+
+    if valid is None:
+        valid = (node_cpu > 0) & (node_mem > 0)
+        safe_cpu = np.where(valid, node_cpu, 1.0)
+        safe_mem = np.where(valid, node_mem, 1.0)
+    elif safe_cpu is None or safe_mem is None:
+        raise TypeError("score_fit_vec: the precomputed kwargs are "
+                        "all-or-nothing (valid + safe_cpu + safe_mem)")
+    score = 20.0 - (
+        np.power(np.float32(10.0), 1.0 - util_cpu / safe_cpu)
+        + np.power(np.float32(10.0), 1.0 - util_mem / safe_mem))
+    score = np.asarray(score)
+    # dtype-preserving zero: float32 pipelines must stay float32 (the
+    # host top-k packs the raw float32 bits into its selection key).
+    return np.where(valid, np.clip(score, 0.0, 18.0),
+                    score.dtype.type(0.0))

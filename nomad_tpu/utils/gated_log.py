@@ -59,11 +59,17 @@ class LogWriter(logging.Handler):
         """(lines appended after monotonic offset ``since``, current
         offset) — the follow-mode contract: clients resume from the
         returned offset and never re-see or miss a line (lines evicted
-        past the ring's maxlen before being read are simply gone)."""
+        past the ring's maxlen before being read are simply gone).
+
+        ``since > total`` means the offset came from a PREVIOUS process
+        (agent restarted, counter reset): the whole ring is returned —
+        the restart backlog is exactly what a watching operator wants."""
         with self._slock:
             total = self._total
             ring = list(self._ring)
-        avail = min(len(ring), max(0, total - since))
+        if since > total:
+            return ring, total
+        avail = min(len(ring), total - since)
         return (ring[-avail:] if avail else []), total
 
     def monitor(self, sink: Callable[[str], None]) -> Callable[[], None]:

@@ -152,3 +152,22 @@ def test_reattach_roundtrip_carries_image_and_flags(tmp_path,
     assert re.container_id == handle.container_id
     assert re.image_id == handle.image_id
     assert re.cleanup_container is True and re.cleanup_image is True
+
+
+def test_latest_pull_failure_falls_back_to_cache(tmp_path, fake_docker,
+                                                 monkeypatch):
+    """An unreachable registry must not fail a task whose image is in
+    the local cache (':latest' freshness pull is best-effort)."""
+    # Prime the cache, then make pulls fail.
+    _start(tmp_path, {"image": "redis"}, name="prime")
+    state = tmp_path / "docker-state"
+    bindir = tmp_path / "fakebin"
+    exe = bindir / "docker"
+    script = exe.read_text().replace(
+        'pull)\n    img=$(echo "$2" | tr \'/:\' \'__\')\n    touch "$state/$img" ;;',
+        'pull) echo "registry unreachable" >&2; exit 1 ;;')
+    assert "registry unreachable" in script, "fake rewrite failed"
+    exe.write_text(script)
+    handle, _ad = _start(tmp_path, {"image": "redis"}, name="offline")
+    assert handle.container_id == "cid-12345"
+    assert handle.image_id == "sha256:id-redis"

@@ -137,3 +137,23 @@ def test_log_writer_lines_since_offsets_survive_wrap():
         assert len(lines) == 1 and off4 == 10
     finally:
         log.removeHandler(writer)
+
+
+def test_lines_since_resets_after_counter_restart():
+    """An offset from a previous agent process (since > total) returns
+    the full ring — the restart backlog is exactly what a watching
+    monitor wants, not silence."""
+    import logging as _logging
+
+    writer = LogWriter(maxlen=8)
+    log = _logging.getLogger("nomad_tpu.test.restart")
+    log.setLevel(_logging.INFO)
+    log.propagate = False
+    log.addHandler(writer)
+    try:
+        for i in range(3):
+            log.info("boot %d", i)
+        lines, off = writer.lines_since(5000)  # stale pre-restart offset
+        assert len(lines) == 3 and off == 3
+    finally:
+        log.removeHandler(writer)

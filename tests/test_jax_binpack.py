@@ -605,3 +605,55 @@ def test_host_place_rounds_tie_parity():
         n_real=fleet.n_real)
     assert np.array_equal(np.asarray(dev[0]), host[0])
     assert np.asarray(dev[2]).shape == host[2].shape
+
+
+class TestTopkExact:
+    """Direct coverage for the host kernel's packed-key top-k
+    (ops/binpack_host._topk_exact): must match lax.top_k's contract —
+    k largest, ties broken by LOWER index — exactly, byte-for-byte with
+    the stable-argsort reference on the docstring's hazard cases."""
+
+    def _ref(self, vals, k):
+        return np.argsort(-vals, kind="stable")[:k]
+
+    def test_ties_straddling_the_boundary(self):
+        from nomad_tpu.ops.binpack_host import _topk_exact
+
+        vals = np.array([5.0, 7.0, 5.0, 5.0, 7.0, 5.0, 3.0],
+                        dtype=np.float32)
+        for k in (1, 2, 3, 4, 5):
+            assert np.array_equal(_topk_exact(vals, k),
+                                  self._ref(vals, k)), k
+
+    def test_negative_zero_and_neg_inf_rows(self):
+        from nomad_tpu.ops.binpack_host import NEG_INF, _topk_exact
+
+        vals = np.array([0.0, -0.0, NEG_INF, -0.0, 0.0, -3.5],
+                        dtype=np.float32)
+        for k in range(1, 7):
+            assert np.array_equal(_topk_exact(vals, k),
+                                  self._ref(vals, k)), k
+
+    def test_k_bounds(self):
+        from nomad_tpu.ops.binpack_host import _topk_exact
+
+        vals = np.array([1.0, 2.0], dtype=np.float32)
+        assert len(_topk_exact(vals, 0)) == 0
+        assert np.array_equal(_topk_exact(vals, 5), self._ref(vals, 5))
+
+    def test_randomized_tie_heavy_parity(self):
+        from nomad_tpu.ops.binpack_host import NEG_INF, _topk_exact
+
+        rng = np.random.default_rng(1234)
+        pool = np.array([NEG_INF, -10.0, -0.0, 0.0, 1.25, 1.25, 9.5,
+                         18.0], dtype=np.float32)
+        for _ in range(500):
+            n = int(rng.integers(2, 80))
+            k = int(rng.integers(1, n + 3))
+            vals = rng.choice(pool, size=n)
+            assert np.array_equal(_topk_exact(vals, k),
+                                  self._ref(vals, k))
+        # Continuous values at fleet scale.
+        vals = rng.random(16384).astype(np.float32)
+        assert np.array_equal(_topk_exact(vals, 1024),
+                              self._ref(vals, 1024))
