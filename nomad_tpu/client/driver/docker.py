@@ -117,8 +117,10 @@ class DockerDriver(Driver):
         return out.stdout.strip() if out.returncode == 0 else None
 
     def _ensure_image(self, image: str) -> str:
-        """Pull-if-absent; ``:latest`` (explicit or implied) is always
-        re-pulled so a stale cache never pins an old version
+        """Pull-if-absent; for ``:latest`` (explicit or implied) a
+        refresh pull is attempted on every start, falling back to a
+        locally cached image when the registry is unreachable — the
+        freshness pull is best-effort, offline nodes still run
         (reference docker.go:285-310).  Returns the image id."""
         tag = image.rsplit(":", 1)[1] if ":" in image.split("/")[-1] \
             else "latest"

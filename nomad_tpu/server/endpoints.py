@@ -4,8 +4,9 @@ Capability parity with /root/reference/nomad/{status,node,job,eval,plan,
 alloc}_endpoint.go: every mutating endpoint raft-applies then (where the
 reference does) creates evaluations; reads support blocking queries
 (min_query_index + max wait with jitter, reference nomad/rpc.go:269-338)
-and stale reads; on a follower, writes forward to the leader over the conn
-pool (reference nomad/rpc.go:162-227).
+and stale reads; on a follower, writes AND non-stale reads forward to the
+leader over the conn pool — default reads are consistent, ``stale`` opts
+into follower-local answers (reference nomad/rpc.go:162-227).
 
 Wire shapes are the structs' dict forms; query options ride in the args map
 ("min_query_index", "max_query_time", "stale", "region").
@@ -20,6 +21,16 @@ from nomad_tpu.structs import Allocation, Evaluation, Job, Node
 
 MAX_BLOCKING_WAIT = 300.0  # reference nomad/rpc.go:30-40
 
+# Query endpoints whose default is a consistent (leader-served) read;
+# ``stale`` in the args opts into a follower-local answer.  Status.* is
+# deliberately absent — it reports the answering server's own view.
+CONSISTENT_READS = frozenset({
+    "Node.GetNode", "Node.GetAllocs", "Node.List",
+    "Job.GetJob", "Job.List", "Job.Allocations", "Job.Evaluations",
+    "Eval.GetEval", "Eval.List", "Eval.Allocations",
+    "Alloc.List", "Alloc.GetAlloc",
+})
+
 
 def _jittered(wait: float) -> float:
     wait = min(wait, MAX_BLOCKING_WAIT)
@@ -33,6 +44,7 @@ class Endpoints:
         self.server = server
 
     def install(self, rpc_server) -> None:
+        registered: set = set()
         for service, methods in {
             "Status": ["Ping", "Version", "Leader", "Peers"],
             "Node": ["Register", "Deregister", "UpdateStatus",
@@ -47,11 +59,32 @@ class Endpoints:
         }.items():
             for m in methods:
                 handler = getattr(self, f"{service.lower()}_{_snake(m)}")
-                rpc_server.register(f"{service}.{m}",
-                                    self._with_region(f"{service}.{m}",
-                                                      handler))
+                full = f"{service}.{m}"
+                if full in CONSISTENT_READS:
+                    handler = self._with_leader_reads(full, handler)
+                rpc_server.register(full,
+                                    self._with_region(full, handler))
+                registered.add(full)
+        # Guard against drift: a typo'd CONSISTENT_READS entry would
+        # silently leave that read follower-local.
+        missing = CONSISTENT_READS - registered
+        if missing:
+            raise RuntimeError(
+                f"CONSISTENT_READS names unregistered methods: {missing}")
 
     # -- plumbing ---------------------------------------------------------
+    def _with_leader_reads(self, method: str, handler):
+        """Default-consistent reads (reference nomad/rpc.go:175-185): a
+        follower forwards the query to the leader unless the caller set
+        ``stale`` — _forward already returns None for stale requests,
+        leaders, and already-forwarded hops."""
+        def routed(args: dict):
+            fwd = self._forward(method, args)
+            if fwd is not None:
+                return fwd
+            return handler(args)
+        return routed
+
     def _with_region(self, method: str, handler):
         """Region routing for EVERY endpoint, reads included (reference
         nomad/rpc.go:162-227 ``forward`` stage 1): a request addressed to

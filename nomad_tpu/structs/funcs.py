@@ -88,11 +88,12 @@ def score_fit_vec(util_cpu, util_mem, node_cpu, node_mem, *,
     pieces precomputed (``valid``/``safe_cpu``/``safe_mem``)."""
     import numpy as np
 
-    if valid is None:
+    given = (valid is not None, safe_cpu is not None, safe_mem is not None)
+    if not any(given):
         valid = (node_cpu > 0) & (node_mem > 0)
         safe_cpu = np.where(valid, node_cpu, 1.0)
         safe_mem = np.where(valid, node_mem, 1.0)
-    elif safe_cpu is None or safe_mem is None:
+    elif not all(given):
         raise TypeError("score_fit_vec: the precomputed kwargs are "
                         "all-or-nothing (valid + safe_cpu + safe_mem)")
     score = 20.0 - (

@@ -1,0 +1,118 @@
+"""Multi-region federation over gossip: WAN-style discovery and pruning.
+
+The reference federates regions through serf member tags — a server
+learns peer regions from gossip (nomad/serf.go, server.go:503-538) and
+`forwardRegion` routes RPCs by that table (nomad/rpc.go:206-227).  The
+unit tests in test_rpc.py wire the region table statically; these tests
+exercise the live path: servers in different regions joined through one
+gossip pool, the region table populated and pruned by join/fail events
+alone, and cross-region RPCs riding the discovered routes.
+"""
+from __future__ import annotations
+
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.server.rpc import ConnPool, RPCError
+
+
+def wait_until(fn, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+def _server(region: str, name: str) -> Server:
+    s = Server(ServerConfig(num_schedulers=1, enable_rpc=True,
+                            enable_gossip=True, region=region,
+                            server_name=name))
+    # Tighten SWIM timings so failure pruning converges in test time.
+    s.gossip.probe_interval = 0.05
+    s.gossip.probe_timeout = 0.05
+    s.gossip.suspect_timeout = 0.3
+    s.establish_leadership()
+    return s
+
+
+@pytest.fixture
+def pool():
+    p = ConnPool()
+    yield p
+    p.shutdown()
+
+
+def test_gossip_discovers_regions_and_forwards(pool):
+    a = _server("region-a", "a1")
+    b = _server("region-b", "b1")
+    try:
+        b.gossip.join(a.gossip.addr)
+        wait_until(lambda: a.regions() == ["region-a", "region-b"],
+                   msg="a discovers region-b")
+        wait_until(lambda: b.regions() == ["region-a", "region-b"],
+                   msg="b discovers region-a")
+
+        # Write addressed to region-b through region-a's server rides
+        # the gossip-discovered route.
+        node = mock.node()
+        pool.call(a.rpc_address(), "Node.Register",
+                  {"node": node.to_dict(), "region": "region-b"})
+        assert b.fsm.state.node_by_id(node.id) is not None
+        assert a.fsm.state.node_by_id(node.id) is None
+
+        # Cross-region read through the same discovered route.
+        out = pool.call(a.rpc_address(), "Node.GetNode",
+                        {"node_id": node.id, "region": "region-b"})
+        assert out["node"]["id"] == node.id
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_region_route_pruned_on_failure(pool):
+    a = _server("region-a", "a1")
+    b = _server("region-b", "b1")
+    try:
+        b.gossip.join(a.gossip.addr)
+        wait_until(lambda: "region-b" in a.regions(),
+                   msg="a discovers region-b")
+
+        # Crash region-b's server (no graceful leave): SWIM suspicion
+        # must prune the route.
+        b.gossip._stop.set()
+        b.gossip.sock.close()
+        wait_until(lambda: a.regions() == ["region-a"],
+                   msg="region-b pruned after failure")
+        with pytest.raises(RPCError, match="no path to region"):
+            pool.call(a.rpc_address(), "Node.Register",
+                      {"node": mock.node().to_dict(),
+                       "region": "region-b"})
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_three_region_transitive_discovery(pool):
+    """A third region joining any one member learns every region
+    transitively, and every server can route to every region."""
+    servers = [_server(f"region-{r}", f"{r}1") for r in ("a", "b", "c")]
+    try:
+        servers[1].gossip.join(servers[0].gossip.addr)
+        servers[2].gossip.join(servers[0].gossip.addr)
+        want = ["region-a", "region-b", "region-c"]
+        for s in servers:
+            wait_until(lambda s=s: s.regions() == want,
+                       msg=f"{s.config.server_name} sees all regions")
+        # c -> a route, never configured anywhere explicitly.
+        node = mock.node()
+        pool.call(servers[2].rpc_address(), "Node.Register",
+                  {"node": node.to_dict(), "region": "region-a"})
+        assert servers[0].fsm.state.node_by_id(node.id) is not None
+    finally:
+        for s in servers:
+            s.shutdown()

@@ -108,6 +108,44 @@ def test_follower_forwards_writes(pool):
             s.raft.shutdown()
 
 
+def test_stale_reads_serve_locally_on_follower(pool):
+    """A read with ``stale`` set is answered from the follower's own
+    snapshot — never forwarded (reference nomad/rpc.go forward +
+    structs.QueryOptions.AllowStale).  Non-stale follower reads forward
+    to the leader."""
+    servers = make_cluster(3)
+    try:
+        leader = wait_for_leader(servers)
+        node = mock.node()
+        leader.node_register(node)
+        follower = next(s for s in servers if not s.raft.is_leader())
+        wait_until(lambda: follower.fsm.state.node_by_id(node.id)
+                   is not None, msg="replication to follower")
+
+        # Any forward attempt from the follower must blow up loudly.
+        def boom(*a, **kw):
+            raise AssertionError("stale read was forwarded")
+        orig_call = follower.conn_pool.call
+        follower.conn_pool.call = boom
+        try:
+            out = pool.call(follower.rpc_address(), "Node.GetNode",
+                            {"node_id": node.id, "stale": True})
+            assert out["node"]["id"] == node.id
+            assert out["known_leader"] is True
+            # Without stale, the same read needs the leader: the
+            # sabotaged pool surfaces as an RPC error.
+            from nomad_tpu.server.rpc import RPCError
+            with pytest.raises(RPCError):
+                pool.call(follower.rpc_address(), "Node.GetNode",
+                          {"node_id": node.id})
+        finally:
+            follower.conn_pool.call = orig_call
+    finally:
+        for s in servers:
+            s.shutdown()
+            s.raft.shutdown()
+
+
 def test_leader_failover():
     servers = make_cluster(3)
     try:

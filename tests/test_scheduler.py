@@ -443,6 +443,25 @@ def test_limit_and_max_score():
     assert ms.next() is None
 
 
+def test_stack_limit_power_of_two_math():
+    """Candidates scanned per placement: max(2, ceil(log2 N)) for
+    service, always 2 for batch (reference stack.go:106-117,
+    power-of-two-choices)."""
+    from nomad_tpu.scheduler.stack import GenericStack
+
+    h, ctx = _ctx()
+    cases = [(1, 2), (2, 2), (3, 2), (4, 2), (5, 3), (100, 7),
+             (10_000, 14)]
+    svc = GenericStack(False, ctx)
+    for n, want in cases:
+        svc.set_nodes([mock.node(i) for i in range(n)])
+        assert svc.limit.limit == want, (n, svc.limit.limit, want)
+    batch = GenericStack(True, ctx)
+    for n, _ in cases:
+        batch.set_nodes([mock.node(i) for i in range(n)])
+        assert batch.limit.limit == 2
+
+
 def test_distinct_hosts_constraint():
     h = Harness()
     nodes = [mock.node(i) for i in range(3)]

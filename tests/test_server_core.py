@@ -452,6 +452,50 @@ class TestNodeLifecycle:
         finally:
             srv.shutdown()
 
+    def test_heartbeat_ttl_rate_scaled(self):
+        """TTL stretches with fleet size so aggregate heartbeat rate
+        stays under max_rate (reference heartbeat.go:37-72,
+        MaxHeartbeatsPerSecond=50)."""
+        from nomad_tpu.server.heartbeat import HeartbeatManager
+
+        hb = HeartbeatManager(server=None)
+        try:
+            # Small fleet: the 10s floor dominates (jitter adds <= 1/16).
+            ttl = hb.reset_heartbeat_timer("n-small")
+            assert 10.0 <= ttl <= 10.0 * (1 + 1 / 16)
+            # ~1000-node fleet: ttl >= n/50 (~20s), so at most 50
+            # heartbeats/s arrive in aggregate.  Seed the timer table
+            # with inert entries — the math only reads len().
+            class _Inert:
+                def cancel(self):
+                    pass
+            for i in range(1000):
+                hb._timers[f"n-{i}"] = _Inert()
+            base = hb.active() / hb.max_rate
+            ttl = hb.reset_heartbeat_timer("n-0")
+            assert base <= ttl <= base * (1 + 1 / 16)
+        finally:
+            hb.clear()
+
+    def test_failover_rearms_all_nodes_at_long_ttl(self):
+        """A new leader can't know when the last heartbeats happened, so
+        initialize() re-arms every live node at the failover TTL
+        (heartbeat.go:21-35)."""
+        srv = make_server()
+        try:
+            for i in range(3):
+                srv.node_register(mock.node(i))
+            down = mock.node(9)
+            srv.node_register(down)
+            srv.node_update_status(down.id, "down")
+            srv.heartbeats.clear()
+            assert srv.heartbeats.active() == 0
+            srv.heartbeats.initialize()
+            # Live nodes re-armed; the down node is not.
+            assert srv.heartbeats.active() == 3
+        finally:
+            srv.shutdown()
+
     def test_system_job_runs_everywhere(self):
         srv = make_server()
         try:
