@@ -239,10 +239,15 @@ class Client:
     def _watch_allocations(self) -> None:
         while not self._shutdown.is_set():
             try:
+                # Stale read (reference client.go:601-608 AllowStale):
+                # any server answers from local state, so alloc watching
+                # scales across followers and survives elections; the
+                # min_query_index long-poll still guarantees progress.
                 resp = self.rpc.call("Node.GetAllocs", {
                     "node_id": self.node.id,
                     "min_query_index": self._alloc_index,
                     "max_query_time": 5.0,
+                    "stale": True,
                 })
             except Exception:
                 logger.exception("client: alloc watch failed")

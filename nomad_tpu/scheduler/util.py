@@ -5,6 +5,7 @@ Capability parity with /root/reference/scheduler/util.go.
 from __future__ import annotations
 
 import random
+import threading
 from dataclasses import dataclass, field
 from types import MappingProxyType
 from typing import Callable, Mapping, Optional
@@ -213,7 +214,28 @@ def diff_system_allocs(job: Job, nodes: list, tainted_nodes: dict,
     return result
 
 
+# Ready-set memo: the scan below is O(fleet) and runs once per eval; its
+# result only changes when the nodes table changes.  Keyed on the store
+# generation's (lineage, nodes index) — lineage is identity-preserved
+# across snapshots/clones and replaced wholesale by snapshot restore, and
+# any node write (status, drain, register) bumps the nodes index, so a
+# hit is always current.  Bounded; callers get a fresh list (they
+# shuffle in place).  Locked: scheduler workers call this concurrently.
+_READY_CACHE: dict = {}
+_READY_CACHE_MAX = 16
+_READY_CACHE_LOCK = threading.Lock()
+
+
 def ready_nodes_in_dcs(state, datacenters: list) -> list:
+    tables = getattr(state, "_t", None)
+    key = None
+    if tables is not None:
+        key = (tables.lineage, tables.indexes["nodes"],
+               tuple(sorted(datacenters)))
+        with _READY_CACHE_LOCK:
+            hit = _READY_CACHE.get(key)
+            if hit is not None:
+                return list(hit)
     dc_set = set(datacenters)
     out = []
     for node in state.nodes():
@@ -224,6 +246,12 @@ def ready_nodes_in_dcs(state, datacenters: list) -> list:
         if node.datacenter not in dc_set:
             continue
         out.append(node)
+    if key is not None:
+        with _READY_CACHE_LOCK:
+            while len(_READY_CACHE) >= _READY_CACHE_MAX:
+                _READY_CACHE.pop(next(iter(_READY_CACHE)), None)
+            _READY_CACHE[key] = out
+        return list(out)
     return out
 
 

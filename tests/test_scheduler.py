@@ -542,6 +542,33 @@ def test_diff_system_allocs_marks_node():
     assert {t.alloc.node_id for t in d.place} == {n.id for n in nodes}
 
 
+def test_ready_nodes_memo_invalidates_on_node_change():
+    """ready_nodes_in_dcs memoizes per (lineage, nodes index): repeated
+    evals reuse the scan, any node write invalidates it, and callers get
+    a private list they may shuffle."""
+    from nomad_tpu.scheduler.util import ready_nodes_in_dcs
+
+    h = Harness()
+    for i in range(4):
+        h.state.upsert_node(h.next_index(), mock.node(i))
+    snap = h.state.snapshot()
+    a = ready_nodes_in_dcs(snap, ["dc1"])
+    b = ready_nodes_in_dcs(snap, ["dc1"])
+    assert len(a) == 4 and [n.id for n in a] == [n.id for n in b]
+    assert a is not b  # fresh list per caller
+    b.reverse()  # caller-side mutation must not poison the cache
+    assert [n.id for n in ready_nodes_in_dcs(snap, ["dc1"])] == \
+        [n.id for n in a]
+
+    # Draining a node bumps the nodes index: the memo must refresh.
+    victim = a[0].copy()
+    victim.drain = True
+    h.state.upsert_node(h.next_index(), victim)
+    c = ready_nodes_in_dcs(h.state.snapshot(), ["dc1"])
+    assert len(c) == 3
+    assert victim.id not in {n.id for n in c}
+
+
 def test_tainted_nodes():
     h = Harness()
     n = mock.node()

@@ -277,6 +277,87 @@ def make_server(**kw) -> Server:
     return srv
 
 
+class TestWorker:
+    def test_pause_holds_work_until_resume(self):
+        """A paused worker leaves ready evals on the broker; resuming
+        drains them (reference worker.go:77-93 — the leader pauses one
+        worker to reserve CPU for its own duties)."""
+        srv = Server(ServerConfig(num_schedulers=1))
+        srv.establish_leadership()
+        try:
+            srv.node_register(mock.node())
+            worker = srv.workers[0]
+            worker.set_pause(True)
+            time.sleep(0.1)  # let the loop reach the pause gate
+            job = mock.job()
+            _, eval_id = srv.job_register(job)
+            time.sleep(0.4)
+            ev = srv.fsm.state.eval_by_id(eval_id)
+            assert ev.status == "pending", "paused worker processed eval"
+            worker.set_pause(False)
+            srv.wait_for_evals([eval_id], timeout=10)
+            assert srv.fsm.state.eval_by_id(eval_id).status == "complete"
+        finally:
+            srv.shutdown()
+
+    def test_wait_for_index_times_out_on_lagging_fsm(self):
+        """An eval whose modify_index outruns the local FSM must not be
+        scheduled from a stale snapshot; past the sync limit the worker
+        gives up (reference worker.go:209-230)."""
+        from nomad_tpu.server.worker import Worker
+
+        srv = Server(ServerConfig(num_schedulers=0))
+        srv.establish_leadership()
+        try:
+            w = Worker(srv)
+            far_future = srv.raft.applied_index() + 1000
+            with pytest.raises(TimeoutError):
+                w._wait_for_index(far_future, timeout=0.2)
+            # An already-applied index returns immediately.
+            w._wait_for_index(srv.raft.applied_index(), timeout=0.2)
+        finally:
+            srv.shutdown()
+
+
+class TestPlanTokenFencing:
+    def test_stale_or_wrong_token_plans_rejected(self):
+        """The plan applier is the split-brain fence: a plan whose eval
+        token doesn't match the outstanding delivery — or whose eval is
+        no longer outstanding at all — must be refused before touching
+        state (reference plan_apply.go:53-65)."""
+        srv = Server(ServerConfig(num_schedulers=0))
+        srv.establish_leadership()
+        try:
+            srv.node_register(mock.node())
+            ev = make_eval()
+            srv.apply_eval_update([ev])
+            got, token = srv.eval_broker.dequeue(["service"], timeout=2)
+            assert got.id == ev.id
+
+            # Wrong token (another scheduler's claim): rejected.
+            plan = got.make_plan(None)
+            plan.eval_token = "not-the-token"
+            future = srv.plan_queue.enqueue(plan)
+            with pytest.raises(RuntimeError, match="token does not"):
+                future.wait(5.0)
+
+            # Right token while outstanding: accepted (empty plan).
+            plan2 = got.make_plan(None)
+            plan2.eval_token = token
+            result = srv.plan_queue.enqueue(plan2).wait(5.0)
+            assert result is not None
+
+            # After ack the eval is no longer outstanding: even the
+            # once-valid token is fenced out.
+            srv.eval_broker.ack(got.id, token)
+            plan3 = got.make_plan(None)
+            plan3.eval_token = token
+            with pytest.raises(RuntimeError, match="not outstanding"):
+                srv.plan_queue.enqueue(plan3).wait(5.0)
+        finally:
+            srv.shutdown()
+
+
 class TestServerEndToEnd:
     def test_job_register_schedules_allocs(self):
         srv = make_server()
