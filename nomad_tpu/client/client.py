@@ -253,8 +253,18 @@ class Client:
                 logger.exception("client: alloc watch failed")
                 self._shutdown.wait(1.0)
                 continue
-            self._alloc_index = max(self._alloc_index,
-                                    resp.get("index", 0))
+            index = resp.get("index", 0)
+            if index <= self._alloc_index:
+                # Timeout, or a stale server lagging behind state we
+                # already applied: never diff on it — a lagging
+                # follower's absence of a live alloc would destroy it
+                # (reference client.go:633-636 Index<=MinQueryIndex).
+                if index <= 0:
+                    # Pre-first-write table: back off instead of a hot
+                    # loop of immediate returns.
+                    self._shutdown.wait(0.2)
+                continue
+            self._alloc_index = index
             allocs = [Allocation.from_dict(a)
                       for a in resp.get("allocs", [])]
             self._run_allocs(allocs)

@@ -25,6 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from nomad_tpu.ops.binpack import _place_rounds, _place_sequence
 
 FLEET_AXIS = "fleet"
+LANE_AXIS = "lanes"
 
 
 def fleet_mesh(devices=None) -> Mesh:
@@ -34,11 +35,46 @@ def fleet_mesh(devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (FLEET_AXIS,))
 
 
+def storm_mesh(lane_ways: int, devices=None) -> Mesh:
+    """2-D mesh ``(lanes, fleet)``: storm lanes data-parallel across one
+    axis, the node axis sharded across the other.
+
+    This is the scheduler's DP x "context-parallel" layout: each
+    lane-axis slice holds a fleet replica serving B/lane_ways evals, so
+    storm throughput scales with lane_ways while per-device fleet memory
+    still shrinks by the fleet-axis factor.  With lane_ways=1 this is
+    fleet_mesh semantics on a 2-D mesh."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if lane_ways <= 0 or n % lane_ways:
+        raise ValueError(
+            f"lane_ways {lane_ways} must divide device count {n}")
+    grid = np.asarray(devices).reshape(lane_ways, n // lane_ways)
+    return Mesh(grid, (LANE_AXIS, FLEET_AXIS))
+
+
 def _shardings(mesh: Mesh):
     node = NamedSharding(mesh, P(FLEET_AXIS))          # [N, ...] row-sharded
     group_node = NamedSharding(mesh, P(None, FLEET_AXIS))  # [G, N]
     repl = NamedSharding(mesh, P())
     return node, group_node, repl
+
+
+def _batch_shardings(mesh: Mesh):
+    """Lane-axis-aware shardings for the storm layouts: on a 1-D fleet
+    mesh lanes are replicated work descriptors; on a 2-D storm_mesh the
+    leading (eval) axis shards over LANE_AXIS so independent evals run
+    data-parallel.  Fleet-static tensors use P(FLEET_AXIS) either way —
+    on the 2-D mesh that means replicated across lanes, sharded on
+    nodes, which is exactly the storm's sharing pattern."""
+    lane_ax = LANE_AXIS if LANE_AXIS in mesh.axis_names else None
+    node = NamedSharding(mesh, P(FLEET_AXIS))
+    lane_node = NamedSharding(mesh, P(lane_ax, None, FLEET_AXIS))  # [B,G,N]
+    lane_n = NamedSharding(mesh, P(lane_ax, FLEET_AXIS))           # [B,N]
+    lane = NamedSharding(mesh, P(lane_ax))
+    repl = NamedSharding(mesh, P())
+    return node, lane_node, lane_n, lane, repl
 
 
 def shard_fleet_arrays(mesh: Mesh, capacity, reserved, usage, job_counts,
@@ -129,14 +165,14 @@ def _place_rounds_batch_sharded_jit(capacity, reserved, usage0, jc0,
 def place_rounds_batch_sharded(mesh: Mesh, capacity, reserved, usage0, jc0,
                                feasible, asks, distinct, counts, penalty, *,
                                k_cap: int, rounds: int):
-    """Batched (one lane per eval) rounds placement, node axis sharded:
-    lanes are replicated work descriptors; the fleet slice each device
-    holds serves every lane (the eval-storm layout — B x G x N feasibility
-    sharded on N, base usage shared across lanes)."""
-    node, _, repl = _shardings(mesh)
-    lane_node = NamedSharding(mesh, P(None, None, FLEET_AXIS))  # [B, G, N]
-    lane_n = NamedSharding(mesh, P(None, FLEET_AXIS))           # [B, N]
-    lane = NamedSharding(mesh, P(None))
+    """Batched (one lane per eval) rounds placement, node axis sharded.
+
+    On a 1-D fleet mesh lanes are replicated work descriptors — every
+    device's fleet slice serves every lane.  On a 2-D ``storm_mesh``
+    the lane axis also shards, so independent evals run data-parallel
+    across mesh rows while each row's fleet slice stays HBM-resident
+    (B x G x N feasibility sharded on lanes + N, base usage shared)."""
+    node, lane_node, lane_n, lane, repl = _batch_shardings(mesh)
     capacity = jax.device_put(capacity, node)
     reserved = jax.device_put(reserved, node)
     usage0 = jax.device_put(usage0, node)
@@ -164,11 +200,10 @@ def _place_sequence_batch_sharded_jit(capacity, reserved, usage0, jc0,
 def place_sequence_batch_sharded(mesh: Mesh, capacity, reserved, usage0,
                                  jc0, feasible, asks, distinct, group_idx,
                                  valid, penalty):
-    """Batched placement scan (one lane per eval), node axis sharded."""
-    node, _, repl = _shardings(mesh)
-    lane_node = NamedSharding(mesh, P(None, None, FLEET_AXIS))
-    lane_n = NamedSharding(mesh, P(None, FLEET_AXIS))
-    lane = NamedSharding(mesh, P(None))
+    """Batched placement scan (one lane per eval), node axis sharded;
+    lane axis also shards on a 2-D ``storm_mesh`` (see
+    place_rounds_batch_sharded)."""
+    node, lane_node, lane_n, lane, repl = _batch_shardings(mesh)
     capacity = jax.device_put(capacity, node)
     reserved = jax.device_put(reserved, node)
     usage0 = jax.device_put(usage0, node)

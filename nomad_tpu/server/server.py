@@ -318,6 +318,10 @@ class Server:
     # -- setup ------------------------------------------------------------
     def _setup_workers(self) -> None:
         n = self.config.num_schedulers
+        if n <= 0:
+            # Leader-only server (and test rigs that drive the broker /
+            # plan queue by hand): no scheduling workers at all.
+            return
         if self.config.use_device_scheduler:
             import nomad_tpu.scheduler as sched_registry
 

@@ -167,3 +167,65 @@ def test_place_sequence_batch_sharded_parity():
 
     assert np.asarray(c).tolist() == np.asarray(ref_c).tolist()
     np.testing.assert_allclose(np.asarray(s), np.asarray(ref_s), rtol=1e-6)
+
+
+def test_storm_mesh_2d_lane_parallel_parity():
+    """2-D (lanes, fleet) mesh: lanes shard data-parallel across mesh
+    rows, fleet across columns — results identical to unsharded and to
+    the 1-D fleet mesh (storms scale across devices, not just memory)."""
+    from nomad_tpu.ops.binpack import place_rounds_batch
+    from nomad_tpu.parallel.mesh import (place_rounds_batch_sharded,
+                                         place_sequence_batch_sharded,
+                                         storm_mesh)
+    from nomad_tpu.ops.binpack import place_sequence_batch as _psb
+
+    fleet, view, feasible, asks, distinct, counts = _rounds_problem()
+    B = 4  # divisible by the 2-way lane axis
+    jc = np.broadcast_to(view.job_counts,
+                         (B,) + view.job_counts.shape).copy()
+    feas = np.broadcast_to(feasible, (B,) + feasible.shape).copy()
+    asks_b = np.broadcast_to(asks, (B,) + asks.shape).copy()
+    dist_b = np.broadcast_to(distinct, (B,) + distinct.shape).copy()
+    counts_b = np.broadcast_to(counts, (B,) + counts.shape).copy()
+    pen = np.full(B, 10.0, dtype=np.float32)
+    kw = dict(k_cap=32, rounds=1)
+
+    ref_c, ref_s, _ = place_rounds_batch(
+        fleet.capacity, fleet.reserved, view.usage, jc, feas, asks_b,
+        dist_b, counts_b, pen, **kw)
+    mesh2d = storm_mesh(2, jax.devices("cpu"))  # 2 lanes x 4 fleet
+    c, s, _ = place_rounds_batch_sharded(
+        mesh2d, fleet.capacity, fleet.reserved, view.usage, jc, feas,
+        asks_b, dist_b, counts_b, pen, **kw)
+    for b in range(B):
+        assert sorted(np.asarray(c)[b].ravel().tolist()) == \
+            sorted(np.asarray(ref_c)[b].ravel().tolist())
+    np.testing.assert_allclose(np.sort(np.asarray(s).ravel()),
+                               np.sort(np.asarray(ref_s).ravel()),
+                               rtol=1e-6)
+
+    # The scan variant on the same 2-D mesh.
+    fleet, view, feasible, asks, distinct, group_idx, valid = _problem()
+    jc = np.broadcast_to(view.job_counts,
+                         (B,) + view.job_counts.shape).copy()
+    feas = np.broadcast_to(feasible, (B,) + feasible.shape).copy()
+    asks_b = np.broadcast_to(asks, (B,) + asks.shape).copy()
+    dist_b = np.broadcast_to(distinct, (B,) + distinct.shape).copy()
+    gi = np.broadcast_to(group_idx, (B,) + group_idx.shape).copy()
+    va = np.broadcast_to(valid, (B,) + valid.shape).copy()
+    pen = np.full(B, 10.0, dtype=np.float32)
+    ref_c, ref_s, _ = _psb(
+        fleet.capacity, fleet.reserved, view.usage, jc, feas, asks_b,
+        dist_b, gi, va, pen)
+    c, s, _ = place_sequence_batch_sharded(
+        mesh2d, fleet.capacity, fleet.reserved, view.usage, jc, feas,
+        asks_b, dist_b, gi, va, pen)
+    assert np.asarray(c).tolist() == np.asarray(ref_c).tolist()
+    np.testing.assert_allclose(np.asarray(s), np.asarray(ref_s), rtol=1e-6)
+
+
+def test_storm_mesh_validates_lane_ways():
+    from nomad_tpu.parallel.mesh import storm_mesh
+
+    with pytest.raises(ValueError, match="must divide"):
+        storm_mesh(3, jax.devices("cpu"))  # 3 does not divide 8

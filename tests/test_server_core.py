@@ -288,7 +288,10 @@ class TestWorker:
             srv.node_register(mock.node())
             worker = srv.workers[0]
             worker.set_pause(True)
-            time.sleep(0.1)  # let the loop reach the pause gate
+            # Outwait an in-flight dequeue (0.25s timeout) started
+            # before the pause flag was set: the loop only re-checks
+            # the gate between iterations.
+            time.sleep(0.4)
             job = mock.job()
             _, eval_id = srv.job_register(job)
             time.sleep(0.4)
