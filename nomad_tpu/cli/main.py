@@ -382,6 +382,39 @@ def cmd_eval_monitor(args) -> int:
     return _monitor_eval(client, args.eval_id)
 
 
+def _dump_alloc_status(alloc, indent: str = "    ") -> None:
+    """Scheduling explainability for one allocation: filter/exhaustion
+    breakdown + scores (reference command/monitor.go dumpAllocStatus).
+    Shared by eval-monitor and alloc-status so AllocMetric has exactly
+    one renderer."""
+    m = alloc.metrics
+    if m is None:
+        print(f"{indent}Allocation {alloc.id[:8]} status "
+              f"{alloc.client_status!r}")
+        return
+    print(f"{indent}Allocation {alloc.id[:8]} status "
+          f"{alloc.client_status!r} "
+          f"({m.nodes_filtered}/{m.nodes_evaluated} nodes filtered)")
+    sub = indent + "  "
+    if m.nodes_evaluated == 0:
+        print(f"{sub}* No nodes were eligible for evaluation")
+    for cls, num in sorted((m.class_filtered or {}).items()):
+        print(f"{sub}* Class {cls!r} filtered {num} nodes")
+    for cons, num in sorted((m.constraint_filtered or {}).items()):
+        print(f"{sub}* Constraint {cons!r} filtered {num} nodes")
+    if m.nodes_exhausted:
+        print(f"{sub}* Resources exhausted on {m.nodes_exhausted} nodes")
+    for cls, num in sorted((m.class_exhausted or {}).items()):
+        print(f"{sub}* Class {cls!r} exhausted on {num} nodes")
+    for dim, num in sorted((m.dimension_exhausted or {}).items()):
+        print(f"{sub}* Dimension {dim!r} exhausted on {num} nodes")
+    if m.coalesced_failures:
+        print(f"{sub}* {m.coalesced_failures} additional placements "
+              f"failed the same way")
+    for name, score in sorted((m.scores or {}).items()):
+        print(f"{sub}* Score {name!r} = {score:.3f}")
+
+
 def _monitor_eval(client: APIClient, eval_id: str,
                   timeout: float = 60.0) -> int:
     """Poll an eval until terminal, then report its allocations
@@ -398,10 +431,16 @@ def _monitor_eval(client: APIClient, eval_id: str,
                   f"{ev.status_description}")
             allocs, _ = client.eval_allocations(eval_id)
             for a in allocs:
-                where = f"on node {a.node_id[:8]}" if a.node_id else \
-                    "unplaced"
-                print(f"    Allocation {a.id[:8]} {where} "
-                      f"({a.desired_status})")
+                if a.desired_status == "failed":
+                    # Scheduling failure: the dump carries the header
+                    # AND the why (reference monitor.go:220-228 +
+                    # dumpAllocStatus).
+                    _dump_alloc_status(a)
+                else:
+                    where = f"on node {a.node_id[:8]}" if a.node_id \
+                        else "unplaced"
+                    print(f"    Allocation {a.id[:8]} {where} "
+                          f"({a.desired_status})")
             if ev.next_eval:
                 print(f"    Followup eval: {ev.next_eval}")
             return 0 if ev.status == "complete" else 2
@@ -420,13 +459,8 @@ def cmd_alloc_status(args) -> int:
     print(f"Desired    = {alloc.desired_status}")
     print(f"Client     = {alloc.client_status}")
     if alloc.metrics:
-        m = alloc.metrics
         print(f"\nPlacement metrics:")
-        print(f"  Nodes evaluated = {m.nodes_evaluated}")
-        print(f"  Nodes filtered  = {m.nodes_filtered}")
-        print(f"  Nodes exhausted = {m.nodes_exhausted}")
-        for key, score in sorted(m.scores.items()):
-            print(f"  Score {key} = {score:.3f}")
+        _dump_alloc_status(alloc, indent="  ")
     return 0
 
 

@@ -836,6 +836,9 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
                 uuids, slots_c, alloc_proto, metric_proto,
                 coalesce_all=1)  # generic TG placements interchangeable
             failed_tg.update(fmap)
+            native_failed = fmap
+        else:
+            native_failed = None
 
         for p in range(start_p, len(place)):
             missing = place[p]
@@ -864,16 +867,29 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
                     task_resources = self._assign_networks(option_node, tg)
                 if task_resources is None:
                     option_node = None
-            if option_node is None and from_device:
-                # Device over-approximation admitted a node the exact
-                # host accounting rejects: sequential fallback.
-                usage_diverged = True
+            if option_node is None:
+                # Sequential fallback, two jobs in one: when the device
+                # picked a node the exact host accounting rejects
+                # (over-approximation divergence) it re-selects; when
+                # the device found NO candidate it produces the
+                # reference's failure explanation — the stack chain
+                # fills ctx metrics with per-constraint/class/dimension
+                # filter and exhaustion counts (monitor.go
+                # dumpAllocStatus is downstream of this data).
+                if from_device:
+                    # Device usage accounting included a placement the
+                    # plan won't make: re-verify later winners exactly.
+                    usage_diverged = True
                 if fallback_nodes is None:
                     fallback_nodes = ready_nodes_in_dcs(
                         self.state, self.job.datacenters)
                 self.stack.set_nodes(list(fallback_nodes))
                 ranked, size = self.stack.select(tg)
                 if ranked is not None:
+                    if not from_device:
+                        # Host placed what the device didn't: diverged
+                        # in the other direction.
+                        usage_diverged = True
                     option_node = ranked.node
                     task_resources = ranked.task_resources
                     # The fallback assigned ports outside our per-node
@@ -883,11 +899,9 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
                         statics.index_of.get(option_node.id), None)
                 # stack.select populated fresh ctx metrics (incl. scores).
                 metrics = self.ctx.metrics()
-            elif option_node is not None:
+            else:
                 metrics = fast_metric(option_node.id + ".binpack",
                                       scores_l[p])
-            else:
-                metrics = fast_metric()
 
             alloc = Allocation.__new__(Allocation)
             d = dict(alloc_proto)
@@ -913,6 +927,38 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
                 alloc.__dict__ = d
                 plan.append_failed(alloc)
                 failed_tg[id(tg)] = alloc
+
+        if native_failed:
+            # The C prefix builds failed allocs with proto metrics so the
+            # happy path never slows down; upgrade them AFTER the loop to
+            # the full sequential explanation (constraint/class/dimension
+            # filter + exhaustion counts — the same data the Python
+            # failure branch records, and what monitor.go dumpAllocStatus
+            # renders).  Coalesced counts accumulated in C carry over.
+            tg_by_key = {}
+            for missing in place:
+                key = id(missing.task_group)
+                if key not in tg_by_key:
+                    tg_by_key[key] = missing.task_group
+            if fallback_nodes is None:
+                fallback_nodes = ready_nodes_in_dcs(
+                    self.state, self.job.datacenters)
+            for key, failed in native_failed.items():
+                tg2 = tg_by_key.get(key)
+                if tg2 is None:
+                    continue
+                self.stack.set_nodes(list(fallback_nodes))
+                ranked, _size = self.stack.select(tg2)
+                if ranked is not None:
+                    # Exact chain disagrees with the device mask (should
+                    # not happen — the mask over-approximates): keep the
+                    # shallow metric rather than invent a placement.
+                    continue
+                explained = self.ctx.metrics()
+                explained.coalesced_failures = \
+                    failed.metrics.coalesced_failures
+                explained.allocation_time = failed.metrics.allocation_time
+                failed.metrics = explained
 
 
 def rounds_to_placements(args: DeviceArgs, chosen_slots: np.ndarray,

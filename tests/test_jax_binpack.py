@@ -657,3 +657,38 @@ class TestTopkExact:
         vals = rng.random(16384).astype(np.float32)
         assert np.array_equal(_topk_exact(vals, 1024),
                               self._ref(vals, 1024))
+
+
+def test_jax_scheduler_failures_carry_explanations():
+    """Device-path failures must carry the reference's AllocMetric
+    explanation — constraint filter counts when no node matches,
+    dimension exhaustion counts when resources run out (monitor.go
+    dumpAllocStatus is downstream of this data)."""
+    # 1) Constraint nobody satisfies: constraint_filtered populated.
+    h = Harness()
+    _register_cluster(h, 3)
+    job = mock.job()
+    job.task_groups[0].constraints = [
+        Constraint(hard=True, l_target="$attr.kernel.name",
+                   r_target="plan9", operand="=")]
+    h.state.upsert_job(h.next_index(), job)
+    h.process("jax-binpack", make_eval(job))
+    plan = h.plans[0]
+    assert plan.failed_allocs
+    m = plan.failed_allocs[0].metrics
+    assert m.nodes_evaluated >= 3
+    assert sum(m.constraint_filtered.values()) >= 3, m.constraint_filtered
+
+    # 2) Resource exhaustion: dimension_exhausted populated.
+    h2 = Harness()
+    _register_cluster(h2, 2)
+    job2 = mock.job()
+    job2.task_groups[0].count = 4
+    job2.task_groups[0].tasks[0].resources.cpu = 3000
+    h2.state.upsert_job(h2.next_index(), job2)
+    h2.process("jax-binpack", make_eval(job2))
+    plan2 = h2.plans[0]
+    assert plan2.failed_allocs
+    m2 = plan2.failed_allocs[0].metrics
+    assert m2.nodes_exhausted >= 1 or m2.dimension_exhausted, \
+        (m2.nodes_exhausted, m2.dimension_exhausted)
