@@ -417,35 +417,48 @@ def _dump_alloc_status(alloc, indent: str = "    ") -> None:
 
 def _monitor_eval(client: APIClient, eval_id: str,
                   timeout: float = 60.0) -> int:
-    """Poll an eval until terminal, then report its allocations
-    (reference command/monitor.go)."""
-    print(f"==> Monitoring evaluation \"{eval_id[:8]}\"")
-    deadline = time.monotonic() + timeout
-    index = 0
-    while time.monotonic() < deadline:
-        ev, meta = client.eval_info(eval_id, QueryOptions(
-            wait_index=index, wait_time=2.0))
-        index = meta.last_index
-        if ev.status in ("complete", "failed"):
-            print(f"    Evaluation status: {ev.status} "
-                  f"{ev.status_description}")
-            allocs, _ = client.eval_allocations(eval_id)
-            for a in allocs:
-                if a.desired_status == "failed":
-                    # Scheduling failure: the dump carries the header
-                    # AND the why (reference monitor.go:220-228 +
-                    # dumpAllocStatus).
-                    _dump_alloc_status(a)
-                else:
-                    where = f"on node {a.node_id[:8]}" if a.node_id \
-                        else "unplaced"
-                    print(f"    Allocation {a.id[:8]} {where} "
-                          f"({a.desired_status})")
-            if ev.next_eval:
-                print(f"    Followup eval: {ev.next_eval}")
-            return 0 if ev.status == "complete" else 2
-    print("    Monitor timed out", file=sys.stderr)
-    return 1
+    """Poll an eval until terminal, then report its allocations;
+    follows rolling-update eval chains, with ``timeout`` bounding each
+    eval in the chain (reference command/monitor.go)."""
+    while True:
+        print(f"==> Monitoring evaluation \"{eval_id[:8]}\"")
+        deadline = time.monotonic() + timeout
+        index = 0
+        ev = None
+        while time.monotonic() < deadline:
+            ev, meta = client.eval_info(eval_id, QueryOptions(
+                wait_index=index, wait_time=2.0))
+            index = meta.last_index
+            if ev.status in ("complete", "failed"):
+                break
+            ev = None
+        if ev is None:
+            print("    Monitor timed out", file=sys.stderr)
+            return 1
+        print(f"    Evaluation status: {ev.status} "
+              f"{ev.status_description}")
+        allocs, _ = client.eval_allocations(eval_id)
+        for a in allocs:
+            if a.desired_status == "failed":
+                # Scheduling failure: the dump carries the header AND
+                # the why (reference monitor.go:220-228 +
+                # dumpAllocStatus).
+                _dump_alloc_status(a)
+            else:
+                where = f"on node {a.node_id[:8]}" if a.node_id \
+                    else "unplaced"
+                print(f"    Allocation {a.id[:8]} {where} "
+                      f"({a.desired_status})")
+        if ev.next_eval:
+            # Rolling update: follow the chain like the reference
+            # monitor (monitor.go:244-253), sleeping out the full
+            # stagger before polling the held eval.
+            print(f"==> Monitoring next evaluation "
+                  f"\"{ev.next_eval[:8]}\" in {ev.wait:.0f}s")
+            time.sleep(ev.wait)
+            eval_id = ev.next_eval
+            continue
+        return 0 if ev.status == "complete" else 2
 
 
 def cmd_alloc_status(args) -> int:

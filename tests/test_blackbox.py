@@ -191,3 +191,119 @@ def test_blackbox_job_lifecycle(agent_proc):
     out = proc.stdout.read()
     assert "shutting down" in out
     assert "metrics snapshot" in out
+
+
+def test_blackbox_agent_kill9_reattach(tmp_path):
+    """Checkpoint/resume across a real process boundary: SIGKILL the
+    client agent mid-run, restart it on the same state dir, and the
+    task PROCESS must survive and be re-attached — not restarted
+    (reference client restore, task_runner.go:92-105; SURVEY §5)."""
+    server = client = client2 = None
+    pid = None
+    pid_job = {"job": {
+        "id": "pidjob", "name": "pidjob", "type": "service",
+        "datacenters": ["dc1"],
+        "task_groups": [{
+            "name": "tg", "count": 1,
+            "tasks": [{"name": "pidtask", "driver": "raw_exec",
+                       "config": {
+                           "command": "/bin/sh",
+                           "args": "-c 'echo $$ > \"$NOMAD_TASK_DIR/pid\";"
+                                   " exec sleep 300'"},
+                       "resources": {"cpu": 20, "memory_mb": 16}}]}]}}
+
+    def wait_for(fn, msg, timeout=45):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if fn():
+                return
+            time.sleep(0.3)
+        raise AssertionError(f"timeout: {msg}")
+
+    def alive(pid: int) -> bool:
+        try:
+            os.kill(pid, 0)
+            return True
+        except ProcessLookupError:
+            return False
+
+    try:
+        server, server_base, server_rpc = _spawn_agent(
+            tmp_path, "srv", "-server")
+        _wait_http(server, server_base)
+        cli_cfg = tmp_path / "client.hcl"
+        cli_cfg.write_text(
+            'client {\n'
+            '  options {\n'
+            '    "driver.raw_exec.enable" = "1"\n'
+            '    "fingerprint.skip_accel" = "1"\n'
+            '  }\n'
+            '}\n')
+        spawn_client = lambda: _spawn_agent(
+            tmp_path, "cli", "-client",
+            "-servers", f"127.0.0.1:{server_rpc}",
+            "-config", str(cli_cfg))
+        client, client_base, _ = spawn_client()
+        _wait_http(client, client_base)
+        wait_for(lambda: any(
+            n["status"] == "ready"
+            for n in _http("GET", server_base + "/v1/nodes")),
+            "client node ready")
+
+        _http("PUT", server_base + "/v1/jobs", pid_job)
+        wait_for(lambda: any(
+            a["client_status"] == "running"
+            for a in _http("GET",
+                           server_base + "/v1/job/pidjob/allocations")),
+            "alloc running")
+
+        # The task wrote its own pid into its task dir.
+        import glob
+
+        def read_pid():
+            nonlocal pid
+            for path in glob.glob(str(tmp_path / "data-cli" / "**" /
+                                      "pid"), recursive=True):
+                content = open(path).read().strip()
+                if content:
+                    pid = int(content)
+                    return True
+            return False
+        wait_for(read_pid, "task pid file")
+        assert alive(pid)
+
+        # Hard-kill the agent: the task (own session) must survive.
+        client.kill()
+        client.wait(10)
+        assert alive(pid), "task died with the agent"
+
+        # Restart on the same state dir: re-attach, don't restart.
+        client2, client2_base, _ = spawn_client()
+        _wait_http(client2, client2_base)
+        wait_for(lambda: _http(
+            "GET", client2_base + "/v1/agent/self"
+        )["stats"]["client"]["allocs"] >= 1, "restored alloc", timeout=60)
+        assert alive(pid), "task was restarted, not re-attached"
+        wait_for(lambda: any(
+            a["client_status"] == "running"
+            for a in _http("GET",
+                           server_base + "/v1/job/pidjob/allocations")),
+            "alloc still running after restart")
+
+        # Stopping the job through the restarted agent kills the
+        # re-attached process — proving the new handle controls it.
+        _http("DELETE", server_base + "/v1/job/pidjob")
+        wait_for(lambda: not alive(pid), "re-attached task killed")
+    finally:
+        # The task detaches into its own session (start_new_session), so
+        # killing the agents cannot reap it: kill it directly if the
+        # test bailed before the job delete.
+        if pid is not None and alive(pid):
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        for proc in (client2, client, server):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(10)
