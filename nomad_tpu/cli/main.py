@@ -451,11 +451,14 @@ def _monitor_eval(client: APIClient, eval_id: str,
                       f"({a.desired_status})")
         if ev.next_eval:
             # Rolling update: follow the chain like the reference
-            # monitor (monitor.go:244-253), sleeping out the full
-            # stagger before polling the held eval.
+            # monitor (monitor.go:244-253).  The stagger lives on the
+            # NEXT eval (next_rolling_eval sets its ``wait``; the
+            # broker holds it that long), so fetch it and sleep that
+            # out before the per-eval poll deadline starts.
+            nxt, _ = client.eval_info(ev.next_eval)
             print(f"==> Monitoring next evaluation "
-                  f"\"{ev.next_eval[:8]}\" in {ev.wait:.0f}s")
-            time.sleep(ev.wait)
+                  f"\"{ev.next_eval[:8]}\" in {nxt.wait:.0f}s")
+            time.sleep(nxt.wait)
             eval_id = ev.next_eval
             continue
         return 0 if ev.status == "complete" else 2

@@ -31,6 +31,61 @@ from .interfaces import SetStatusError
 from .jax_binpack import JaxBinPackScheduler, fetch_results
 from .util import set_status
 
+# Fused-dispatch mesh, resolved once per process: None on a single
+# device; otherwise the largest power-of-two device subset, shaped
+# (lanes, fleet) per dispatch by _mesh_for.  Multi-chip agents get the
+# storm layout automatically — lanes data-parallel, node axis sharded —
+# with no configuration (parallel/mesh.py; single-chip dispatch is
+# untouched).
+_MESH_CACHE: dict = {}
+
+
+def _mesh_for(n_lanes: int, n_pad: int):
+    """Mesh for a fused dispatch of ``n_lanes`` evals over an
+    ``n_pad``-wide (power-of-two padded) node axis, or None when one
+    device (or a lane/device shape that cannot split) makes the plain
+    jit the right call.  Lane ways = largest power of two dividing
+    n_lanes, capped at half the devices so the fleet axis keeps width;
+    remaining devices shard the node axis, capped at n_pad so the
+    sharding always divides it."""
+    import jax
+
+    # Devices of the platform the runtime actually computes on: when a
+    # default device is pinned (tests pin cpu:0 while the environment
+    # also registers a remote TPU backend), the mesh must live on that
+    # platform, not on whichever backend jax.devices() favors.  The
+    # config value may be a Device or a platform-name string.
+    default = jax.config.jax_default_device
+    if default is None:
+        all_devices = jax.devices()
+    else:
+        platform = getattr(default, "platform", None) or \
+            str(default).split(":")[0]
+        all_devices = jax.devices(platform)
+    n_dev = len(all_devices)
+    if n_dev < 2:
+        return None
+    n = 1 << (n_dev.bit_length() - 1)  # power-of-two subset
+    lane_ways = 1
+    while lane_ways * 2 <= min(n // 2, n_lanes) and \
+            n_lanes % (lane_ways * 2) == 0:
+        lane_ways *= 2
+    # Fleet ways must divide the padded node axis (both powers of two,
+    # so <= suffices); tiny fleets on big hosts use fewer devices.
+    n = min(n, lane_ways * max(1, n_pad))
+    if n < 2:
+        return None
+    key = (n, lane_ways)
+    mesh = _MESH_CACHE.get(key)
+    if mesh is None:
+        from nomad_tpu.parallel.mesh import fleet_mesh, storm_mesh
+
+        devices = all_devices[:n]
+        mesh = storm_mesh(lane_ways, devices) if lane_ways > 1 \
+            else fleet_mesh(devices)
+        _MESH_CACHE[key] = mesh
+    return mesh
+
 
 class BatchEvalRunner:
     """Fuses a batch of evaluations into one device dispatch.
@@ -170,13 +225,24 @@ class BatchEvalRunner:
         if rounds_ok:
             # Fast path: top-k rounds — device steps scale with unique
             # groups x rounds, not with placements.
-            from nomad_tpu.ops.binpack import place_rounds_batch
             from .jax_binpack import rounds_to_placements
 
-            chosen_s, score_s, _u = place_rounds_batch(
-                capacity_d, reserved_d, base_usage, job_counts, feasible,
-                asks, distinct, counts, penalty, k_cap=k_cap,
-                rounds=rounds)
+            mesh = _mesh_for(B, statics.n_pad)
+            if mesh is not None:
+                from nomad_tpu.parallel.mesh import \
+                    place_rounds_batch_sharded
+
+                chosen_s, score_s, _u = place_rounds_batch_sharded(
+                    mesh, capacity_d, reserved_d, base_usage, job_counts,
+                    feasible, asks, distinct, counts, penalty,
+                    k_cap=k_cap, rounds=rounds)
+            else:
+                from nomad_tpu.ops.binpack import place_rounds_batch
+
+                chosen_s, score_s, _u = place_rounds_batch(
+                    capacity_d, reserved_d, base_usage, job_counts,
+                    feasible, asks, distinct, counts, penalty,
+                    k_cap=k_cap, rounds=rounds)
             chosen_s, score_s = fetch_results(chosen_s, score_s)
             for b, (sched, place, args) in enumerate(pending):
                 chosen, scores = rounds_to_placements(
@@ -184,9 +250,18 @@ class BatchEvalRunner:
                 sched.finish_deferred(place, args, chosen, scores)
                 self._finish(sched)
         else:
-            chosen, scores, _usage = place_sequence_batch(
-                capacity_d, reserved_d, base_usage, job_counts, feasible,
-                asks, distinct, group_idx, valid, penalty)
+            mesh = _mesh_for(B, statics.n_pad)
+            if mesh is not None:
+                from nomad_tpu.parallel.mesh import \
+                    place_sequence_batch_sharded
+
+                chosen, scores, _usage = place_sequence_batch_sharded(
+                    mesh, capacity_d, reserved_d, base_usage, job_counts,
+                    feasible, asks, distinct, group_idx, valid, penalty)
+            else:
+                chosen, scores, _usage = place_sequence_batch(
+                    capacity_d, reserved_d, base_usage, job_counts,
+                    feasible, asks, distinct, group_idx, valid, penalty)
             chosen, scores = fetch_results(chosen, scores)
             for b, (sched, place, args) in enumerate(pending):
                 sched.finish_deferred(place, args, chosen[b], scores[b])

@@ -151,3 +151,54 @@ def test_batch_runner_same_job_without_refresh_fails_safe():
     live = [a for a in h.state.allocs_by_job(job.id)
             if not a.terminal_status()]
     assert len(live) == 2
+
+
+def test_fused_dispatch_rides_the_mesh_on_multi_device(monkeypatch):
+    """On a multi-device host the fused dispatch routes through the
+    mesh-sharded kernels (storm layout when the lane count splits), and
+    the plans match a single-device run lane for lane."""
+    import nomad_tpu.scheduler.batch as batch_mod
+
+    def build(runner_patch=None):
+        h = Harness()
+        for i in range(16):
+            h.state.upsert_node(h.next_index(), mock.node(i))
+        jobs = []
+        for _ in range(4):
+            j = mock.job()
+            j.task_groups[0].count = 4
+            h.state.upsert_job(h.next_index(), j)
+            jobs.append(j)
+        return h, jobs
+
+    # Force the device executor (the tiny fleet would otherwise take
+    # the host twins) and record which mesh the dispatch used.
+    from nomad_tpu.scheduler.jax_binpack import JaxBinPackScheduler
+
+    monkeypatch.setattr(JaxBinPackScheduler, "HOST_SINGLE_SHOT_COST", 0)
+    used = []
+    orig = batch_mod._mesh_for
+
+    def spy(n_lanes, n_pad):
+        mesh = orig(n_lanes, n_pad)
+        used.append(mesh)
+        return mesh
+    monkeypatch.setattr(batch_mod, "_mesh_for", spy)
+
+    h, jobs = build()
+    BatchEvalRunner(h.state.snapshot(), h).process(
+        [make_eval(j) for j in jobs])
+    assert used and used[-1] is not None, "mesh not used on 8 devices"
+    assert "lanes" in used[-1].axis_names  # storm layout chosen
+    mesh_counts = [sum(len(v) for v in p.node_allocation.values())
+                   for p in h.plans]
+
+    # Same workload forced down the single-device path.
+    monkeypatch.setattr(batch_mod, "_mesh_for", lambda n, p: None)
+    h2, jobs2 = build()
+    BatchEvalRunner(h2.state.snapshot(), h2).process(
+        [make_eval(j) for j in jobs2])
+    single_counts = [sum(len(v) for v in p.node_allocation.values())
+                     for p in h2.plans]
+    assert mesh_counts == single_counts == [4, 4, 4, 4]
+    assert all(e.status == "complete" for e in h.evals)

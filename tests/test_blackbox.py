@@ -88,6 +88,15 @@ def _wait_http(proc, base, timeout=60):
     raise AssertionError("agent never served HTTP")
 
 
+def wait_for(fn, msg, timeout=45):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.3)
+    raise AssertionError(f"timeout: {msg}")
+
+
 def test_blackbox_two_process_cluster(tmp_path):
     """A server-only agent and a client-only agent as separate OS
     processes: registration, heartbeats, long-poll alloc delivery, and
@@ -110,14 +119,6 @@ def test_blackbox_two_process_cluster(tmp_path):
             "-servers", f"127.0.0.1:{server_rpc}",
             "-config", str(cli_cfg))
         _wait_http(client, client_base)
-
-        def wait_for(fn, msg, timeout=45):
-            deadline = time.monotonic() + timeout
-            while time.monotonic() < deadline:
-                if fn():
-                    return
-                time.sleep(0.3)
-            raise AssertionError(f"timeout: {msg}")
 
         # Client node registers with the server over real RPC.
         wait_for(lambda: any(
@@ -153,14 +154,6 @@ def test_blackbox_job_lifecycle(agent_proc):
     proc, base = agent_proc
     resp = _http("PUT", base + "/v1/jobs", JOB)
     eval_id = resp["eval_id"]
-
-    def wait_for(fn, msg, timeout=30):
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            if fn():
-                return
-            time.sleep(0.2)
-        raise AssertionError(f"timeout: {msg}")
 
     wait_for(lambda: _http(
         "GET", f"{base}/v1/evaluation/{eval_id}")["status"] == "complete",
@@ -211,14 +204,6 @@ def test_blackbox_agent_kill9_reattach(tmp_path):
                            "args": "-c 'echo $$ > \"$NOMAD_TASK_DIR/pid\";"
                                    " exec sleep 300'"},
                        "resources": {"cpu": 20, "memory_mb": 16}}]}]}}
-
-    def wait_for(fn, msg, timeout=45):
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            if fn():
-                return
-            time.sleep(0.3)
-        raise AssertionError(f"timeout: {msg}")
 
     def alive(pid: int) -> bool:
         try:
