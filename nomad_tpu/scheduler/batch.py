@@ -75,7 +75,7 @@ def _mesh_for(n_lanes: int, n_pad: int):
     n = min(n, lane_ways * max(1, n_pad))
     if n < 2:
         return None
-    key = (n, lane_ways)
+    key = (all_devices[0].platform, n, lane_ways)
     mesh = _MESH_CACHE.get(key)
     if mesh is None:
         from nomad_tpu.parallel.mesh import fleet_mesh, storm_mesh
@@ -222,12 +222,12 @@ class BatchEvalRunner:
         # first lane's view carries one (no upload).
         base_usage = pending[0][2].view.dispatch_usage()
 
+        mesh = _mesh_for(B, statics.n_pad)
         if rounds_ok:
             # Fast path: top-k rounds — device steps scale with unique
             # groups x rounds, not with placements.
             from .jax_binpack import rounds_to_placements
 
-            mesh = _mesh_for(B, statics.n_pad)
             if mesh is not None:
                 from nomad_tpu.parallel.mesh import \
                     place_rounds_batch_sharded
@@ -250,7 +250,6 @@ class BatchEvalRunner:
                 sched.finish_deferred(place, args, chosen, scores)
                 self._finish(sched)
         else:
-            mesh = _mesh_for(B, statics.n_pad)
             if mesh is not None:
                 from nomad_tpu.parallel.mesh import \
                     place_sequence_batch_sharded

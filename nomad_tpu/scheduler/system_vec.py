@@ -238,6 +238,10 @@ class VectorSystemScheduler(SystemScheduler, FastPlacementMixin):
         alloc_proto = dict(_ALLOC_STATIC, eval_id=self.eval.id,
                            job_id=job.id, job=job)
         failed_tg: dict = {}
+        # TG ids whose recorded failure came from the device mask
+        # (chosen < 0) — the only failures _explain_failures may
+        # re-narrate; network-assign failures keep their own story.
+        mask_rejected: set = set()
         chosen_l = chosen.tolist()
         scores_l = scores.tolist()
 
@@ -256,6 +260,9 @@ class VectorSystemScheduler(SystemScheduler, FastPlacementMixin):
                 uuids, slots_c, alloc_proto, metric_proto,
                 coalesce_all=0)  # node-pinned: coalesce chosen-less only
             failed_tg.update(fmap)
+            # Native fmap entries are created only for chosen-less
+            # placements (coalesce_all=0 semantics).
+            mask_rejected.update(fmap.keys())
             for failed in fmap.values():
                 failed.metrics.nodes_filtered = 1
 
@@ -320,6 +327,51 @@ class VectorSystemScheduler(SystemScheduler, FastPlacementMixin):
                 alloc.__dict__ = d
                 plan.append_failed(alloc)
                 failed_tg[id(tg)] = alloc
+                if ni < 0:
+                    mask_rejected.add(id(tg))
+
+        self._explain_failures(mask_rejected, failed_tg, place, chosen_l,
+                               nodes_arr, statics)
+
+    def _explain_failures(self, mask_rejected, failed_tg, place, chosen_l,
+                          nodes_arr, statics) -> None:
+        """Upgrade each task group's first mask-rejected placement to
+        the sequential chain's explanation.  System placements are
+        node-pinned, so the failure story is that node's
+        constraint/fit verdict — run the stack against just that node
+        and take its ctx metrics (what the reference system scheduler
+        records per failed alloc; later failures stay coalesced onto
+        this one).  Only allocs whose ORIGINAL failure was the device
+        mask qualify (``mask_rejected``) — a network-assign failure on
+        a chosen node keeps its own story."""
+        if not failed_tg:
+            return
+        index_of = statics.index_of
+        pending = {k: v for k, v in failed_tg.items()
+                   if k in mask_rejected}
+        for p, missing in enumerate(place):
+            if not pending:
+                break
+            if chosen_l[p] >= 0:
+                continue
+            failed = pending.pop(id(missing.task_group), None)
+            if failed is None:
+                continue
+            ni = index_of.get(missing.alloc.node_id, -1)
+            if ni < 0:
+                continue
+            self.stack.set_nodes([nodes_arr[ni]])
+            option, _size = self.stack.select(missing.task_group)
+            if option is not None:
+                # Exact chain would place here (mask over-approximation
+                # disagreement): keep the shallow metric rather than
+                # invent a story.
+                continue
+            explained = self.ctx.metrics()
+            explained.coalesced_failures = \
+                failed.metrics.coalesced_failures
+            explained.allocation_time = failed.metrics.allocation_time
+            failed.metrics = explained
 
 
 def new_vector_system_scheduler(state, planner) -> VectorSystemScheduler:

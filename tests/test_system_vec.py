@@ -191,3 +191,22 @@ def test_system_parity_count_gt_one_tight_node():
                   if a.node_id == node_id]
         fit, _dim, _util = allocs_fit(node, allocs)
         assert fit, f"oversubscribed node {node_id}: {placed}"
+
+
+def test_system_vec_failures_carry_explanations():
+    """A mask-rejected system placement's failed alloc carries the
+    node's actual constraint verdict, same as the sequential chain
+    (the vectorized path patches the first failure per task group)."""
+    def job_fn():
+        j = mock.system_job()
+        j.task_groups[0].constraints = [
+            Constraint(hard=True, l_target="$attr.kernel.name",
+                       r_target="plan9", operand="=")]
+        return j
+
+    (h_vec, plan_vec), (h_seq, plan_seq) = run_both(4, job_fn)
+    for plan in (plan_vec, plan_seq):
+        assert plan.failed_allocs
+        m = plan.failed_allocs[0].metrics
+        assert sum(m.constraint_filtered.values()) >= 1, (
+            m.constraint_filtered)
