@@ -368,6 +368,13 @@ class VectorSystemScheduler(SystemScheduler, FastPlacementMixin):
                 # invent a story.
                 continue
             explained = self.ctx.metrics()
+            if not (explained.constraint_filtered or
+                    explained.class_filtered):
+                # Only constraint/class verdicts are usage-independent;
+                # an exhaustion story computed against the FINISHED
+                # plan could blame usage that accumulated after this
+                # placement's decision point — keep the shallow metric.
+                continue
             explained.coalesced_failures = \
                 failed.metrics.coalesced_failures
             explained.allocation_time = failed.metrics.allocation_time

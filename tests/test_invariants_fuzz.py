@@ -80,7 +80,7 @@ def random_job(rng, tag):
     return job
 
 
-def check_invariants(h: Harness, nodes, jobs):
+def check_invariants(h: Harness, nodes, jobs, conservation=True):
     by_id = {n.id: n for n in nodes}
     state_allocs = [a for a in h.state.allocs()
                     if not a.terminal_status()]
@@ -129,7 +129,11 @@ def check_invariants(h: Harness, nodes, jobs):
                     seen.add(a.node_id)
 
     # 5. Conservation: every requested instance is placed, failed, or
-    # coalesced onto a failed alloc.
+    # coalesced onto a failed alloc.  (Skipped for optimistic-conflict
+    # rigs where retries submit several plans per job — state-level
+    # conservation is asserted by the caller instead.)
+    if not conservation:
+        return
     for job, plan in zip(jobs, h.plans):
         requested = sum(tg.count for tg in job.task_groups)
         placed = sum(len(v) for v in plan.node_allocation.values())
@@ -172,3 +176,83 @@ def test_fuzz_invariants_native_off(seed, monkeypatch):
         h.state.upsert_job(h.next_index(), job)
         h.process("jax-binpack", make_eval(job))
     check_invariants(h, nodes, jobs)
+
+
+class VerifyingPlanner:
+    """Leader plan-applier semantics for the optimistic fuzz rigs:
+    verify each node's placements against live state (partial commit +
+    RefreshIndex, server/plan_apply.evaluate_plan), then apply only the
+    accepted portion — the serialization point the fused lanes rely on
+    in the real server."""
+
+    def __init__(self, h: Harness) -> None:
+        self.h = h
+
+    def submit_plan(self, plan):
+        from nomad_tpu.server.plan_apply import evaluate_plan
+
+        with h_lock(self.h):
+            result = evaluate_plan(self.h.state, plan)
+            allocs = []
+            for v in result.node_update.values():
+                allocs.extend(v)
+            for v in result.node_allocation.values():
+                allocs.extend(v)
+            allocs.extend(result.failed_allocs)
+            index = self.h.next_index()
+            if allocs:
+                self.h.state.upsert_allocs(index, allocs)
+            result.alloc_index = index
+        state = self.h.state.snapshot() if result.refresh_index else None
+        return result, state
+
+    def update_eval(self, ev):
+        self.h.update_eval(ev)
+
+    def create_eval(self, ev):
+        self.h.create_eval(ev)
+
+
+def h_lock(h):
+    import contextlib
+    return getattr(h, "_lock", None) or contextlib.nullcontext()
+
+
+@pytest.mark.parametrize("seed", [5, 58])
+def test_fuzz_invariants_fused_mesh_storm(seed, monkeypatch):
+    """The fused BatchEvalRunner with the device executor forced, so
+    the dispatch rides the runtime-selected mesh on the 8-device test
+    host (scheduler/batch.py _mesh_for).  Lanes plan optimistically
+    against one snapshot; a plan-applier-semantics planner serializes
+    commits (partial accept + refresh), and the hard invariants must
+    hold on the committed state — the multi-chip storm path gets the
+    same property net as the single-eval paths."""
+    from nomad_tpu.scheduler.batch import BatchEvalRunner
+    from nomad_tpu.scheduler.jax_binpack import JaxBinPackScheduler
+
+    monkeypatch.setattr(JaxBinPackScheduler, "HOST_SINGLE_SHOT_COST", 0)
+    rng = np.random.default_rng(seed)
+    h = Harness()
+    h.planner = VerifyingPlanner(h)
+    nodes = random_fleet(rng, int(rng.integers(16, 80)))
+    for n in nodes:
+        h.state.upsert_node(h.next_index(), n)
+    jobs = [random_job(rng, t) for t in range(4)]
+    for job in jobs:
+        h.state.upsert_job(h.next_index(), job)
+    runner = BatchEvalRunner(h.state.snapshot(), h.planner)
+    runner.process([make_eval(j) for j in jobs])
+    check_invariants(h, nodes, jobs, conservation=False)
+    # State-level conservation: per job, committed non-terminal
+    # placements never exceed the request, and everything requested is
+    # accounted placed or failed/coalesced.
+    for job in jobs:
+        requested = sum(tg.count for tg in job.task_groups)
+        allocs = h.state.allocs_by_job(job.id)
+        placed = len([a for a in allocs
+                      if a.node_id and not a.terminal_status()])
+        failed = [a for a in allocs if a.desired_status == "failed"]
+        coalesced = sum(a.metrics.coalesced_failures for a in failed)
+        assert placed <= requested, (job.id, placed, requested)
+        assert placed + len(failed) + coalesced >= requested, (
+            job.id, placed, len(failed), coalesced, requested)
