@@ -526,6 +526,18 @@ PyObject* bulk_finish(PyObject*, PyObject* args) {
       continue;
     }
 
+    if (ch < 0 && coalesce_all) {
+      // First failure of a task group under generic semantics: bail so
+      // the Python loop owns it — its sequential fallback can still
+      // PLACE the copy when the device's rounds estimate stranded it
+      // (fleet-fullness underestimates), and failures that survive get
+      // the full filter/exhaustion explanation.  The system path
+      // (coalesce_all=0, node-pinned) keeps its O(1) inline failures.
+      Py_DECREF(tg_key);
+      Py_DECREF(tg);
+      goto done;
+    }
+
     PyObject* slot = PyList_GET_ITEM(slots, g);
     PyObject* size_obj = PyTuple_GET_ITEM(slot, 0);
     PyObject* tasks = PyTuple_GET_ITEM(slot, 1);

@@ -91,3 +91,46 @@ class RejectPlan:
 
     def create_eval(self, ev: Evaluation) -> None:
         pass
+
+
+class VerifyingPlanner:
+    """Leader plan-applier semantics over a Harness: verify each node's
+    placements against live state (partial accept + RefreshIndex,
+    server/plan_apply.evaluate_plan), commit only the accepted portion,
+    and hand back a fresh snapshot when the scheduler must retry — the
+    serialization point optimistic eval storms rely on in the real
+    server.  Used by the fuzz rigs and bench config 5b (contended
+    storm)."""
+
+    def __init__(self, h: Harness) -> None:
+        self.h = h
+        self.conflicts = 0  # plans that came back partial/rejected
+
+    def submit_plan(self, plan: Plan):
+        from nomad_tpu.server.plan_apply import evaluate_plan
+
+        # No h.plans bookkeeping here: when reached through
+        # Harness.submit_plan (h.planner delegation) the harness has
+        # already recorded the plan.
+        with self.h._lock:
+            result = evaluate_plan(self.h.state, plan)
+            allocs: list = []
+            for v in result.node_update.values():
+                allocs.extend(v)
+            for v in result.node_allocation.values():
+                allocs.extend(v)
+            allocs.extend(result.failed_allocs)
+            index = self.h.next_index()
+            if allocs:
+                self.h.state.upsert_allocs(index, allocs)
+            result.alloc_index = index
+            if result.refresh_index:
+                self.conflicts += 1
+        state = self.h.state.snapshot() if result.refresh_index else None
+        return result, state
+
+    def update_eval(self, ev: Evaluation) -> None:
+        self.h.update_eval(ev)
+
+    def create_eval(self, ev: Evaluation) -> None:
+        self.h.create_eval(ev)

@@ -177,6 +177,57 @@ def fetch_results(*arrays) -> list:
     return [np.asarray(a) for a in arrays]
 
 
+def _fit_rounds(statics, view, feasible_h, asks, slot_placements,
+                k_cap: int, rounds: int) -> tuple[int, bool]:
+    """Fit-aware rounds refresh, run on EVERY dispatch (the prep cache
+    can't carry it — usage moves without the job/fleet generation
+    moving).  One round places at most one copy per currently-fitting
+    node, so the static (constraint-only) estimate goes stale as the
+    fleet fills: with 100 copies, 160 constraint-feasible nodes but
+    only 60 with room, rounds=1 strands 40 copies that the next round
+    would place.  Still an estimate — nodes filling MID-dispatch can
+    strand copies; the finish loop's sequential fallback rescues those
+    exactly.  Returns (rounds, rounds_eligible); need > 16 rounds means
+    the eval is scan-shaped and the sequence kernel takes it."""
+    n = statics.n_real
+    if n == 0 or not slot_placements:
+        return rounds, True
+    cap = statics.capacity[:n]
+    res = statics.reserved[:n]
+    usage = np.asarray(view.usage)[:n]
+    for slot, ps in slot_placements.items():
+        fit = ((usage + res + asks[slot]) <= cap).all(axis=-1)
+        fit_count = int((fit & feasible_h[slot, :n]).sum())
+        if fit_count == 0:
+            # Nothing can place for this slot right now: one cheap
+            # dispatch suffices — the finish fallback coalesces and
+            # explains the failures.
+            continue
+        need = -(-len(ps) // min(fit_count, k_cap))  # ceil
+        if need > 16:
+            # Scan-shaped (huge count on a tiny fitting set): the exact
+            # sequence kernel takes it.
+            return rounds, False
+        rounds = max(rounds, need)
+    # Bucket to powers of two: ``rounds`` is a static jit arg, and a
+    # value drifting 1,2,3,... as the fleet fills would recompile the
+    # kernel at every new value; buckets cap it at 5 signatures.
+    if rounds > 1:
+        rounds = 1 << (rounds - 1).bit_length()
+    return min(rounds, 16), True
+
+
+def _refresh_rounds(args: "DeviceArgs") -> "DeviceArgs":
+    """Per-dispatch rounds refinement applied to every DeviceArgs (both
+    the prep-cache hit and the fresh build) — ONE call site per return
+    so the policy cannot desynchronize."""
+    if args.rounds_eligible:
+        args.rounds, args.rounds_eligible = _fit_rounds(
+            args.statics, args.view, args.feasible_h, args.asks,
+            args.slot_placements, args.k_cap, args.rounds)
+    return args
+
+
 class DeviceArgs:
     """Everything one eval contributes to a (possibly batched) dispatch."""
 
@@ -607,9 +658,9 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
             # generations' HBM buffers for the job's lifetime.
             feas = statics.device_cache.get(tmpl[4])
             if feas is not None:
-                return DeviceArgs(statics=statics, view=view, start=start,
-                                  feasible_d=feas, feasible_h=feas[0],
-                                  **tmpl[5])
+                return _refresh_rounds(DeviceArgs(
+                    statics=statics, view=view, start=start,
+                    feasible_d=feas, feasible_h=feas[0], **tmpl[5]))
 
         # Dedupe task groups by *semantic* key (constraints + drivers + dc +
         # ask): count-expanded groups collapse to one mask row, keeping the
@@ -723,17 +774,8 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
             if gain_bound >= penalty * 0.95:
                 eligible = False
                 break
-            feas_count = int(feasible_h[slot, :statics.n_real].sum())
-            per_round = max(min(feas_count, k_cap), 1)
-            need = -(-len(ps) // per_round)  # ceil
-            # A round costs one top_k over the fleet (~sub-ms); 16 rounds
-            # still beats a multi-thousand-step sequential scan, so only
-            # truly scan-shaped evals (huge count on a tiny feasible set)
-            # fall back to place_sequence.
-            if need > 16:
-                eligible = False
-                break
-            rounds = max(rounds, need)
+            # Rounds themselves are estimated fit-aware per dispatch by
+            # _refresh_rounds — the one producer of that policy.
 
         kw = dict(
             asks=asks, distinct=distinct,
@@ -751,8 +793,9 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
         # Same reason the feasibility entry is cached by KEY.
         job.__dict__["_prep_cache"] = (job.modify_index, statics.gen, place,
                                        self.batch, feas_key, kw)
-        return DeviceArgs(statics=statics, view=view, start=start,
-                          feasible_d=cached, feasible_h=feasible_h, **kw)
+        return _refresh_rounds(DeviceArgs(
+            statics=statics, view=view, start=start,
+            feasible_d=cached, feasible_h=feasible_h, **kw))
 
     def finish_deferred(self, place: list, args: DeviceArgs,
                         chosen: np.ndarray, scores: np.ndarray) -> None:
@@ -835,10 +878,10 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
                 native, self, place, args.group_l, chosen_l, scores_l,
                 uuids, slots_c, alloc_proto, metric_proto,
                 coalesce_all=1)  # generic TG placements interchangeable
+            # fmap stays empty under generic semantics: the C loop bails
+            # on a task group's first chosen-less placement so the
+            # sequential fallback below can rescue or explain it.
             failed_tg.update(fmap)
-            native_failed = fmap
-        else:
-            native_failed = None
 
         for p in range(start_p, len(place)):
             missing = place[p]
@@ -927,38 +970,6 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
                 alloc.__dict__ = d
                 plan.append_failed(alloc)
                 failed_tg[id(tg)] = alloc
-
-        if native_failed:
-            # The C prefix builds failed allocs with proto metrics so the
-            # happy path never slows down; upgrade them AFTER the loop to
-            # the full sequential explanation (constraint/class/dimension
-            # filter + exhaustion counts — the same data the Python
-            # failure branch records, and what monitor.go dumpAllocStatus
-            # renders).  Coalesced counts accumulated in C carry over.
-            tg_by_key = {}
-            for missing in place:
-                key = id(missing.task_group)
-                if key not in tg_by_key:
-                    tg_by_key[key] = missing.task_group
-            if fallback_nodes is None:
-                fallback_nodes = ready_nodes_in_dcs(
-                    self.state, self.job.datacenters)
-            for key, failed in native_failed.items():
-                tg2 = tg_by_key.get(key)
-                if tg2 is None:
-                    continue
-                self.stack.set_nodes(list(fallback_nodes))
-                ranked, _size = self.stack.select(tg2)
-                if ranked is not None:
-                    # Exact chain disagrees with the device mask (should
-                    # not happen — the mask over-approximates): keep the
-                    # shallow metric rather than invent a placement.
-                    continue
-                explained = self.ctx.metrics()
-                explained.coalesced_failures = \
-                    failed.metrics.coalesced_failures
-                explained.allocation_time = failed.metrics.allocation_time
-                failed.metrics = explained
 
 
 def rounds_to_placements(args: DeviceArgs, chosen_slots: np.ndarray,

@@ -178,46 +178,6 @@ def test_fuzz_invariants_native_off(seed, monkeypatch):
     check_invariants(h, nodes, jobs)
 
 
-class VerifyingPlanner:
-    """Leader plan-applier semantics for the optimistic fuzz rigs:
-    verify each node's placements against live state (partial commit +
-    RefreshIndex, server/plan_apply.evaluate_plan), then apply only the
-    accepted portion — the serialization point the fused lanes rely on
-    in the real server."""
-
-    def __init__(self, h: Harness) -> None:
-        self.h = h
-
-    def submit_plan(self, plan):
-        from nomad_tpu.server.plan_apply import evaluate_plan
-
-        with h_lock(self.h):
-            result = evaluate_plan(self.h.state, plan)
-            allocs = []
-            for v in result.node_update.values():
-                allocs.extend(v)
-            for v in result.node_allocation.values():
-                allocs.extend(v)
-            allocs.extend(result.failed_allocs)
-            index = self.h.next_index()
-            if allocs:
-                self.h.state.upsert_allocs(index, allocs)
-            result.alloc_index = index
-        state = self.h.state.snapshot() if result.refresh_index else None
-        return result, state
-
-    def update_eval(self, ev):
-        self.h.update_eval(ev)
-
-    def create_eval(self, ev):
-        self.h.create_eval(ev)
-
-
-def h_lock(h):
-    import contextlib
-    return getattr(h, "_lock", None) or contextlib.nullcontext()
-
-
 @pytest.mark.parametrize("seed", [5, 58])
 def test_fuzz_invariants_fused_mesh_storm(seed, monkeypatch):
     """The fused BatchEvalRunner with the device executor forced, so
@@ -228,6 +188,7 @@ def test_fuzz_invariants_fused_mesh_storm(seed, monkeypatch):
     hold on the committed state — the multi-chip storm path gets the
     same property net as the single-eval paths."""
     from nomad_tpu.scheduler.batch import BatchEvalRunner
+    from nomad_tpu.scheduler.harness import VerifyingPlanner
     from nomad_tpu.scheduler.jax_binpack import JaxBinPackScheduler
 
     monkeypatch.setattr(JaxBinPackScheduler, "HOST_SINGLE_SHOT_COST", 0)

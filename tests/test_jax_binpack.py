@@ -692,3 +692,35 @@ def test_jax_scheduler_failures_carry_explanations():
     m2 = plan2.failed_allocs[0].metrics
     assert m2.nodes_exhausted >= 1 or m2.dimension_exhausted, \
         (m2.nodes_exhausted, m2.dimension_exhausted)
+
+
+def test_rounds_mode_places_past_fleet_fullness():
+    """Regression: with N constraint-feasible nodes but only a few
+    having room, the rounds estimate must grow (fit-aware _fit_rounds)
+    or the finish fallback must rescue — a 100-copy task group on a
+    fleet where just 5 nodes have capacity places ALL copies, not one
+    per fitting node."""
+    h = Harness()
+    # 5 roomy nodes + 25 full-ish nodes (room for exactly one task).
+    for i in range(30):
+        n = mock.node(i)
+        if i >= 5:
+            n.resources = Resources(
+                cpu=260, memory_mb=160, disk_mb=10_000, iops=150,
+                networks=n.resources.networks)
+        h.state.upsert_node(h.next_index(), n)
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 100
+    from nomad_tpu.structs import NetworkResource
+
+    tg.tasks[0].resources = Resources(
+        cpu=100, memory_mb=64,
+        networks=[NetworkResource(mbits=5, dynamic_ports=["http"])])
+    h.state.upsert_job(h.next_index(), job)
+    h.process("jax-binpack", make_eval(job))
+    plan = h.plans[0]
+    placed = sum(len(v) for v in plan.node_allocation.values())
+    # 5 roomy nodes hold 38 each (cpu 4000-100-100*38...), plenty for
+    # 100; the 25 tight nodes hold one each.
+    assert placed == 100, (placed, len(plan.failed_allocs))
