@@ -99,6 +99,11 @@ class BatchEvalRunner:
     rather than silently over-scheduled.
     """
 
+    # Fused retry rounds before the per-eval sequential fallback: 1 =
+    # collect-then-serial (each retry sees every earlier retry's
+    # commits).
+    FUSED_RETRY_ROUNDS = 2
+
     def __init__(self, state, planner,
                  state_refresh: Optional[Callable] = None) -> None:
         self.state = state
@@ -149,9 +154,31 @@ class BatchEvalRunner:
         from nomad_tpu.utils.gctune import gc_pause
 
         with gc_pause():
-            self._process(evals)
+            pending = list(evals)
+            # Fused retry rounds: lanes whose plans came back partial or
+            # rejected re-plan TOGETHER against a refreshed snapshot —
+            # under contention the applier's serialized conflicts, not
+            # planning, dominate, and one fused round retries them all
+            # for one dispatch.  Without a refresh hook (or for the
+            # stragglers after the round cap) the exact per-eval
+            # sequential retry gives the same terminal guarantee as the
+            # single-eval worker path.
+            rounds = self.FUSED_RETRY_ROUNDS \
+                if self.state_refresh is not None else 1
+            for _ in range(rounds):
+                retries = [] if self.state_refresh is not None else None
+                self._process(pending, retries)
+                if not retries:
+                    return
+                pending = retries
+                self.state = self.state_refresh()
+            for ev in pending:
+                retry = JaxBinPackScheduler(self.state, self.planner,
+                                            batch=(ev.type == "batch"))
+                retry.process(ev)
 
-    def _process(self, evals: list[Evaluation]) -> None:
+    def _process(self, evals: list[Evaluation],
+                 retries: Optional[list] = None) -> None:
         from nomad_tpu.ops.binpack import place_sequence_batch
 
         this_round, leftovers = self._split_rounds(evals)
@@ -165,7 +192,7 @@ class BatchEvalRunner:
             if sched.plan.node_update or sched.plan.node_allocation:
                 # Plan already carries deltas (migrations, in-place
                 # updates): base usage differs, run its own dispatch.
-                self._run_single(sched, place, args)
+                self._run_single(sched, place, args, retries)
                 continue
             pending.append((sched, place, args))
 
@@ -190,7 +217,8 @@ class BatchEvalRunner:
         steps = rounds * g_max if rounds_ok else p_max
         fused_cost = B * steps * statics.n_real
         if fused_cost <= JaxBinPackScheduler.HOST_SINGLE_SHOT_COST:
-            self._finish_fused_host(pending, rounds_ok, k_cap, rounds)
+            self._finish_fused_host(pending, rounds_ok, k_cap, rounds,
+                                    retries)
             if leftovers:
                 self._process_leftovers(leftovers)
             return
@@ -248,7 +276,7 @@ class BatchEvalRunner:
                 chosen, scores = rounds_to_placements(
                     args, chosen_s[b], score_s[b])
                 sched.finish_deferred(place, args, chosen, scores)
-                self._finish(sched)
+                self._finish(sched, retries)
         else:
             if mesh is not None:
                 from nomad_tpu.parallel.mesh import \
@@ -264,13 +292,13 @@ class BatchEvalRunner:
             chosen, scores = fetch_results(chosen, scores)
             for b, (sched, place, args) in enumerate(pending):
                 sched.finish_deferred(place, args, chosen[b], scores[b])
-                self._finish(sched)
+                self._finish(sched, retries)
 
         if leftovers:
             self._process_leftovers(leftovers)
 
     def _finish_fused_host(self, pending, rounds_ok, k_cap,
-                           rounds) -> None:
+                           rounds, retries=None) -> None:
         """Host-executor twin of the fused dispatch: every lane plans
         against the same snapshot base usage via the numpy kernels, one
         lane at a time (each lane's kernel is vectorized over nodes),
@@ -298,7 +326,7 @@ class BatchEvalRunner:
                     args.distinct, args.group_idx, args.valid,
                     float(args.penalty), n_real=n_real)
             sched.finish_deferred(place, args, chosen, scores)
-            self._finish(sched)
+            self._finish(sched, retries)
 
     def _process_leftovers(self, leftovers: list) -> None:
         if self.state_refresh is None:
@@ -310,15 +338,17 @@ class BatchEvalRunner:
         self.state = self.state_refresh()
         self.process(leftovers)
 
-    def _run_single(self, sched, place, args) -> None:
+    def _run_single(self, sched, place, args, retries=None) -> None:
         handles = sched.dispatch_device(args)
         chosen, scores = sched.collect_device(args, handles)
         sched.finish_deferred(place, args, chosen, scores)
-        self._finish(sched)
+        self._finish(sched, retries)
 
-    def _finish(self, sched) -> None:
-        """Submit the plan; on rejection/partial commit fall back to the
-        sequential retry loop (fresh scheduler, full process)."""
+    def _finish(self, sched, retries=None) -> None:
+        """Submit the plan; on rejection/partial commit either queue the
+        eval for the next FUSED retry round (``retries`` list supplied)
+        or fall back to the sequential retry loop (fresh scheduler,
+        full process)."""
         ev = sched.eval
         try:
             ok = sched._submit()
@@ -329,6 +359,8 @@ class BatchEvalRunner:
         if ok:
             set_status(self.planner, ev, sched.next_eval,
                        EVAL_STATUS_COMPLETE)
+        elif retries is not None:
+            retries.append(ev)  # no status yet: a later round owns it
         else:
             retry = JaxBinPackScheduler(
                 sched.state, self.planner, batch=(ev.type == "batch"))

@@ -848,12 +848,19 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
             return m
 
         failed_tg: dict = {}
+        # slot -> explained failure metrics: identical groups share one
+        # fleet-walk verdict (usage is monotone within a finish pass).
+        failed_slots: dict = {}
         fallback_nodes = None
         # Once any placement deviates from the device's choice, the device
         # scan's usage accounting has diverged from the plan's, so every
         # later device winner must be re-verified host-side with the exact
         # allocs_fit before being trusted.
         usage_diverged = False
+        # One-shot vectorized recovery: on the first divergence the whole
+        # remaining tail is re-planned by the exact host kernel instead
+        # of falling into a per-placement sequential walk.
+        redispatched = False
 
         # Native happy-path prefix: the C extension executes the common
         # per-placement steps (port picks, offer/Resources/AllocMetric/
@@ -883,12 +890,14 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
             # sequential fallback below can rescue or explain it.
             failed_tg.update(fmap)
 
-        for p in range(start_p, len(place)):
+        p = start_p
+        while p < len(place):
             missing = place[p]
             tg = missing.task_group
             prior_fail = failed_tg.get(id(tg))
             if prior_fail is not None:
                 prior_fail.metrics.coalesced_failures += 1
+                p += 1
                 continue
 
             g = slot_of_tg[id(tg)]
@@ -910,6 +919,25 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
                     task_resources = self._assign_networks(option_node, tg)
                 if task_resources is None:
                     option_node = None
+            if option_node is None and not redispatched and \
+                    (usage_diverged or from_device):
+                # The device's remaining choices are stale (the plan
+                # deviated from the kernel's assumed trajectory):
+                # re-plan place[p:] in ONE exact host-kernel pass
+                # against usage rebuilt from state + the in-flight
+                # plan, then re-enter this iteration with the fresh
+                # choice.  Turns the post-divergence tail from
+                # per-placement sequential walks (~ms each under
+                # contention) into a single vector pass.  A plain
+                # chosen=-1 with NO divergence skips this — the rerun
+                # would reproduce the same inputs and the same -1.
+                redispatched = True
+                fresh_c, fresh_s = self._redispatch_remaining(
+                    place, args, p)
+                chosen_l[p:] = fresh_c
+                scores_l[p:] = fresh_s
+                usage_diverged = False  # choices now exact vs the plan
+                continue  # re-handle p with the fresh choice
             if option_node is None:
                 # Sequential fallback, two jobs in one: when the device
                 # picked a node the exact host accounting rejects
@@ -923,25 +951,40 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
                     # Device usage accounting included a placement the
                     # plan won't make: re-verify later winners exactly.
                     usage_diverged = True
-                if fallback_nodes is None:
-                    fallback_nodes = ready_nodes_in_dcs(
-                        self.state, self.job.datacenters)
-                self.stack.set_nodes(list(fallback_nodes))
-                ranked, size = self.stack.select(tg)
-                if ranked is not None:
-                    if not from_device:
-                        # Host placed what the device didn't: diverged
-                        # in the other direction.
-                        usage_diverged = True
-                    option_node = ranked.node
-                    task_resources = ranked.task_resources
-                    # The fallback assigned ports outside our per-node
-                    # state: rebuild both on next use.
-                    self._net_cache.pop(option_node.id, None)
-                    self._node_net.pop(
-                        statics.index_of.get(option_node.id), None)
-                # stack.select populated fresh ctx metrics (incl. scores).
-                metrics = self.ctx.metrics()
+                prior_verdict = failed_slots.get(g)
+                if prior_verdict is not None:
+                    # A semantically identical group already walked the
+                    # fleet and failed; usage only grows within one
+                    # finish pass, so the verdict (and its explanation)
+                    # still holds — copy it instead of re-walking
+                    # O(fleet x allocs) per identical group.  The
+                    # source object lives on ANOTHER group's failed
+                    # alloc and accumulates that group's coalesce
+                    # count: zero it on the copy.
+                    metrics = prior_verdict.copy()
+                    metrics.coalesced_failures = 0
+                else:
+                    if fallback_nodes is None:
+                        fallback_nodes = ready_nodes_in_dcs(
+                            self.state, self.job.datacenters)
+                    self.stack.set_nodes(list(fallback_nodes))
+                    ranked, size = self.stack.select(tg)
+                    if ranked is not None:
+                        if not from_device:
+                            # Host placed what the device didn't:
+                            # diverged in the other direction.
+                            usage_diverged = True
+                        option_node = ranked.node
+                        task_resources = ranked.task_resources
+                        # The fallback assigned ports outside our
+                        # per-node state: rebuild both on next use.
+                        self._net_cache.pop(option_node.id, None)
+                        self._node_net.pop(
+                            statics.index_of.get(option_node.id), None)
+                    # select populated fresh ctx metrics (incl. scores).
+                    metrics = self.ctx.metrics()
+                    if ranked is None:
+                        failed_slots[g] = metrics
             else:
                 metrics = fast_metric(option_node.id + ".binpack",
                                       scores_l[p])
@@ -970,6 +1013,27 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
                 alloc.__dict__ = d
                 plan.append_failed(alloc)
                 failed_tg[id(tg)] = alloc
+            p += 1
+
+    def _redispatch_remaining(self, place: list, args: DeviceArgs,
+                              p: int) -> tuple[list, list]:
+        """Re-plan place[p:] with the exact host sequence kernel against
+        usage rebuilt from state + the in-flight plan (the same math the
+        device runs, so results splice straight into the finish loop)."""
+        from nomad_tpu.ops.binpack_host import place_sequence_host
+
+        statics = args.statics
+        view = build_usage(statics, self._proposed_allocs_all(),
+                           job_id=self.job.id)
+        rem = len(place) - p
+        group_idx = np.asarray(args.group_idx[p:p + rem], dtype=np.int32)
+        valid = np.ones(rem, dtype=bool)
+        chosen, scores, _u = place_sequence_host(
+            statics.capacity, statics.reserved, view.usage,
+            view.job_counts, args.feasible_h, args.asks, args.distinct,
+            group_idx, valid, np.float32(args.penalty),
+            n_real=statics.n_real)
+        return np.asarray(chosen).tolist(), np.asarray(scores).tolist()
 
 
 def rounds_to_placements(args: DeviceArgs, chosen_slots: np.ndarray,
