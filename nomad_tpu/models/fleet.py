@@ -476,7 +476,21 @@ def mirror_for(statics: FleetStatics) -> UsageMirror:
 
 def _scatter_rows(usage_d, idx: np.ndarray, rows: np.ndarray):
     """Asynchronous device scatter: overwrite the touched rows.  NOT
-    donating: in-flight dispatches may still hold the previous buffer."""
+    donating: in-flight dispatches may still hold the previous buffer.
+
+    The batch is padded to a power-of-two row count (pad entries rewrite
+    row idx[0] with its own value — a no-op) so the jit compiles at most
+    log2(N) signatures instead of one per distinct delta size: commit
+    streams change a different number of rows every sync, and an XLA
+    compile per size (~0.5s) would dwarf the scatter itself."""
+    n = len(idx)
+    if n == 0:
+        return usage_d
+    padded = 1 << int(n - 1).bit_length()
+    if padded != n:
+        pad = padded - n
+        idx = np.concatenate([idx, np.repeat(idx[:1], pad)])
+        rows = np.concatenate([rows, np.repeat(rows[:1], pad, axis=0)])
     return _ensure_scatter_jit()(usage_d, idx, rows)
 
 
