@@ -306,3 +306,25 @@ def test_mirror_for_is_singleton():
 if __name__ == "__main__":
     import sys
     sys.exit(pytest.main([__file__, "-v"]))
+
+
+def test_scatter_rows_pads_to_pow2_and_stays_exact():
+    """_scatter_rows pads every batch to a power-of-two row count (the
+    jit would otherwise recompile per distinct delta size) with no-op
+    rewrites — results must equal a plain numpy row assignment for odd,
+    even, single and empty batches."""
+    import jax
+    import numpy as np
+    from nomad_tpu.models.fleet import _scatter_rows
+
+    base = np.arange(40, dtype=np.float32).reshape(10, 4)
+    usage_d = jax.device_put(base)
+    rng = np.random.default_rng(7)
+    for n in (0, 1, 2, 3, 5, 7, 10):
+        idx = rng.choice(10, size=n, replace=False).astype(np.int64) \
+            if n else np.zeros(0, dtype=np.int64)
+        rows = rng.normal(size=(n, 4)).astype(np.float32)
+        want = np.asarray(usage_d).copy()
+        want[idx] = rows
+        usage_d = _scatter_rows(usage_d, idx, rows)
+        np.testing.assert_array_equal(np.asarray(usage_d), want)
