@@ -123,38 +123,65 @@ def bench_sequential_stream(h, jobs, scheduler: str, repeats: int = 3):
     pipelined side, so the reported speedups compare min against min."""
     best, best_lats, placed = float("inf"), [], 0
     for _ in range(repeats):
-        recorder = _RecordOnlyPlanner()
-        h.planner = recorder
-        lats = []
-        start = time.perf_counter()
-        for job in jobs:
-            t0 = time.perf_counter()
-            h.process(scheduler, make_eval(job))
-            lats.append(time.perf_counter() - t0)
-        total = time.perf_counter() - start
+        total, lats, got = _sequential_rep(h, jobs, scheduler)
         if total < best:
-            best, best_lats, placed = total, lats, _placed(recorder)
+            best, best_lats, placed = total, lats, got
     return best, best_lats, placed
+
+
+def _sequential_rep(h, jobs, scheduler: str):
+    recorder = _RecordOnlyPlanner()
+    h.planner = recorder
+    lats = []
+    start = time.perf_counter()
+    for job in jobs:
+        t0 = time.perf_counter()
+        h.process(scheduler, make_eval(job))
+        lats.append(time.perf_counter() - t0)
+    return time.perf_counter() - start, lats, _placed(recorder)
+
+
+def bench_interleaved_stream(h, jobs, scheduler: str, depth: int,
+                             repeats: int = 3):
+    """Symmetric best-of-N for BOTH sides with device/sequential reps
+    INTERLEAVED, so shared-host load drift between the two measurement
+    phases cannot skew the ratio: each side's best is drawn from the
+    same alternating load windows.  Returns
+    (dev_s, dev_lats, dev_placed, seq_s, seq_lats, seq_placed)."""
+    dev_best, dev_lats, dev_placed = float("inf"), [], 0
+    seq_best, seq_lats, seq_placed = float("inf"), [], 0
+    for _ in range(repeats):
+        total, lats, got = _pipelined_rep(h, jobs, depth)
+        if total < dev_best:
+            dev_best, dev_lats, dev_placed = total, lats, got
+        total, lats, got = _sequential_rep(h, jobs, scheduler)
+        if total < seq_best:
+            seq_best, seq_lats, seq_placed = total, lats, got
+    return dev_best, dev_lats, dev_placed, seq_best, seq_lats, seq_placed
+
+
+def _pipelined_rep(h, jobs, depth: int):
+    from nomad_tpu.scheduler.pipeline import PipelinedEvalRunner
+
+    recorder = _RecordOnlyPlanner()
+    snapshot = h.state.snapshot()
+    runner = PipelinedEvalRunner(snapshot, recorder, depth=depth)
+    evals = [make_eval(j) for j in jobs]
+    start = time.perf_counter()
+    runner.process(evals)
+    total = time.perf_counter() - start
+    assert len(recorder.plans) == len(jobs)
+    return total, runner.latencies, _placed(recorder)
 
 
 def bench_pipelined_stream(h, jobs, depth: int = 6, repeats: int = 1):
     """Device path with the dispatch pipeline; returns best-of-N
     (total_s, per_eval_latencies, placed)."""
-    from nomad_tpu.scheduler.pipeline import PipelinedEvalRunner
-
     best, best_lats, placed = float("inf"), [], 0
     for _ in range(repeats):
-        recorder = _RecordOnlyPlanner()
-        snapshot = h.state.snapshot()
-        runner = PipelinedEvalRunner(snapshot, recorder, depth=depth)
-        evals = [make_eval(j) for j in jobs]
-        start = time.perf_counter()
-        runner.process(evals)
-        total = time.perf_counter() - start
-        assert len(recorder.plans) == len(jobs)
+        total, lats, got = _pipelined_rep(h, jobs, depth)
         if total < best:
-            best, best_lats, placed = total, runner.latencies, \
-                _placed(recorder)
+            best, best_lats, placed = total, lats, got
     return best, best_lats, placed
 
 
@@ -346,10 +373,11 @@ def main() -> None:
     assert placed_dev == placed_seq == args.groups, (placed_dev, placed_seq)
     # Stream throughput: the pipeline hides the round trip behind host
     # work, so evals/sec is bound by per-eval host time, not the RTT.
+    # Device/sequential reps interleave so shared-host load drift can't
+    # skew the ratio between the two measurement phases.
     bench_pipelined_stream(h4, jobs4, depth=args.depth)  # warm caches
-    dev_s, dev_lats, _ = bench_pipelined_stream(
-        h4, jobs4, depth=args.depth, repeats=3)
-    seq_s, seq_lats, _ = bench_sequential_stream(h4, jobs4, "service")
+    dev_s, dev_lats, _, seq_s, seq_lats, _ = bench_interleaved_stream(
+        h4, jobs4, "service", depth=args.depth)
     configs["4_binpack_10kn_x_1ktg"] = {
         "evals_per_sec": round(len(jobs4) / dev_s, 3),
         "seq_evals_per_sec": round(len(jobs4) / seq_s, 3),
@@ -394,12 +422,21 @@ def main() -> None:
         import jax
         profile = jax.profiler.trace(args.profile_dir)
         profile.__enter__()
-    storm_dev = bench_storm_device(h5, jobs5, args.repeats)
+    # Interleaved symmetric best-of-N (see bench_interleaved_stream);
+    # the profiler trace brackets only the device reps.
+    storm_dev, storm_seq = float("inf"), float("inf")
+    storm_lats: list = []
+    for _ in range(args.repeats):
+        if profile is not None:
+            profile.__enter__()
+        storm_dev = min(storm_dev, bench_storm_device(h5, jobs5, 1))
+        if profile is not None:
+            profile.__exit__(None, None, None)
+        s_total, s_lats, _ = _sequential_rep(h5, jobs5, "service")
+        if s_total < storm_seq:
+            storm_seq, storm_lats = s_total, s_lats
     if profile is not None:
-        profile.__exit__(None, None, None)
         note(f"profile trace written to {args.profile_dir}")
-    storm_seq, storm_lats, _ = bench_sequential_stream(
-        h5, jobs5, "service")
     storm_eps = args.storm_jobs / storm_dev
     storm_seq_eps = args.storm_jobs / storm_seq
     configs["5_storm_64x"] = {
