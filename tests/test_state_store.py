@@ -163,3 +163,52 @@ def test_latest_index():
     s.upsert_node(7, mock.node())
     s.upsert_job(9, mock.job())
     assert s.latest_index() == 9
+
+
+def test_scheduling_never_mutates_store_objects():
+    """The race-safety cornerstone (reference state_store.go:17-19):
+    every object the store returns is treated as immutable by the
+    schedulers.  Deep-serialize the cluster, run generic + system evals
+    (device and sequential paths, placements and failures), and assert
+    the stored objects' serialized forms are bit-identical.  (Scheduler
+    memo caches annotate job.__dict__ with private keys; the dataclass
+    fields — the shared contract — must never move.)"""
+    import nomad_tpu.mock as mock
+    from nomad_tpu.scheduler import Harness
+    from nomad_tpu.structs import (Constraint, Evaluation,
+                                   generate_uuid)
+
+    h = Harness()
+    for i in range(8):
+        h.state.upsert_node(h.next_index(), mock.node(i))
+    jobs = []
+    for k in range(3):
+        j = mock.job()
+        j.task_groups[0].count = 4
+        if k == 2:  # one job that fails everywhere
+            j.task_groups[0].constraints = [
+                Constraint(hard=True, l_target="$attr.kernel.name",
+                           r_target="plan9", operand="=")]
+        h.state.upsert_job(h.next_index(), j)
+        jobs.append(j)
+    sysjob = mock.system_job()
+    h.state.upsert_job(h.next_index(), sysjob)
+
+    def frozen():
+        return {
+            "nodes": {n.id: n.to_dict() for n in h.state.nodes()},
+            "jobs": {j.id: j.to_dict() for j in h.state.jobs()},
+        }
+
+    def make_eval(job):
+        return Evaluation(id=generate_uuid(), priority=job.priority,
+                          type=job.type, triggered_by="job-register",
+                          job_id=job.id)
+
+    before = frozen()
+    for j in jobs:
+        h.process("jax-binpack", make_eval(j))
+        h.process("service", make_eval(j))
+    h.process("system", make_eval(sysjob))
+    h.process("system-seq", make_eval(sysjob))
+    assert frozen() == before, "a scheduler mutated a store object"
