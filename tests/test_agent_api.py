@@ -347,6 +347,20 @@ class TestCLI:
         assert code == 0
         assert "ready" in out
 
+    def test_node_drain_cli(self, dev_agent):
+        """`node-drain -enable <id>` marks the node draining; -disable
+        clears it (reference command/node_drain.go)."""
+        agent, _ = dev_agent
+        node_id = agent.client.node.id
+        code, out = self.run_cli(dev_agent, "node-drain", "-enable",
+                                 node_id)
+        assert code == 0, out
+        assert agent.server.fsm.state.node_by_id(node_id).drain
+        code, out = self.run_cli(dev_agent, "node-drain", "-disable",
+                                 node_id)
+        assert code == 0, out
+        assert not agent.server.fsm.state.node_by_id(node_id).drain
+
     def test_run_status_stop(self, dev_agent, tmp_path):
         spec = tmp_path / "cli-job.nomad"
         spec.write_text(JOBSPEC.replace('job "web"', 'job "cli-job"')
