@@ -103,10 +103,12 @@ class FleetStatics:
     mirror: Optional["UsageMirror"] = None
 
     def device_capacity_reserved(self):
+        from nomad_tpu.parallel.devices import ensure_on_default, \
+            on_default_platform
         hit = self.device_cache.get("capres")
-        if hit is None:
-            import jax
-            hit = (jax.device_put(self.capacity), jax.device_put(self.reserved))
+        if hit is None or not on_default_platform(hit[0]):
+            hit = (ensure_on_default(None, self.capacity),
+                   ensure_on_default(None, self.reserved))
             self.device_cache["capres"] = hit
         return hit
 
@@ -390,11 +392,12 @@ class UsageMirror:
         self._scatters_since_upload += 1
 
     def _device_usage_locked(self):
-        if self._usage_d is None:
-            import jax
-            self._usage_d = jax.device_put(self.usage)
+        from nomad_tpu.parallel.devices import ensure_on_default
+        buf = ensure_on_default(self._usage_d, self.usage)
+        if buf is not self._usage_d:  # fresh upload (first use or re-pin)
+            self._usage_d = buf
             self._scatters_since_upload = 0
-        return self._usage_d
+        return buf
 
     def device_usage(self):
         """Device-resident copy of the mirror's usage (uploaded on first

@@ -1,0 +1,106 @@
+"""Device-plane resolution: ONE authority for "which devices do we compute on".
+
+The environment may register more than one jax backend (e.g. a remote
+TPU plugin AND the host CPU platform); ``jax.devices()`` favors
+whichever backend wins registration, which is NOT necessarily the
+platform the runtime was pinned to (tests pin
+``jax.config.jax_default_device`` to cpu:0 over an 8-virtual-device
+host mesh; the driver's multi-chip dry run does the same).  Every
+device-plane entry point — mirror uploads, mesh construction, backend
+probes — must resolve devices through here so host tensors, meshes and
+jitted dispatches all land on ONE platform.  Mixing backends (CPU mesh
+kernels + a default-backend mirror upload) is exactly the class of bug
+that produced the round-4 multi-chip failure.
+
+Capability parity role: the reference has no analogue — its compute
+plane is the Go runtime itself.  This module is the TPU-native seam
+between the host data plane and the XLA device plane.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def default_platform() -> Optional[str]:
+    """Platform name of the pinned default device, or None when unpinned.
+
+    ``jax.config.jax_default_device`` may hold a Device or a platform
+    string (jax accepts both).
+    """
+    default = jax.config.jax_default_device
+    if default is None:
+        return None
+    return getattr(default, "platform", None) or str(default).split(":")[0]
+
+
+def default_platform_devices() -> list:
+    """Devices of the platform the runtime actually computes on.
+
+    When a default device is pinned, ALL devices of ITS platform (so an
+    8-virtual-device CPU pin yields the whole 8-device mesh); otherwise
+    whatever ``jax.devices()`` resolves to.
+    """
+    platform = default_platform()
+    if platform is None:
+        return jax.devices()
+    return jax.devices(platform)
+
+
+def default_device():
+    """The device unsharded host->device uploads must target (or None).
+
+    ``jax.device_put(x)`` with no device argument lands on the *default
+    backend's* device 0 and IGNORES the pinned default device; passing
+    this explicitly keeps single-buffer mirrors on the same platform as
+    the meshes built from :func:`default_platform_devices`.  Returns
+    None when nothing is pinned, which ``jax.device_put`` accepts and
+    treats as the unpinned default — same behavior, one code path.
+    """
+    default = jax.config.jax_default_device
+    if default is None:
+        return None
+    if isinstance(default, str):
+        return jax.devices(default_platform())[0]
+    return default
+
+
+def current_platform() -> str:
+    """Platform the runtime computes on RIGHT NOW: the pinned default
+    device's platform, or the default backend's when nothing is pinned
+    (what an argument-less ``jax.device_put`` / unjitted dispatch would
+    use)."""
+    platform = default_platform()
+    if platform is None:
+        platform = jax.devices()[0].platform
+    return platform
+
+
+def on_default_platform(arr) -> bool:
+    """Is this cached device buffer resident on :func:`current_platform`?
+
+    Device-buffer caches (mirror usage, capacity/reserved, feasibility)
+    outlive a runtime re-pin of ``jax_default_device`` (e.g. the
+    multi-chip dry run pins the mesh platform mid-process, then restores
+    the prior pin); serving a stale buffer would recreate the
+    mixed-backend dispatch this module exists to prevent, so caches call
+    this and re-upload on mismatch.  Platform-level on purpose: a
+    same-platform re-pin (cpu:0 -> cpu:3) must NOT invalidate
+    bench-scale fleet tensors.
+    """
+    return next(iter(arr.devices())).platform == current_platform()
+
+
+def ensure_on_default(cached, host):
+    """Device copy of ``host`` on the current platform, reusing
+    ``cached`` when it is still resident there.
+
+    The one invalidation policy for every single-buffer device cache:
+    callers keep whatever cache structure they need and route
+    (cached, host) pairs through here.  Returns ``cached`` itself when
+    it is valid, so callers can detect a re-upload by identity.
+    """
+    if cached is not None and on_default_platform(cached):
+        return cached
+    return jax.device_put(host, default_device())

@@ -29,9 +29,11 @@ LANE_AXIS = "lanes"
 
 
 def fleet_mesh(devices=None) -> Mesh:
-    """1-D mesh over all (or the given) devices; axis name 'fleet'."""
+    """1-D mesh over the default platform's (or the given) devices;
+    axis name 'fleet'."""
     if devices is None:
-        devices = jax.devices()
+        from nomad_tpu.parallel.devices import default_platform_devices
+        devices = default_platform_devices()
     return Mesh(np.asarray(devices), (FLEET_AXIS,))
 
 
@@ -45,7 +47,8 @@ def storm_mesh(lane_ways: int, devices=None) -> Mesh:
     still shrinks by the fleet-axis factor.  With lane_ways=1 this is
     fleet_mesh semantics on a 2-D mesh."""
     if devices is None:
-        devices = jax.devices()
+        from nomad_tpu.parallel.devices import default_platform_devices
+        devices = default_platform_devices()
     n = len(devices)
     if lane_ways <= 0 or n % lane_ways:
         raise ValueError(

@@ -74,6 +74,7 @@ def device_available() -> bool:
     if not _HAS_JAX:
         return False
     try:
-        return bool(jax.devices())
+        from nomad_tpu.parallel.devices import default_platform_devices
+        return bool(default_platform_devices())
     except Exception:
         return False

@@ -48,20 +48,13 @@ def _mesh_for(n_lanes: int, n_pad: int):
     n_lanes, capped at half the devices so the fleet axis keeps width;
     remaining devices shard the node axis, capped at n_pad so the
     sharding always divides it."""
-    import jax
-
     # Devices of the platform the runtime actually computes on: when a
     # default device is pinned (tests pin cpu:0 while the environment
     # also registers a remote TPU backend), the mesh must live on that
-    # platform, not on whichever backend jax.devices() favors.  The
-    # config value may be a Device or a platform-name string.
-    default = jax.config.jax_default_device
-    if default is None:
-        all_devices = jax.devices()
-    else:
-        platform = getattr(default, "platform", None) or \
-            str(default).split(":")[0]
-        all_devices = jax.devices(platform)
+    # platform, not on whichever backend jax.devices() favors.
+    from nomad_tpu.parallel.devices import default_platform_devices
+
+    all_devices = default_platform_devices()
     n_dev = len(all_devices)
     if n_dev < 2:
         return None

@@ -539,10 +539,8 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
             return self.dispatch_host(args)
         capacity_d, reserved_d = args.statics.device_capacity_reserved()
         feas_cached = args.feasible_d  # [host, device-or-None], lazy
-        if feas_cached[1] is None:
-            import jax
-
-            feas_cached[1] = jax.device_put(feas_cached[0])
+        from nomad_tpu.parallel.devices import ensure_on_default
+        feas_cached[1] = ensure_on_default(feas_cached[1], feas_cached[0])
         feasible_d = feas_cached[1]
         if args.rounds_eligible:
             from nomad_tpu.ops.binpack import place_rounds

@@ -1,0 +1,78 @@
+"""Device-plane resolver semantics (nomad_tpu/parallel/devices.py).
+
+The round-4 multi-chip failure was a mixed-backend ``device_put``; the
+resolver is the one authority that prevents it.  These tests pin/re-pin
+``jax_default_device`` and assert the cache-invalidation policy:
+same-platform re-pins keep buffers, platform changes invalidate.
+"""
+import jax
+import numpy as np
+import pytest
+
+from nomad_tpu.parallel.devices import (
+    current_platform,
+    default_device,
+    default_platform,
+    default_platform_devices,
+    ensure_on_default,
+    on_default_platform,
+)
+
+
+@pytest.fixture
+def restore_pin():
+    prior = jax.config.jax_default_device
+    yield
+    jax.config.update("jax_default_device", prior)
+
+
+def test_default_platform_devices_follow_pin(restore_pin):
+    cpus = jax.devices("cpu")
+    jax.config.update("jax_default_device", cpus[0])
+    assert default_platform() == "cpu"
+    assert default_platform_devices() == cpus
+    assert default_device() is cpus[0]
+
+
+def test_string_pin_resolves(restore_pin):
+    jax.config.update("jax_default_device", "cpu")
+    assert default_platform() == "cpu"
+    assert default_device() is jax.devices("cpu")[0]
+
+
+def test_same_platform_repin_keeps_cached_buffer(restore_pin):
+    cpus = jax.devices("cpu")
+    jax.config.update("jax_default_device", cpus[0])
+    buf = ensure_on_default(None, np.ones(4, dtype=np.float32))
+    assert on_default_platform(buf)
+    # Re-pin to another device of the SAME platform: bench-scale fleet
+    # tensors must not be re-uploaded.
+    jax.config.update("jax_default_device", cpus[-1])
+    assert on_default_platform(buf)
+    assert ensure_on_default(buf, np.ones(4, dtype=np.float32)) is buf
+
+
+def test_unpinned_checks_default_backend_platform(restore_pin):
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
+    buf = ensure_on_default(None, np.ones(4, dtype=np.float32))
+    jax.config.update("jax_default_device", None)
+    # Unpinned: the policy compares against the default backend's
+    # platform (what a bare device_put would use), not "anything goes".
+    assert current_platform() == jax.devices()[0].platform
+    assert on_default_platform(buf) == \
+        (jax.devices()[0].platform == "cpu")
+
+
+def test_usage_mirror_survives_repin(restore_pin):
+    import nomad_tpu.mock as mock
+    from nomad_tpu.models.fleet import build_fleet
+
+    cpus = jax.devices("cpu")
+    jax.config.update("jax_default_device", cpus[0])
+    fleet = build_fleet([mock.node(i) for i in range(4)])
+    cap_d, res_d = fleet.device_capacity_reserved()
+    assert on_default_platform(cap_d)
+    # Same-platform re-pin: cache identity must be preserved.
+    jax.config.update("jax_default_device", cpus[-1])
+    cap2, res2 = fleet.device_capacity_reserved()
+    assert cap2 is cap_d and res2 is res_d
