@@ -58,6 +58,18 @@ def _res_vector(res: Optional[Resources]) -> np.ndarray:
     return np.asarray(res.as_vector(), dtype=np.float32)
 
 
+def alloc_vec(alloc: "Allocation") -> np.ndarray:
+    """Cached resource vector of an allocation.  Sound because committed
+    allocations are replaced, never mutated (the store immutability
+    contract, tests/test_state_store.py) — a new record is a new object
+    with an empty cache; dataclasses.replace()-based copies don't carry
+    the cache either."""
+    vec = alloc.__dict__.get("_res_vec")
+    if vec is None:
+        vec = alloc.__dict__["_res_vec"] = _res_vector(alloc.resources)
+    return vec
+
+
 def _pad_to(n: int) -> int:
     """Next power of two >= n (>= 8); buckets shapes so jit caches stay hot."""
     p = 8
@@ -151,6 +163,74 @@ def build_fleet(nodes: list[Node]) -> FleetStatics:
     )
 
 
+def net_base_for(statics: FleetStatics, node_index: int, node):
+    """Node-static network base for the fast port/bandwidth paths:
+    ``(frozen reserved-ports, reserved mbits, bandwidth capacity, ip,
+    device)`` or None for topologies that need the exact NetworkIndex
+    walk (multi-network nodes, unresolvable ip).  Cached on the fleet
+    statics; shared by the scheduler's fast assigner
+    (scheduler/jax_binpack.FastPlacementMixin) and the plan verifier
+    (server/plan_apply)."""
+    base_cache = statics.net_base
+    base = base_cache.get(node_index, False)
+    if base is not False:
+        return base
+    from nomad_tpu.structs.network import _cidr_ips
+
+    base = None
+    nets = [n for n in node.resources.networks if n.device] \
+        if node.resources is not None else []
+    if len(nets) == 1:
+        n0 = nets[0]
+        ip = n0.ip
+        if not ip:
+            for ip in _cidr_ips(n0.cidr):
+                break
+        if ip:
+            used: set = set()
+            bw_used = 0
+            if node.reserved is not None:
+                for rn in node.reserved.networks:
+                    used.update(rn.reserved_ports)
+                    bw_used += rn.mbits
+            base = (frozenset(used), bw_used, n0.mbits, ip,
+                    n0.device)
+    base_cache[node_index] = base
+    return base
+
+
+# Sentinel net key for allocs whose offers span ips/devices (or carry
+# in-alloc oddities): forces the exact NetworkIndex path for their node.
+NET_KEY_ODD = ("__odd__", "__odd__")
+
+
+def _net_row(alloc: Allocation):
+    """The verifier's network row for one alloc: ``(ports, mbits,
+    (ip, device))`` aggregated over the FIRST network of each task —
+    exactly the set NetworkIndex.add_allocs accounts
+    (structs/network.py:87-95, reference nomad/structs/network.go
+    AddAllocs) — or None when the alloc reserves no network.  Offers
+    spanning multiple ips or devices get NET_KEY_ODD."""
+    ports: list = []
+    mbits = 0
+    key = None
+    for task_res in alloc.task_resources.values():
+        nets = task_res.networks
+        if not nets:
+            continue
+        n0 = nets[0]
+        ports.extend(n0.reserved_ports)
+        mbits += n0.mbits
+        k = (n0.ip, n0.device)
+        if key is None:
+            key = k
+        elif k != key:
+            key = NET_KEY_ODD
+    if key is None and not mbits:
+        return None
+    return (tuple(ports), mbits, key or NET_KEY_ODD)
+
+
 @dataclass
 class FleetView:
     """One eval's dynamic view: statics + usage + same-job alloc counts."""
@@ -189,7 +269,7 @@ def build_usage(statics: FleetStatics, allocs: list[Allocation],
             if i < 0:
                 continue
             idx[keep] = i
-            vecs[keep] = _res_vector(a.resources)
+            vecs[keep] = alloc_vec(a)
             if job_id and a.job_id == job_id:
                 job_counts[i] += 1
             keep += 1
@@ -239,7 +319,37 @@ class UsageMirror:
         # Invariant: _usage_d is None or exactly equals self.usage.
         self._usage_d = None
         self._scatters_since_upload = 0
-        self._lock = threading.Lock()
+        # Per-node port/bandwidth tracking for the vectorized plan
+        # verifier (server/plan_apply).  Disabled until sync_net() is
+        # first called so scheduler-only users pay nothing; once
+        # enabled, maintained incrementally by the same delta walk as
+        # usage.  All keyed by node index, empties pruned:
+        #   net_rows:   alloc_id -> (ni, ports, mbits, (ip, device))
+        #   node_ports: ni -> {port: live count}
+        #   node_dup:   ni -> number of ports with count > 1
+        #   node_bw:    ni -> sum of live offer mbits
+        #   node_net_keys: ni -> {(ip, device): count} (NET_KEY_ODD rows
+        #                  force the exact path for their node)
+        self._net_ready = False
+        self.net_rows: dict = {}
+        self.node_ports: dict = {}
+        self.node_dup: dict = {}
+        self.node_bw: dict = {}
+        self.node_net_keys: dict = {}
+        # Reentrant so a caller can hold the mirror across a composite
+        # read (sync_net + the plan verifier's verdict loop) while the
+        # internal sync paths re-acquire: the net dicts are mutated in
+        # place by _apply_deltas, so unlike the copy-on-write usage
+        # array they must not be read unlocked.
+        self._lock = threading.RLock()
+
+    @property
+    def lock(self):
+        """Hold this across any multi-step read of the in-place-mutated
+        net structures (node_ports/node_net_keys/net_rows/alloc_rows);
+        the usage array itself is replaced copy-on-write and may be
+        taken by reference."""
+        return self._lock
 
     # -- sync --------------------------------------------------------------
     def _current(self, t) -> bool:
@@ -287,6 +397,20 @@ class UsageMirror:
         with self._lock:
             return self._sync_locked(t)
 
+    def sync_net(self, state) -> bool:
+        """sync() plus per-node port/bandwidth tracking: enabled (full
+        net rebuild) on first call, maintained incrementally by every
+        later sync.  Same monotonicity contract as sync()."""
+        t = state._t
+        if self._net_ready and self._current(t):
+            return True
+        with self._lock:
+            ok = self._sync_locked(t)
+            if ok and not self._net_ready:
+                self._rebuild_net(t.tables["allocs"])
+                self._net_ready = True
+            return ok
+
     def _changed_ids(self, log: list, target: int) -> set:
         start = self._log_pos if log is self._log_ref else 0
         changed: set = set()
@@ -320,7 +444,7 @@ class UsageMirror:
             ni = index_of.get(alloc.node_id, -1)
             if ni < 0:
                 continue
-            vec = _res_vector(alloc.resources)
+            vec = alloc_vec(alloc)
             usage[ni] += vec
             job_counts.setdefault(alloc.job_id, {})[ni] = \
                 job_counts.get(alloc.job_id, {}).get(ni, 0) + 1
@@ -330,6 +454,86 @@ class UsageMirror:
         self.alloc_rows = rows
         self.rebuilds += 1
         self._usage_d = None
+        if self._net_ready:
+            self._rebuild_net(table)
+
+    # -- net tracking (vectorized plan verifier) ---------------------------
+    def _rebuild_net(self, table: dict) -> None:
+        index_of = self.statics.index_of
+        self.net_rows = {}
+        self.node_ports = {}
+        self.node_dup = {}
+        self.node_bw = {}
+        self.node_net_keys = {}
+        for alloc in table.values():
+            if alloc.terminal_status():
+                continue
+            ni = index_of.get(alloc.node_id, -1)
+            if ni < 0:
+                continue
+            self._net_add(alloc.id, ni, alloc)
+
+    def _net_add(self, aid: str, ni: int, alloc: Allocation) -> None:
+        row = _net_row(alloc)
+        if row is None:
+            return
+        ports, mbits, key = row
+        self.net_rows[aid] = (ni, ports, mbits, key)
+        if mbits:
+            self.node_bw[ni] = self.node_bw.get(ni, 0) + mbits
+        keys = self.node_net_keys.setdefault(ni, {})
+        keys[key] = keys.get(key, 0) + 1
+        if ports:
+            pc = self.node_ports.setdefault(ni, {})
+            dup = 0
+            for p in ports:
+                c = pc.get(p, 0) + 1
+                pc[p] = c
+                if c == 2:
+                    dup += 1
+            if dup:
+                self.node_dup[ni] = self.node_dup.get(ni, 0) + dup
+
+    def _net_remove(self, aid: str) -> None:
+        row = self.net_rows.pop(aid, None)
+        if row is None:
+            return
+        ni, ports, mbits, key = row
+        if mbits:
+            bw = self.node_bw.get(ni, 0) - mbits
+            if bw:
+                self.node_bw[ni] = bw
+            else:
+                self.node_bw.pop(ni, None)
+        keys = self.node_net_keys.get(ni)
+        if keys is not None:
+            c = keys.get(key, 0) - 1
+            if c > 0:
+                keys[key] = c
+            else:
+                keys.pop(key, None)
+                if not keys:
+                    self.node_net_keys.pop(ni, None)
+        if ports:
+            pc = self.node_ports.get(ni)
+            if pc is not None:
+                dup = 0
+                for p in ports:
+                    c = pc.get(p, 0) - 1
+                    if c > 0:
+                        pc[p] = c
+                        if c == 1:
+                            dup += 1
+                    else:
+                        pc.pop(p, None)
+                if dup:
+                    d = self.node_dup.get(ni, 0) - dup
+                    if d > 0:
+                        self.node_dup[ni] = d
+                    else:
+                        self.node_dup.pop(ni, None)
+                if not pc:
+                    self.node_ports.pop(ni, None)
 
     def _apply_deltas(self, table: dict, changed: set) -> None:
         statics = self.statics
@@ -350,12 +554,14 @@ class UsageMirror:
                 jc[ni] = jc.get(ni, 0) - 1
                 del self.alloc_rows[aid]
                 touched_rows.add(ni)
+            if self._net_ready:
+                self._net_remove(aid)
             new = table.get(aid)
             if new is not None and not new.terminal_status():
                 ni = index_of.get(new.node_id, -1)
                 if ni < 0:
                     continue
-                vec = _res_vector(new.resources)
+                vec = alloc_vec(new)
                 usage[ni] += vec
                 jid = new.job_id
                 jc = touched_jobs.get(jid)
@@ -365,6 +571,8 @@ class UsageMirror:
                 jc[ni] = jc.get(ni, 0) + 1
                 self.alloc_rows[aid] = (ni, vec, jid)
                 touched_rows.add(ni)
+                if self._net_ready:
+                    self._net_add(aid, ni, new)
         for jid, jc in touched_jobs.items():
             jc = {ni: c for ni, c in jc.items() if c > 0}
             if jc:

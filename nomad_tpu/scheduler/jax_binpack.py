@@ -36,6 +36,7 @@ from nomad_tpu.models.fleet import (
     build_usage,
     fleet_cache,
     mirror_for,
+    net_base_for,
 )
 from nomad_tpu.ops.binpack import place_sequence
 from nomad_tpu.structs import (
@@ -53,7 +54,6 @@ from nomad_tpu.structs import (
     generate_uuids,
 )
 from nomad_tpu.structs.model import MAX_DYNAMIC_PORT, MIN_DYNAMIC_PORT
-from nomad_tpu.structs.network import _cidr_ips
 
 from .generic import GenericScheduler
 from .stack import (
@@ -273,32 +273,10 @@ class FastPlacementMixin:
     def _net_base_for(self, node_index: int, node):
         """Node-static network base (frozen used-ports, reserved bw, bw
         capacity, ip, device) or None for topologies needing the exact
-        path.  Cached on the fleet statics; also the callback the native
+        path.  Cached on the fleet statics (models/fleet.net_base_for,
+        shared with the plan verifier); also the callback the native
         bulk finish uses on a base-cache miss."""
-        base_cache = self._statics.net_base
-        base = base_cache.get(node_index, False)
-        if base is not False:
-            return base
-        base = None
-        nets = [n for n in node.resources.networks if n.device] \
-            if node.resources is not None else []
-        if len(nets) == 1:
-            n0 = nets[0]
-            ip = n0.ip
-            if not ip:
-                for ip in _cidr_ips(n0.cidr):
-                    break
-            if ip:
-                used: set = set()
-                bw_used = 0
-                if node.reserved is not None:
-                    for rn in node.reserved.networks:
-                        used.update(rn.reserved_ports)
-                        bw_used += rn.mbits
-                base = (frozenset(used), bw_used, n0.mbits, ip,
-                        n0.device)
-        base_cache[node_index] = base
-        return base
+        return net_base_for(self._statics, node_index, node)
 
     def _node_net_init(self, node_index: int, node):
         """Fast per-node network state: [used_ports, bw_used, bw_avail,

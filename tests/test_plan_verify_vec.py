@@ -1,0 +1,342 @@
+"""Vectorized plan verifier parity (server/plan_apply._evaluate_plan_vec).
+
+The scalar per-node walk (_evaluate_node_plan: allocs_fit + a fresh
+NetworkIndex per node, reference nomad/plan_apply.go:238-284) is the
+semantic truth; the vector path must produce IDENTICAL PlanResults on
+every snapshot it serves, including port collisions, bandwidth limits,
+freed-by-eviction fits and in-place updates.  Targeted cases first,
+then a randomized fuzz, then incremental net-mirror consistency.
+"""
+import random
+
+import numpy as np
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.models.fleet import fleet_cache, mirror_for
+from nomad_tpu.server.plan_apply import (
+    _evaluate_node_plan,
+    _evaluate_plan_vec,
+    evaluate_plan,
+)
+from nomad_tpu.state.store import StateStore
+from nomad_tpu.structs import (
+    ALLOC_CLIENT_STATUS_PENDING,
+    ALLOC_DESIRED_STATUS_RUN,
+    ALLOC_DESIRED_STATUS_STOP,
+    Allocation,
+    NetworkResource,
+    Plan,
+    Resources,
+    generate_uuid,
+)
+
+
+def make_alloc(node, *, cpu=500, mem=256, port=None, mbits=10,
+               job_id="j1", terminal=False, alloc_id=None) -> Allocation:
+    ports = [port] if port is not None else []
+    # Offers land on the node's one ip — mock nodes carry it on the
+    # reserved network (the /32 cidr resolves to the same address).
+    ip = node.reserved.networks[0].ip if node.reserved is not None and \
+        node.reserved.networks else "192.168.0.1"
+    net = NetworkResource(device="eth0", ip=ip,
+                          reserved_ports=list(ports), mbits=mbits)
+    a = Allocation(
+        id=alloc_id or generate_uuid(),
+        node_id=node.id,
+        job_id=job_id,
+        task_group="web",
+        resources=Resources(cpu=cpu, memory_mb=mem,
+                            networks=[net.copy()]),
+        task_resources={"web": Resources(cpu=cpu, memory_mb=mem,
+                                         networks=[net])},
+        desired_status=ALLOC_DESIRED_STATUS_STOP if terminal
+        else ALLOC_DESIRED_STATUS_RUN,
+        client_status=ALLOC_CLIENT_STATUS_PENDING,
+    )
+    return a
+
+
+def scalar_truth(snap, plan) -> dict:
+    """The scalar walk's verdict for every touched node."""
+    node_ids = set(plan.node_update) | set(plan.node_allocation)
+    return {nid: _evaluate_node_plan(snap, plan, nid) for nid in node_ids}
+
+
+def assert_parity(state, plan):
+    verdicts = _evaluate_plan_vec(
+        state, plan, set(plan.node_update) | set(plan.node_allocation))
+    truth = scalar_truth(state, plan)
+    assert verdicts is not None
+    for nid, want in truth.items():
+        got = verdicts[nid]
+        if got is None:
+            continue  # punted to the scalar walk: trivially consistent
+        assert got == want, (nid, got, want)
+    return verdicts
+
+
+@pytest.fixture
+def rig():
+    state = StateStore()
+    nodes = [mock.node(i) for i in range(8)]
+    idx = 10
+    for n in nodes:
+        state.upsert_node(idx, n)
+        idx += 1
+    return state, nodes, [idx]  # mutable index cell
+
+
+def bump(cell):
+    cell[0] += 1
+    return cell[0]
+
+
+def test_over_capacity_rejected(rig):
+    state, nodes, cell = rig
+    n = nodes[0]
+    plan = Plan(node_allocation={n.id: [
+        make_alloc(n, cpu=8000, mem=64)]})  # node has 4000 MHz
+    v = assert_parity(state, plan)
+    assert v[n.id] is False
+
+
+def test_fit_accepted_and_eviction_frees(rig):
+    state, nodes, cell = rig
+    n = nodes[0]
+    big = make_alloc(n, cpu=3500, mem=4000)
+    state.upsert_allocs(bump(cell), [big])
+    # Without eviction the second big alloc cannot fit...
+    plan = Plan(node_allocation={n.id: [make_alloc(n, cpu=3500, mem=400)]})
+    v = assert_parity(state, plan)
+    assert v[n.id] is False
+    # ...evicting it in the same plan frees the room.
+    stopped = make_alloc(n, cpu=3500, mem=4000, alloc_id=big.id)
+    plan = Plan(node_update={n.id: [stopped]},
+                node_allocation={n.id: [make_alloc(n, cpu=3500, mem=400)]})
+    v = assert_parity(state, plan)
+    assert v[n.id] is True
+
+
+def test_port_collision_with_existing(rig):
+    state, nodes, cell = rig
+    n = nodes[0]
+    state.upsert_allocs(bump(cell), [make_alloc(n, port=30000)])
+    plan = Plan(node_allocation={n.id: [make_alloc(n, port=30000)]})
+    v = assert_parity(state, plan)
+    assert v[n.id] is False
+    # A different port fits.
+    plan = Plan(node_allocation={n.id: [make_alloc(n, port=30001)]})
+    v = assert_parity(state, plan)
+    assert v[n.id] is True
+
+
+def test_port_collision_within_plan(rig):
+    state, nodes, cell = rig
+    n = nodes[0]
+    plan = Plan(node_allocation={n.id: [
+        make_alloc(n, port=31000), make_alloc(n, port=31000)]})
+    v = assert_parity(state, plan)
+    assert v[n.id] is False
+
+
+def test_port_freed_by_eviction_reusable(rig):
+    state, nodes, cell = rig
+    n = nodes[0]
+    old = make_alloc(n, port=32000)
+    state.upsert_allocs(bump(cell), [old])
+    stopped = make_alloc(n, port=32000, alloc_id=old.id)
+    plan = Plan(node_update={n.id: [stopped]},
+                node_allocation={n.id: [make_alloc(n, port=32000)]})
+    v = assert_parity(state, plan)
+    assert v[n.id] is True
+
+
+def test_node_reserved_port_always_collides(rig):
+    state, nodes, cell = rig
+    n = nodes[0]  # mock nodes reserve port 22
+    plan = Plan(node_allocation={n.id: [make_alloc(n, port=22)]})
+    v = assert_parity(state, plan)
+    assert v[n.id] is False
+
+
+def test_eviction_frees_duplicated_port(rig):
+    """State can hold colliding ports (committed without verification);
+    a plan that evicts one of the pair must be judged on the
+    POST-removal live set, exactly like the scalar walk."""
+    state, nodes, cell = rig
+    n = nodes[0]
+    a1 = make_alloc(n, port=33000)
+    a2 = make_alloc(n, port=33000)
+    state.upsert_allocs(bump(cell), [a1, a2])
+    # Collision still live: rejected.
+    plan = Plan(node_allocation={n.id: [make_alloc(n, port=33001)]})
+    v = assert_parity(state, plan)
+    assert v[n.id] is False
+    # Evicting one of the pair clears the duplicate; its port is still
+    # held by the survivor, so a placement on it must still reject —
+    # but any other port fits.
+    stop = make_alloc(n, port=33000, alloc_id=a1.id)
+    plan = Plan(node_update={n.id: [stop]},
+                node_allocation={n.id: [make_alloc(n, port=33001)]})
+    v = assert_parity(state, plan)
+    assert v[n.id] is True
+    plan = Plan(node_update={n.id: [stop]},
+                node_allocation={n.id: [make_alloc(n, port=33000)]})
+    v = assert_parity(state, plan)
+    assert v[n.id] is False
+
+
+def test_off_network_reserved_punts_to_scalar(rig):
+    """Reserved networks on a different ip/device than the node's
+    primary network can't ride the merged fast counting — the verdict
+    must come from the scalar walk (None), and the public
+    evaluate_plan result must equal the scalar truth."""
+    state, nodes, cell = rig
+    n = mock.node(50)
+    n.reserved.networks.append(NetworkResource(
+        device="lo", ip="127.0.0.1", reserved_ports=[8080], mbits=0))
+    state.upsert_node(bump(cell), n)
+    plan = Plan(node_allocation={n.id: [make_alloc(n, port=8080)]})
+    verdicts = _evaluate_plan_vec(state, plan, {n.id})
+    assert verdicts[n.id] is None  # punted
+    result = evaluate_plan(state, plan)
+    want = scalar_truth(state, plan)[n.id]
+    assert (n.id in result.node_allocation) == want
+
+
+def test_bandwidth_exceeded(rig):
+    state, nodes, cell = rig
+    n = nodes[0]  # 1000 mbits capacity, 1 reserved
+    state.upsert_allocs(bump(cell), [make_alloc(n, mbits=800)])
+    plan = Plan(node_allocation={n.id: [make_alloc(n, mbits=300)]})
+    v = assert_parity(state, plan)
+    assert v[n.id] is False
+    plan = Plan(node_allocation={n.id: [make_alloc(n, mbits=100)]})
+    v = assert_parity(state, plan)
+    assert v[n.id] is True
+
+
+def test_down_node_rejected(rig):
+    state, nodes, cell = rig
+    n = mock.node(99)
+    n.status = "down"
+    state.upsert_node(bump(cell), n)
+    plan = Plan(node_allocation={n.id: [make_alloc(n)]})
+    v = assert_parity(state, plan)
+    assert v[n.id] is False
+
+
+def test_terminal_allocs_ignored(rig):
+    state, nodes, cell = rig
+    n = nodes[0]
+    state.upsert_allocs(bump(cell), [
+        make_alloc(n, cpu=3900, mem=7000, terminal=True)])
+    plan = Plan(node_allocation={n.id: [make_alloc(n, cpu=3500)]})
+    v = assert_parity(state, plan)
+    assert v[n.id] is True
+
+
+def test_evaluate_plan_end_to_end_matches(rig):
+    """Whole-result comparison through the public evaluate_plan."""
+    state, nodes, cell = rig
+    n0, n1 = nodes[0], nodes[1]
+    state.upsert_allocs(bump(cell), [make_alloc(n0, port=30000)])
+    plan = Plan(node_allocation={
+        n0.id: [make_alloc(n0, port=30000)],     # collides -> rejected
+        n1.id: [make_alloc(n1, port=30000)],     # fine on another node
+    })
+    result = evaluate_plan(state, plan)
+    assert n1.id in result.node_allocation
+    assert n0.id not in result.node_allocation
+    assert result.refresh_index > 0
+
+
+def test_fuzz_parity(rig):
+    state, nodes, cell = rig
+    rng = random.Random(7)
+    live: list = []
+    for round_i in range(60):
+        # Mutate state: add some allocs, stop some.
+        batch = []
+        for _ in range(rng.randrange(0, 4)):
+            n = rng.choice(nodes)
+            batch.append(make_alloc(
+                n, cpu=rng.choice([200, 900, 1800]),
+                mem=rng.choice([128, 2048]),
+                port=rng.choice([None, 30000 + rng.randrange(6)]),
+                mbits=rng.choice([0, 10, 400])))
+        if batch:
+            live.extend(batch)
+            state.upsert_allocs(bump(cell), batch)
+        if live and rng.random() < 0.4:
+            victim = live.pop(rng.randrange(len(live)))
+            stopped = make_alloc(victim_node(nodes, victim),
+                                 alloc_id=victim.id, terminal=True)
+            state.upsert_allocs(bump(cell), [stopped])
+
+        # Random plan over random nodes.
+        plan = Plan()
+        for n in rng.sample(nodes, rng.randrange(1, 5)):
+            if rng.random() < 0.3:
+                on_node = [a for a in live if a.node_id == n.id]
+                if on_node:
+                    victim = rng.choice(on_node)
+                    plan.node_update.setdefault(n.id, []).append(
+                        make_alloc(n, alloc_id=victim.id))
+            k = rng.randrange(0, 3)
+            for _ in range(k):
+                plan.node_allocation.setdefault(n.id, []).append(
+                    make_alloc(n, cpu=rng.choice([200, 1500, 3900]),
+                               mem=rng.choice([128, 4096]),
+                               port=rng.choice(
+                                   [None, 30000 + rng.randrange(6)]),
+                               mbits=rng.choice([0, 10, 600])))
+        if plan.node_update or plan.node_allocation:
+            assert_parity(state, plan)
+
+
+def victim_node(nodes, alloc):
+    for n in nodes:
+        if n.id == alloc.node_id:
+            return n
+    raise AssertionError(alloc.node_id)
+
+
+def test_incremental_net_mirror_matches_rebuild(rig):
+    """After arbitrary churn, the incrementally-maintained net state must
+    equal a from-scratch rebuild (same invariant style as the usage
+    mirror's parity tests)."""
+    state, nodes, cell = rig
+    statics = fleet_cache.statics_for(state)
+    mirror = mirror_for(statics)
+    mirror.sync_net(state)  # enable tracking before the churn
+
+    rng = random.Random(3)
+    live: list = []
+    for _ in range(40):
+        batch = []
+        for _ in range(rng.randrange(0, 3)):
+            n = rng.choice(nodes)
+            batch.append(make_alloc(
+                n, port=rng.choice([None, 40000 + rng.randrange(4)]),
+                mbits=rng.choice([0, 25])))
+        if batch:
+            live.extend(batch)
+            state.upsert_allocs(bump(cell), batch)
+        if live and rng.random() < 0.5:
+            victim = live.pop(rng.randrange(len(live)))
+            state.upsert_allocs(bump(cell), [make_alloc(
+                victim_node(nodes, victim), alloc_id=victim.id,
+                terminal=True)])
+        mirror.sync_net(state)
+
+        from nomad_tpu.models.fleet import UsageMirror
+        fresh = UsageMirror(statics)
+        fresh.sync_net(state)
+        assert mirror.net_rows == fresh.net_rows
+        assert mirror.node_ports == fresh.node_ports
+        assert mirror.node_dup == fresh.node_dup
+        assert mirror.node_bw == fresh.node_bw
+        assert mirror.node_net_keys == fresh.node_net_keys
+        np.testing.assert_array_equal(mirror.usage, fresh.usage)
