@@ -200,6 +200,113 @@ def bench_single_eval(h, job, scheduler: str, repeats: int):
     return best, placed
 
 
+# Nominal HBM bandwidth used for the rough roofline line: TPU v5 lite
+# (the chip this environment exposes) is ~819 GB/s; CPU runs just get a
+# smaller achieved number against the same nominal, clearly labeled.
+HBM_NOMINAL_GBPS = 819.0
+
+
+def device_kernel_stats(h, job, repeats: int = 5):
+    """Pure device time of the config-4 rounds kernel with resident
+    inputs, plus a rough HBM-traffic estimate, so the report grounds the
+    speedups in hardware terms (device_fraction + roofline) instead of
+    ratios alone.
+
+    Traffic model: per slot x round, score_all_nodes streams the four
+    [N, D] f32 fleet tensors (capacity/reserved/usage/job-counts) and
+    one [N] bool feasibility row -> G * rounds * N * (4*D*4 + 1) bytes.
+    An estimate, not a measurement — XLA keeps the scan carry in HBM and
+    may fuse reads — but it bounds the kernel's order of magnitude.
+    """
+    import jax
+    import numpy as np
+
+    from nomad_tpu.models.fleet import NDIMS
+    from nomad_tpu.ops.binpack import place_rounds
+    from nomad_tpu.parallel.devices import ensure_on_default
+    from nomad_tpu.scheduler.jax_binpack import JaxBinPackScheduler
+
+    sched = JaxBinPackScheduler(h.state.snapshot(), h, batch=False)
+    sched.eval = make_eval(job)
+    sched.defer_device = True
+    sched._begin()
+    _place, a = sched.deferred
+    cap_d, res_d = a.statics.device_capacity_reserved()
+    feas_d = ensure_on_default(None, a.feasible_h)
+    usage_d = ensure_on_default(None, a.view.usage)
+    jc_d = ensure_on_default(None, a.view.job_counts)
+
+    def run():
+        out = place_rounds(cap_d, res_d, usage_d, jc_d, feas_d, a.asks,
+                           a.distinct, a.counts, a.penalty,
+                           k_cap=a.k_cap, rounds=a.rounds)
+        # np.asarray, not block_until_ready: on the remote-attached
+        # (axon) platform readiness can resolve without the device
+        # actually finishing; pulling the choices back is the only
+        # honest fence, and it is what the scheduler does anyway.
+        np.asarray(out[0])
+
+    run()  # compile
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        run()
+        best = min(best, time.perf_counter() - t0)
+    g_pad, n_pad = a.feasible_h.shape
+    est_bytes = g_pad * a.rounds * n_pad * (4 * NDIMS * 4 + 1)
+    return best, est_bytes
+
+
+def storm_kernel_stats(h, job, lanes: int, repeats: int = 2):
+    """Pure device time of the fused [B, G, N] storm kernel (the config-5
+    dispatch shape) with resident inputs; traffic model = per-lane
+    config-4 traffic x lanes (each lane streams its own feasibility and
+    evolves its own usage copy)."""
+    import jax
+    import numpy as np
+
+    from nomad_tpu.models.fleet import NDIMS
+    from nomad_tpu.ops.binpack import place_rounds_batch
+    from nomad_tpu.parallel.devices import ensure_on_default
+    from nomad_tpu.scheduler.jax_binpack import JaxBinPackScheduler
+
+    sched = JaxBinPackScheduler(h.state.snapshot(), h, batch=False)
+    sched.eval = make_eval(job)
+    sched.defer_device = True
+    sched._begin()
+    _place, a = sched.deferred
+    cap_d, res_d = a.statics.device_capacity_reserved()
+    usage_d = ensure_on_default(None, a.view.usage)
+    jc_b = ensure_on_default(None, np.broadcast_to(
+        a.view.job_counts, (lanes,) + a.view.job_counts.shape).copy())
+    feas_b = ensure_on_default(None, np.broadcast_to(
+        a.feasible_h, (lanes,) + a.feasible_h.shape).copy())
+    asks_b = ensure_on_default(None, np.broadcast_to(
+        a.asks, (lanes,) + a.asks.shape).copy())
+    dist_b = ensure_on_default(None, np.broadcast_to(
+        a.distinct, (lanes,) + a.distinct.shape).copy())
+    counts_b = ensure_on_default(None, np.broadcast_to(
+        a.counts, (lanes,) + a.counts.shape).copy())
+    pen_b = ensure_on_default(None, np.full(
+        lanes, float(a.penalty), dtype=np.float32))
+
+    def run():
+        out = place_rounds_batch(cap_d, res_d, usage_d, jc_b, feas_b,
+                                 asks_b, dist_b, counts_b, pen_b,
+                                 k_cap=a.k_cap, rounds=a.rounds)
+        np.asarray(out[0])  # honest fence, see device_kernel_stats
+
+    run()  # compile
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        run()
+        best = min(best, time.perf_counter() - t0)
+    g_pad, n_pad = a.feasible_h.shape
+    est_bytes = lanes * g_pad * a.rounds * n_pad * (4 * NDIMS * 4 + 1)
+    return best, est_bytes
+
+
 def bench_storm_device(h, jobs, repeats: int):
     """One fused BatchEvalRunner dispatch for the whole storm."""
     from nomad_tpu.scheduler.batch import BatchEvalRunner
@@ -378,6 +485,13 @@ def main() -> None:
     bench_pipelined_stream(h4, jobs4, depth=args.depth)  # warm caches
     dev_s, dev_lats, _, seq_s, seq_lats, _ = bench_interleaved_stream(
         h4, jobs4, "service", depth=args.depth)
+    # Hardware grounding (SURVEY §6): one device dispatch of this shape,
+    # fenced by pulling the result back.  On the remote-attached chip
+    # this is ~one network round trip — the measurement that JUSTIFIES
+    # the executor policy (host numpy for single evals, device for the
+    # fused storm): per-eval compute is far below the RTT.
+    kernel_s, est_bytes = device_kernel_stats(h4, jobs4[0])
+    per_eval_s = dev_s / len(jobs4)
     configs["4_binpack_10kn_x_1ktg"] = {
         "evals_per_sec": round(len(jobs4) / dev_s, 3),
         "seq_evals_per_sec": round(len(jobs4) / seq_s, 3),
@@ -387,6 +501,15 @@ def main() -> None:
         "single_eval_speedup": round(lat_seq / lat_dev, 2),
         "p99_ms": round(_p(dev_lats, 99), 2),
         "seq_p99_ms": round(_p(seq_lats, 99), 2),
+        # Hardware terms: a single-eval device dispatch is RTT-bound
+        # on the remote-attached chip (deduped groups make its compute
+        # tiny), so this config runs the HOST executor and its device
+        # fraction is honestly 0 — the chip earns its keep on the fused
+        # storm (config 5) and multi-chip shapes.
+        "device_dispatch_rtt_ms": round(kernel_s * 1000.0, 1),
+        "approx_hbm_gb_per_eval": round(est_bytes / 1e9, 4),
+        "host_executor": True,
+        "device_fraction": 0.0,
         "bottleneck": ("per-eval host floor ~5-7ms: native bulk finish "
                        "(C alloc construction + port assignment, "
                        "native/port_alloc.cpp) ~2.5ms for 1k placements, "
@@ -407,6 +530,12 @@ def main() -> None:
          f"-> {lat_seq / lat_dev:.1f}x; remaining per-eval host work "
          f"~{dev_s / len(jobs4) * 1000:.1f}ms (native bulk finish "
          f"~2.5ms, kernel ~1ms, bookkeeping ~1ms; diff/prep memoized)")
+    note(f"config4 hardware: one fenced device dispatch of this shape "
+         f"costs {kernel_s * 1000:.0f}ms (remote-attach RTT; est HBM "
+         f"traffic only {est_bytes / 1e9:.3f}GB after group dedup) vs "
+         f"{per_eval_s * 1000:.1f}ms/eval host wall -> the executor "
+         f"policy keeps single evals host-side; the chip carries the "
+         f"fused storm (config 5)")
 
     # --- config 5: optimistic eval storm (headline) ----------------------
     h5 = _harness_with_nodes(args.nodes)
@@ -417,28 +546,35 @@ def main() -> None:
         jobs5.append(job)
     tune_gc()  # re-freeze the storm store
     bench_storm_device(h5, jobs5, 1)  # warm up device compile caches
-    profile = None
-    if args.profile_dir:
-        import jax
-        profile = jax.profiler.trace(args.profile_dir)
-        profile.__enter__()
-    # Interleaved symmetric best-of-N (see bench_interleaved_stream);
-    # the profiler trace brackets only the device reps.
+    # Interleaved symmetric best-of-N (see bench_interleaved_stream); a
+    # FRESH profiler trace brackets each device rep (jax.profiler.trace
+    # is a one-shot context manager — re-entering one instance raises).
     storm_dev, storm_seq = float("inf"), float("inf")
     storm_lats: list = []
     for _ in range(args.repeats):
-        if profile is not None:
-            profile.__enter__()
+        trace = None
+        if args.profile_dir:
+            import jax
+            trace = jax.profiler.trace(args.profile_dir)
+            trace.__enter__()
         storm_dev = min(storm_dev, bench_storm_device(h5, jobs5, 1))
-        if profile is not None:
-            profile.__exit__(None, None, None)
+        if trace is not None:
+            trace.__exit__(None, None, None)
         s_total, s_lats, _ = _sequential_rep(h5, jobs5, "service")
         if s_total < storm_seq:
             storm_seq, storm_lats = s_total, s_lats
-    if profile is not None:
+    if args.profile_dir:
         note(f"profile trace written to {args.profile_dir}")
     storm_eps = args.storm_jobs / storm_dev
     storm_seq_eps = args.storm_jobs / storm_seq
+    sk_s, sk_bytes = storm_kernel_stats(h5, jobs5[0], args.storm_jobs)
+    # Device compute = fused-dispatch wall minus the RTT floor the
+    # config-4 probe measured; the scan-structured kernel is LATENCY-
+    # bound (tiny sequential steps), so achieved bandwidth sits far
+    # below the HBM roofline — the win is batching 64 evals into one
+    # dispatch, not saturating HBM.
+    sk_compute = max(sk_s - kernel_s, 1e-4)
+    sk_gbps = sk_bytes / sk_compute / 1e9
     configs["5_storm_64x"] = {
         "evals_per_sec": round(storm_eps, 2),
         "seq_evals_per_sec": round(storm_seq_eps, 2),
@@ -446,11 +582,24 @@ def main() -> None:
         "storm_jobs": args.storm_jobs,
         "storm_groups": args.storm_groups,
         "seq_p99_ms": round(_p(storm_lats, 99), 2),
+        # Hardware terms for the fused [B, G, N] dispatch.
+        "kernel_wall_ms": round(sk_s * 1000.0, 1),
+        "kernel_compute_ms": round(sk_compute * 1000.0, 1),
+        "device_fraction": round(min(1.0, sk_s / storm_dev), 3),
+        "approx_hbm_gb": round(sk_bytes / 1e9, 2),
+        "achieved_hbm_gbps": round(sk_gbps, 1),
+        "hbm_roofline_fraction": round(sk_gbps / HBM_NOMINAL_GBPS, 4),
+        "roofline_note": ("scan-latency-bound, not bandwidth-bound: "
+                          "the fused win is 64 evals per dispatch"),
     }
     note(f"config5 storm {args.storm_jobs} evals x {args.storm_groups}tg "
          f"on {args.nodes}n: device {storm_dev:.3f}s ({storm_eps:.1f}/s) "
          f"vs sequential {storm_seq:.3f}s ({storm_seq_eps:.1f}/s) -> "
-         f"{storm_eps / storm_seq_eps:.1f}x")
+         f"{storm_eps / storm_seq_eps:.1f}x; fused kernel wall "
+         f"{sk_s * 1000:.0f}ms ({min(1.0, sk_s / storm_dev):.0%} of "
+         f"storm wall), ~{sk_gbps:.1f} GB/s achieved of "
+         f"~{HBM_NOMINAL_GBPS:.0f} nominal -> scan-latency-bound; "
+         f"the fused win is batching, not bandwidth")
 
     # --- config 5b: contended storm WITH plan-apply conflicts ------------
     # BASELINE.md config 5 spells out "with plan_apply conflicts": a

@@ -243,6 +243,8 @@ struct Interned {
   PyObject* client_status = nullptr;
   PyObject* scores = nullptr;
   PyObject* coalesced = nullptr;
+  PyObject* lazy_score_key = nullptr;
+  PyObject* lazy_score_val = nullptr;
   PyObject* dunder_new = nullptr;
   PyObject* dunder_dict = nullptr;
   PyObject* proposed_allocs = nullptr;
@@ -274,6 +276,8 @@ Interned& interned() {
     s.client_status = PyUnicode_InternFromString("client_status");
     s.scores = PyUnicode_InternFromString("scores");
     s.coalesced = PyUnicode_InternFromString("coalesced_failures");
+    s.lazy_score_key = PyUnicode_InternFromString("_lazy_score_key");
+    s.lazy_score_val = PyUnicode_InternFromString("_lazy_score_val");
     s.dunder_new = PyUnicode_InternFromString("__new__");
     s.dunder_dict = PyUnicode_InternFromString("__dict__");
     s.proposed_allocs = PyUnicode_InternFromString("proposed_allocs");
@@ -304,24 +308,6 @@ PyObject* make_instance(PyObject* cls, PyObject* d) {
     return nullptr;
   }
   return inst;
-}
-
-// Fresh metric dict from the proto + empty factory dicts.
-PyObject* metric_dict(PyObject* proto, PyObject* factory_names) {
-  PyObject* d = PyDict_Copy(proto);
-  if (!d) return nullptr;
-  Py_ssize_t n = PyTuple_GET_SIZE(factory_names);
-  for (Py_ssize_t i = 0; i < n; i++) {
-    PyObject* empty = PyDict_New();
-    if (!empty || PyDict_SetItem(d, PyTuple_GET_ITEM(factory_names, i),
-                                 empty) < 0) {
-      Py_XDECREF(empty);
-      Py_DECREF(d);
-      return nullptr;
-    }
-    Py_DECREF(empty);
-  }
-  return d;
 }
 
 // Accumulate one node's proposed-alloc network usage into (used, bw).
@@ -437,7 +423,7 @@ int node_base(PyObject* net_base, PyObject* base_fn, PyObject* ch_key,
 
 // bulk_finish(place, group_idx, chosen, scores, uuids, slots, nodes,
 //             node_net, net_base, base_fn, allocs_idx, ctx, plan_nu, plan_na,
-//             failed_list, alloc_proto, metric_proto, metric_factories,
+//             failed_list, alloc_proto, metric_proto,
 //             alloc_cls, metric_cls, res_cls, net_cls,
 //             statuses, coalesce_all, port_lcg, min_port, max_port)
 //   -> (n_done, port_lcg, failed_map)
@@ -455,16 +441,16 @@ PyObject* bulk_finish(PyObject*, PyObject* args) {
   PyObject *place, *group_idx, *chosen, *scores, *uuids, *slots, *nodes;
   PyObject *node_net, *net_base, *base_fn, *allocs_idx, *ctx, *plan_nu,
       *plan_na;
-  PyObject *failed_list, *alloc_proto, *metric_proto, *metric_factories;
+  PyObject *failed_list, *alloc_proto, *metric_proto;
   PyObject *alloc_cls, *metric_cls, *res_cls, *net_cls, *statuses;
   int coalesce_all;
   long long lcg;  // 64-bit: lcg*1103515245 overflows a 32-bit long
   long min_port, max_port;
   if (!PyArg_ParseTuple(
-          args, "OOOOOOOOOOOOOOOOOOOOOOOiLll", &place, &group_idx, &chosen,
+          args, "OOOOOOOOOOOOOOOOOOOOOOiLll", &place, &group_idx, &chosen,
           &scores, &uuids, &slots, &nodes, &node_net, &net_base, &base_fn,
           &allocs_idx, &ctx, &plan_nu, &plan_na, &failed_list, &alloc_proto,
-          &metric_proto, &metric_factories, &alloc_cls, &metric_cls,
+          &metric_proto, &alloc_cls, &metric_cls,
           &res_cls, &net_cls, &statuses, &coalesce_all, &lcg, &min_port,
           &max_port)) {
     return nullptr;
@@ -858,7 +844,10 @@ PyObject* bulk_finish(PyObject*, PyObject* args) {
     }
 
     // --- metric + alloc construction --------------------------------
-    PyObject* md = metric_dict(metric_proto, metric_factories);
+    // Lazy AllocMetric: only the proto copy + the one binpack score as
+    // two scalars; factory dicts + the scores dict materialize on
+    // first read (AllocMetric.__getattr__ in structs/model.py).
+    PyObject* md = PyDict_Copy(metric_proto);
     if (!md) {
       Py_XDECREF(out_trs);
       Py_XDECREF(node_id);
@@ -869,10 +858,8 @@ PyObject* bulk_finish(PyObject*, PyObject* args) {
     if (node_id) {
       PyObject* key = PyUnicode_Concat(node_id, I.binpack_suffix);
       PyObject* sv = key ? PyFloat_FromDouble(score) : nullptr;
-      PyObject* sd = sv ? PyDict_New() : nullptr;
-      if (!sd || PyDict_SetItem(sd, key, sv) < 0 ||
-          PyDict_SetItem(md, I.scores, sd) < 0) {
-        Py_XDECREF(sd);
+      if (!sv || PyDict_SetItem(md, I.lazy_score_key, key) < 0 ||
+          PyDict_SetItem(md, I.lazy_score_val, sv) < 0) {
         Py_XDECREF(sv);
         Py_XDECREF(key);
         Py_DECREF(md);
@@ -882,8 +869,6 @@ PyObject* bulk_finish(PyObject*, PyObject* args) {
         Py_DECREF(tg);
         goto fail;
       }
-      gc_untrack(sd);
-      Py_DECREF(sd);
       Py_DECREF(sv);
       Py_DECREF(key);
     }

@@ -415,11 +415,18 @@ def _dump_alloc_status(alloc, indent: str = "    ") -> None:
         print(f"{sub}* Score {name!r} = {score:.3f}")
 
 
+MONITOR_MAX_CHAIN = 256  # rolling-update evals followed before bailing
+
+
 def _monitor_eval(client: APIClient, eval_id: str,
                   timeout: float = 60.0) -> int:
     """Poll an eval until terminal, then report its allocations;
     follows rolling-update eval chains, with ``timeout`` bounding each
-    eval in the chain (reference command/monitor.go)."""
+    eval in the chain (reference command/monitor.go).  Total runtime is
+    bounded: stagger sleeps are capped at ``timeout`` and at most
+    MONITOR_MAX_CHAIN chained evals are followed, so a pathological
+    stagger or an endless chain can't hang the CLI."""
+    followed = 0
     while True:
         print(f"==> Monitoring evaluation \"{eval_id[:8]}\"")
         deadline = time.monotonic() + timeout
@@ -455,10 +462,21 @@ def _monitor_eval(client: APIClient, eval_id: str,
             # NEXT eval (next_rolling_eval sets its ``wait``; the
             # broker holds it that long), so fetch it and sleep that
             # out before the per-eval poll deadline starts.
+            followed += 1
+            if followed >= MONITOR_MAX_CHAIN:
+                print(f"    Followed {followed} chained evaluations; "
+                      "giving up (job keeps rolling server-side)",
+                      file=sys.stderr)
+                return 1
             nxt, _ = client.eval_info(ev.next_eval)
+            # Sleep the FULL stagger (capping below it would time the
+            # next eval out while the broker still holds it), bounded
+            # by an absolute 1h ceiling so a pathological stagger
+            # can't hang the CLI forever.
+            wait = min(nxt.wait, 3600.0)
             print(f"==> Monitoring next evaluation "
-                  f"\"{ev.next_eval[:8]}\" in {nxt.wait:.0f}s")
-            time.sleep(nxt.wait)
+                  f"\"{ev.next_eval[:8]}\" in {wait:.0f}s")
+            time.sleep(wait)
             eval_id = ev.next_eval
             continue
         return 0 if ev.status == "complete" else 2

@@ -118,10 +118,12 @@ class DockerDriver(Driver):
 
     def _ensure_image(self, image: str) -> str:
         """Pull-if-absent; for ``:latest`` (explicit or implied) a
-        refresh pull is attempted on every start, falling back to a
-        locally cached image when the registry is unreachable — the
-        freshness pull is best-effort, offline nodes still run
-        (reference docker.go:285-310).  Returns the image id."""
+        refresh pull is attempted on every start.  DELIBERATE DIVERGENCE
+        from the reference (docker.go:285-310, which fails the task when
+        the pull fails even if the image is cached locally): here the
+        freshness pull is best-effort and a locally cached image still
+        runs, so offline/rate-limited nodes keep serving (also noted in
+        PARITY.md).  Returns the image id."""
         tag = image.rsplit(":", 1)[1] if ":" in image.split("/")[-1] \
             else "latest"
         image_id = None if tag == "latest" else self._image_id(image)

@@ -124,6 +124,27 @@ class FleetStatics:
             self.device_cache["capres"] = hit
         return hit
 
+    def device_capacity_reserved_sharded(self, mesh):
+        """Mesh-resident (node-axis-sharded) capacity/reserved, uploaded
+        once per (fleet generation, mesh) and reused by every fused
+        multi-chip dispatch.  Keyed per mesh (bounded): _mesh_for hands
+        out different meshes for different fused batch sizes, and
+        alternating sizes must not thrash the residency."""
+        per_mesh = self.device_cache.setdefault("capres_mesh", {})
+        hit = per_mesh.get(mesh)
+        if hit is None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from nomad_tpu.parallel.mesh import FLEET_AXIS
+            if len(per_mesh) >= 4:
+                per_mesh.clear()
+            node = NamedSharding(mesh, P(FLEET_AXIS))
+            hit = (jax.device_put(self.capacity, node),
+                   jax.device_put(self.reserved, node))
+            per_mesh[mesh] = hit
+        return hit
+
 
 def build_fleet(nodes: list[Node]) -> FleetStatics:
     n_real = len(nodes)
@@ -319,6 +340,12 @@ class UsageMirror:
         # Invariant: _usage_d is None or exactly equals self.usage.
         self._usage_d = None
         self._scatters_since_upload = 0
+        # Mesh twins of _usage_d: node-axis-sharded resident copies for
+        # the fused multi-chip dispatch, one per mesh (bounded — see
+        # device_usage_sharded), maintained by the same scatters.
+        # Invariant: every value exactly equals self.usage.
+        self._usage_m: dict = {}      # mesh -> sharded jax array
+        self._m_scatters: dict = {}   # mesh -> scatters since upload
         # Per-node port/bandwidth tracking for the vectorized plan
         # verifier (server/plan_apply).  Disabled until sync_net() is
         # first called so scheduler-only users pay nothing; once
@@ -454,6 +481,8 @@ class UsageMirror:
         self.alloc_rows = rows
         self.rebuilds += 1
         self._usage_d = None
+        self._usage_m.clear()
+        self._m_scatters.clear()
         if self._net_ready:
             self._rebuild_net(table)
 
@@ -585,19 +614,35 @@ class UsageMirror:
     # -- device mirror -----------------------------------------------------
     def _update_device(self, new_usage: np.ndarray,
                        touched_rows: set) -> None:
-        """Keep the device copy equal to the (about-to-be-installed) host
-        usage: scatter the touched rows, or drop the copy when a fresh
-        upload is cheaper.  Called under the lock from _apply_deltas."""
-        if self._usage_d is None:
+        """Keep the device copies (single-device and mesh-sharded) equal
+        to the (about-to-be-installed) host usage: scatter the touched
+        rows, or drop a copy when a fresh upload is cheaper.  Called
+        under the lock from _apply_deltas."""
+        if self._usage_d is None and not self._usage_m:
             return
-        if len(touched_rows) > self.MAX_SCATTER_ROWS or \
-                self._scatters_since_upload >= self.DEVICE_REFRESH_EVERY:
-            self._usage_d = None
-            return
-        idx = np.fromiter(touched_rows, dtype=np.int32,
-                          count=len(touched_rows))
-        self._usage_d = _scatter_rows(self._usage_d, idx, new_usage[idx])
-        self._scatters_since_upload += 1
+        big = len(touched_rows) > self.MAX_SCATTER_ROWS
+        idx = rows = None
+        if not big:
+            idx = np.fromiter(touched_rows, dtype=np.int32,
+                              count=len(touched_rows))
+            rows = new_usage[idx]
+        if self._usage_d is not None:
+            if big or self._scatters_since_upload >= \
+                    self.DEVICE_REFRESH_EVERY:
+                self._usage_d = None
+            else:
+                self._usage_d = _scatter_rows(self._usage_d, idx, rows)
+                self._scatters_since_upload += 1
+        for mesh in list(self._usage_m):
+            if big or self._m_scatters.get(mesh, 0) >= \
+                    self.DEVICE_REFRESH_EVERY:
+                del self._usage_m[mesh]
+                self._m_scatters.pop(mesh, None)
+            else:
+                self._usage_m[mesh] = _scatter_rows(
+                    self._usage_m[mesh], idx, rows)
+                self._m_scatters[mesh] = \
+                    self._m_scatters.get(mesh, 0) + 1
 
     def _device_usage_locked(self):
         from nomad_tpu.parallel.devices import ensure_on_default
@@ -612,6 +657,34 @@ class UsageMirror:
         use, then scatter-maintained alongside every host delta)."""
         with self._lock:
             return self._device_usage_locked()
+
+    def device_usage_sharded(self, mesh, expect_usage):
+        """Mesh-resident (node-axis-sharded) copy of the mirror's usage
+        for a fused multi-chip dispatch, or None when the mirror has
+        moved past the caller's view (``expect_usage`` is the view's
+        host array — the caller must then upload it itself).  Uploaded
+        on first use PER MESH (alternating fused batch sizes get
+        different meshes and must not thrash each other), scatter-
+        maintained alongside every host delta like the single-device
+        copy; bounded at a handful of resident meshes."""
+        with self._lock:
+            if self.usage is not expect_usage:
+                return None
+            buf = self._usage_m.get(mesh)
+            if buf is None:
+                import jax
+                from jax.sharding import NamedSharding, \
+                    PartitionSpec as P
+
+                from nomad_tpu.parallel.mesh import FLEET_AXIS
+                if len(self._usage_m) >= 4:
+                    self._usage_m.clear()
+                    self._m_scatters.clear()
+                node = NamedSharding(mesh, P(FLEET_AXIS))
+                buf = jax.device_put(self.usage, node)
+                self._usage_m[mesh] = buf
+                self._m_scatters[mesh] = 0
+            return buf
 
     # -- views -------------------------------------------------------------
     def _view_locked(self, plan, job_id: str) -> FleetView:

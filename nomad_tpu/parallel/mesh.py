@@ -57,6 +57,16 @@ def storm_mesh(lane_ways: int, devices=None) -> Mesh:
     return Mesh(grid, (LANE_AXIS, FLEET_AXIS))
 
 
+def _put(x, sharding):
+    """device_put that skips arrays already resident with the target
+    sharding — the seam that lets mesh-resident fleet tensors (the
+    sharded usage mirror, cached capacity/reserved) flow into the
+    sharded kernels without a per-dispatch upload."""
+    if getattr(x, "sharding", None) == sharding:
+        return x
+    return jax.device_put(x, sharding)
+
+
 def _shardings(mesh: Mesh):
     node = NamedSharding(mesh, P(FLEET_AXIS))          # [N, ...] row-sharded
     group_node = NamedSharding(mesh, P(None, FLEET_AXIS))  # [G, N]
@@ -85,11 +95,11 @@ def shard_fleet_arrays(mesh: Mesh, capacity, reserved, usage, job_counts,
     """Place fleet tensors on the mesh, node axis sharded."""
     node, group_node, repl = _shardings(mesh)
     return (
-        jax.device_put(capacity, node),
-        jax.device_put(reserved, node),
-        jax.device_put(usage, node),
-        jax.device_put(job_counts, node),
-        jax.device_put(feasible, group_node),
+        _put(capacity, node),
+        _put(reserved, node),
+        _put(usage, node),
+        _put(job_counts, node),
+        _put(feasible, group_node),
     )
 
 
@@ -113,10 +123,10 @@ def place_sequence_sharded(mesh: Mesh, capacity, reserved, usage0,
     capacity, reserved, usage0, job_counts0, feasible = shard_fleet_arrays(
         mesh, capacity, reserved, usage0, job_counts0, feasible)
     _, _, repl = _shardings(mesh)
-    asks = jax.device_put(asks, repl)
-    distinct = jax.device_put(distinct, repl)
-    group_idx = jax.device_put(group_idx, repl)
-    valid = jax.device_put(valid, repl)
+    asks = _put(asks, repl)
+    distinct = _put(distinct, repl)
+    group_idx = _put(group_idx, repl)
+    valid = _put(valid, repl)
     return _place_sharded(capacity, reserved, usage0, job_counts0, feasible,
                           asks, distinct, group_idx, valid, penalty)
 
@@ -147,9 +157,9 @@ def place_rounds_sharded(mesh: Mesh, capacity, reserved, usage0, jc0,
     capacity, reserved, usage0, jc0, feasible = shard_fleet_arrays(
         mesh, capacity, reserved, usage0, jc0, feasible)
     _, _, repl = _shardings(mesh)
-    asks = jax.device_put(asks, repl)
-    distinct = jax.device_put(distinct, repl)
-    counts = jax.device_put(counts, repl)
+    asks = _put(asks, repl)
+    distinct = _put(distinct, repl)
+    counts = _put(counts, repl)
     return _place_rounds_sharded_jit(capacity, reserved, usage0, jc0,
                                      feasible, asks, distinct, counts,
                                      penalty, k_cap=k_cap, rounds=rounds)
@@ -176,15 +186,15 @@ def place_rounds_batch_sharded(mesh: Mesh, capacity, reserved, usage0, jc0,
     across mesh rows while each row's fleet slice stays HBM-resident
     (B x G x N feasibility sharded on lanes + N, base usage shared)."""
     node, lane_node, lane_n, lane, repl = _batch_shardings(mesh)
-    capacity = jax.device_put(capacity, node)
-    reserved = jax.device_put(reserved, node)
-    usage0 = jax.device_put(usage0, node)
-    jc0 = jax.device_put(jc0, lane_n)
-    feasible = jax.device_put(feasible, lane_node)
-    asks = jax.device_put(asks, lane)
-    distinct = jax.device_put(distinct, lane)
-    counts = jax.device_put(counts, lane)
-    penalty = jax.device_put(penalty, repl)
+    capacity = _put(capacity, node)
+    reserved = _put(reserved, node)
+    usage0 = _put(usage0, node)
+    jc0 = _put(jc0, lane_n)
+    feasible = _put(feasible, lane_node)
+    asks = _put(asks, lane)
+    distinct = _put(distinct, lane)
+    counts = _put(counts, lane)
+    penalty = _put(penalty, repl)
     return _place_rounds_batch_sharded_jit(
         capacity, reserved, usage0, jc0, feasible, asks, distinct, counts,
         penalty, k_cap=k_cap, rounds=rounds)
@@ -207,16 +217,16 @@ def place_sequence_batch_sharded(mesh: Mesh, capacity, reserved, usage0,
     lane axis also shards on a 2-D ``storm_mesh`` (see
     place_rounds_batch_sharded)."""
     node, lane_node, lane_n, lane, repl = _batch_shardings(mesh)
-    capacity = jax.device_put(capacity, node)
-    reserved = jax.device_put(reserved, node)
-    usage0 = jax.device_put(usage0, node)
-    jc0 = jax.device_put(jc0, lane_n)
-    feasible = jax.device_put(feasible, lane_node)
-    asks = jax.device_put(asks, lane)
-    distinct = jax.device_put(distinct, lane)
-    group_idx = jax.device_put(group_idx, lane)
-    valid = jax.device_put(valid, lane)
-    penalty = jax.device_put(penalty, repl)
+    capacity = _put(capacity, node)
+    reserved = _put(reserved, node)
+    usage0 = _put(usage0, node)
+    jc0 = _put(jc0, lane_n)
+    feasible = _put(feasible, lane_node)
+    asks = _put(asks, lane)
+    distinct = _put(distinct, lane)
+    group_idx = _put(group_idx, lane)
+    valid = _put(valid, lane)
+    penalty = _put(penalty, repl)
     return _place_sequence_batch_sharded_jit(
         capacity, reserved, usage0, jc0, feasible, asks, distinct,
         group_idx, valid, penalty)

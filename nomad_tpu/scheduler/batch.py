@@ -237,13 +237,26 @@ class BatchEvalRunner:
         penalty = np.asarray([a.penalty for _, _, a in pending],
                              dtype=np.float32)
 
-        capacity_d, reserved_d = statics.device_capacity_reserved()
-        # All fused lanes share the same snapshot base usage (fast-path
-        # contract above); use the mirror's device-resident copy when the
-        # first lane's view carries one (no upload).
-        base_usage = pending[0][2].view.dispatch_usage()
-
         mesh = _mesh_for(B, statics.n_pad)
+        # All fused lanes share the same snapshot base usage (fast-path
+        # contract above); use the resident device copies when available
+        # (single-device mirror copy, or on a mesh the sharded statics +
+        # sharded usage mirror) so fleet tensors are not re-uploaded per
+        # dispatch.
+        view0 = pending[0][2].view
+        if mesh is not None:
+            capacity_d, reserved_d = \
+                statics.device_capacity_reserved_sharded(mesh)
+            base_usage = None
+            if view0.usage_device is not None and \
+                    statics.mirror is not None:
+                base_usage = statics.mirror.device_usage_sharded(
+                    mesh, view0.usage)
+            if base_usage is None:
+                base_usage = view0.usage  # mirror moved on: host upload
+        else:
+            capacity_d, reserved_d = statics.device_capacity_reserved()
+            base_usage = view0.dispatch_usage()
         if rounds_ok:
             # Fast path: top-k rounds — device steps scale with unique
             # groups x rounds, not with placements.

@@ -72,9 +72,10 @@ _RES_STATIC, _RES_FACTORIES = _proto_of(Resources)
 _NET_STATIC, _NET_FACTORIES = _proto_of(NetworkResource)
 
 # Native bulk finish (native/port_alloc.cpp bulk_finish): available only
-# when the C extension built AND every AllocMetric factory is a plain dict
-# (the C side creates dicts directly).  Resolved once — the answer can't
-# change within a process.
+# when the C extension built.  Resolved once — the answer can't change
+# within a process.  (AllocMetric's factory dicts are materialized
+# lazily by AllocMetric.__getattr__, so the C side no longer creates
+# them at all.)
 _NATIVE_BULK_CACHE: list = []
 
 
@@ -82,13 +83,9 @@ def _native_bulk():
     if not _NATIVE_BULK_CACHE:
         from nomad_tpu.utils.native import HAS_NATIVE, native
 
-        ok = HAS_NATIVE and hasattr(native, "bulk_finish") and \
-            all(fac is dict for _n, fac in _METRIC_FACTORIES)
+        ok = HAS_NATIVE and hasattr(native, "bulk_finish")
         _NATIVE_BULK_CACHE.append(native if ok else None)
     return _NATIVE_BULK_CACHE[0]
-
-
-_METRIC_FACTORY_NAMES = tuple(n for n, _f in _METRIC_FACTORIES)
 
 
 def run_bulk_finish(native, sched, place, group_l, chosen_l, scores_l,
@@ -108,7 +105,7 @@ def run_bulk_finish(native, sched, place, group_l, chosen_l, scores_l,
         sched._net_base_for,
         sched.state.allocs_node_index(), sched.ctx, plan.node_update,
         plan.node_allocation, plan.failed_allocs,
-        alloc_proto, metric_proto, _METRIC_FACTORY_NAMES,
+        alloc_proto, metric_proto,
         Allocation, AllocMetric, Resources, NetworkResource,
         (ALLOC_DESIRED_STATUS_RUN, ALLOC_CLIENT_STATUS_PENDING,
          ALLOC_DESIRED_STATUS_FAILED, ALLOC_CLIENT_STATUS_FAILED,
@@ -814,12 +811,13 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
                            job=job)
 
         def fast_metric(score_key=None, score=0.0) -> AllocMetric:
+            # Lazy form: factory dicts + the scores dict materialize on
+            # first read (AllocMetric.__getattr__).
             m = AllocMetric.__new__(AllocMetric)
             d = dict(metric_proto)
-            for nm, fac in _METRIC_FACTORIES:
-                d[nm] = fac()
             if score_key is not None:
-                d["scores"][score_key] = score
+                d["_lazy_score_key"] = score_key
+                d["_lazy_score_val"] = score
             m.__dict__ = d
             return m
 

@@ -50,7 +50,6 @@ from nomad_tpu.structs.funcs import score_fit_vec
 
 from .jax_binpack import (
     _ALLOC_STATIC,
-    _METRIC_FACTORIES,
     _METRIC_STATIC,
     FastPlacementMixin,
     _native_bulk,
@@ -296,9 +295,7 @@ class VectorSystemScheduler(SystemScheduler, FastPlacementMixin):
                     continue
 
             m = AllocMetric.__new__(AllocMetric)
-            md = dict(metric_proto)
-            for nm, fac in _METRIC_FACTORIES:
-                md[nm] = fac()
+            md = dict(metric_proto)  # factory dicts materialize lazily
             alloc = Allocation.__new__(Allocation)
             d = dict(alloc_proto)
             d["id"] = uuids[p]
@@ -308,7 +305,8 @@ class VectorSystemScheduler(SystemScheduler, FastPlacementMixin):
             d["metrics"] = m
             d["task_states"] = {}
             if ok:
-                md["scores"] = {node.id + ".binpack": float(scores_l[p])}
+                md["_lazy_score_key"] = node.id + ".binpack"
+                md["_lazy_score_val"] = float(scores_l[p])
                 d["node_id"] = node.id
                 d["task_resources"] = task_resources
                 d["desired_status"] = ALLOC_DESIRED_STATUS_RUN

@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import random
 import threading
+import weakref
 from dataclasses import dataclass, field
 from types import MappingProxyType
 from typing import Callable, Mapping, Optional
@@ -215,25 +216,27 @@ def diff_system_allocs(job: Job, nodes: list, tainted_nodes: dict,
 
 
 # Ready-set memo: the scan below is O(fleet) and runs once per eval; its
-# result only changes when the nodes table changes.  Keyed on the store
-# generation's (lineage, nodes index) — lineage is identity-preserved
-# across snapshots/clones and replaced wholesale by snapshot restore, and
-# any node write (status, drain, register) bumps the nodes index, so a
-# hit is always current.  Bounded; callers get a fresh list (they
-# shuffle in place).  Locked: scheduler workers call this concurrently.
-_READY_CACHE: dict = {}
-_READY_CACHE_MAX = 16
+# result only changes when the nodes table changes.  Keyed PER LINEAGE
+# in a WeakKeyDictionary — lineage is identity-preserved across
+# snapshots/clones and replaced wholesale by snapshot restore, so a dead
+# world's entries free themselves when its store drops the token, while
+# several live stores in one process (test rigs, multi-server dev
+# agents) each keep their own bounded sub-cache.  Any node write bumps
+# the nodes index, so a hit is always current.  Callers get a fresh
+# list (they shuffle in place).  Locked: workers call this concurrently.
+_READY_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_READY_CACHE_MAX = 16  # per lineage
 _READY_CACHE_LOCK = threading.Lock()
 
 
 def ready_nodes_in_dcs(state, datacenters: list) -> list:
     tables = getattr(state, "_t", None)
-    key = None
+    key = sub = None
     if tables is not None:
-        key = (tables.lineage, tables.indexes["nodes"],
-               tuple(sorted(datacenters)))
+        key = (tables.indexes["nodes"], tuple(sorted(datacenters)))
         with _READY_CACHE_LOCK:
-            hit = _READY_CACHE.get(key)
+            sub = _READY_CACHE.get(tables.lineage)
+            hit = sub.get(key) if sub is not None else None
             if hit is not None:
                 return list(hit)
     dc_set = set(datacenters)
@@ -248,9 +251,12 @@ def ready_nodes_in_dcs(state, datacenters: list) -> list:
         out.append(node)
     if key is not None:
         with _READY_CACHE_LOCK:
-            while len(_READY_CACHE) >= _READY_CACHE_MAX:
-                _READY_CACHE.pop(next(iter(_READY_CACHE)), None)
-            _READY_CACHE[key] = out
+            sub = _READY_CACHE.get(tables.lineage)
+            if sub is None:
+                sub = _READY_CACHE[tables.lineage] = {}
+            while len(sub) >= _READY_CACHE_MAX:
+                sub.pop(next(iter(sub)), None)
+            sub[key] = out
         return list(out)
     return out
 

@@ -76,6 +76,12 @@ class StateWatch:
                 ev.set()
 
 
+class _LineageToken:
+    """Weakref-able identity token (bare ``object()`` is not)."""
+
+    __slots__ = ("__weakref__",)
+
+
 class _Tables:
     """One immutable-once-shared generation of all table + index dicts."""
 
@@ -102,8 +108,10 @@ class _Tables:
         # Lineage token: identity preserved across clones and changelog
         # compaction, REPLACED by snapshot restore — a mirror synced under
         # a different lineage must rebuild even if the raft index matches
-        # (the world was swapped wholesale).
-        self.lineage: object = object()
+        # (the world was swapped wholesale).  Weakref-able on purpose:
+        # per-lineage caches (scheduler/util._READY_CACHE) key on it with
+        # a WeakKeyDictionary so a dead world's entries free themselves.
+        self.lineage: object = _LineageToken()
 
     def clone(self) -> "_Tables":
         new = _Tables.__new__(_Tables)

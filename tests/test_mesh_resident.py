@@ -1,0 +1,85 @@
+"""Mesh-resident fleet tensors (the sharded usage mirror + statics).
+
+The fused multi-chip dispatch must not re-upload capacity/reserved/usage
+per call: statics cache a (mesh, capacity, reserved) triple, the
+UsageMirror keeps a node-axis-sharded twin of its usage maintained by
+the same scatter deltas as the single-device copy, and mesh._put skips
+placement for already-resident shardings.
+"""
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import nomad_tpu.mock as mock
+from nomad_tpu.models.fleet import fleet_cache, mirror_for
+from nomad_tpu.parallel.mesh import FLEET_AXIS, fleet_mesh, _put
+from nomad_tpu.state.store import StateStore
+from tests.test_plan_verify_vec import bump, make_alloc
+
+
+def _rig(n_nodes=16):
+    state = StateStore()
+    nodes = [mock.node(i) for i in range(n_nodes)]
+    idx = 10
+    for n in nodes:
+        state.upsert_node(idx, n)
+        idx += 1
+    return state, nodes, [idx]
+
+
+def test_statics_sharded_capres_cached():
+    state, nodes, cell = _rig()
+    statics = fleet_cache.statics_for(state)
+    mesh = fleet_mesh(jax.devices("cpu")[:8])
+    cap1, res1 = statics.device_capacity_reserved_sharded(mesh)
+    cap2, res2 = statics.device_capacity_reserved_sharded(mesh)
+    assert cap1 is cap2 and res1 is res2  # resident, no re-upload
+    node_sh = NamedSharding(mesh, P(FLEET_AXIS))
+    assert cap1.sharding == node_sh
+    np.testing.assert_array_equal(np.asarray(cap1), statics.capacity)
+    # A different mesh re-uploads.
+    mesh2 = fleet_mesh(jax.devices("cpu")[:4])
+    cap3, _ = statics.device_capacity_reserved_sharded(mesh2)
+    assert cap3 is not cap1
+
+
+def test_put_skips_resident_arrays():
+    mesh = fleet_mesh(jax.devices("cpu")[:8])
+    sh = NamedSharding(mesh, P(FLEET_AXIS))
+    x = np.arange(32, dtype=np.float32).reshape(16, 2)
+    a = _put(x, sh)
+    assert _put(a, sh) is a  # no-op on resident sharding
+
+
+def test_mirror_sharded_usage_scatter_maintained():
+    state, nodes, cell = _rig()
+    statics = fleet_cache.statics_for(state)
+    mirror = mirror_for(statics)
+    mirror.sync(state)
+    mesh = fleet_mesh(jax.devices("cpu")[:8])
+
+    us1 = mirror.device_usage_sharded(mesh, mirror.usage)
+    assert us1 is not None
+    assert us1.sharding == NamedSharding(mesh, P(FLEET_AXIS))
+    np.testing.assert_allclose(np.asarray(us1), mirror.usage)
+    # Same view, same mesh: resident identity.
+    assert mirror.device_usage_sharded(mesh, mirror.usage) is us1
+
+    # Commit deltas; incremental scatter must track the host mirror and
+    # keep the sharding.
+    state.upsert_allocs(bump(cell), [make_alloc(nodes[3], cpu=700),
+                                     make_alloc(nodes[5], cpu=900)])
+    mirror.sync(state)
+    us2 = mirror.device_usage_sharded(mesh, mirror.usage)
+    assert us2 is not None and us2 is not us1
+    assert us2.sharding == NamedSharding(mesh, P(FLEET_AXIS))
+    np.testing.assert_allclose(np.asarray(us2), mirror.usage)
+
+    # A stale view (the mirror has moved past it) gets None, never a
+    # silently-wrong resident buffer.
+    stale = mirror.usage
+    state.upsert_allocs(bump(cell), [make_alloc(nodes[0], cpu=100)])
+    mirror.sync(state)
+    assert mirror.device_usage_sharded(mesh, stale) is None
+    fresh = mirror.device_usage_sharded(mesh, mirror.usage)
+    np.testing.assert_allclose(np.asarray(fresh), mirror.usage)
