@@ -206,31 +206,51 @@ def bench_single_eval(h, job, scheduler: str, repeats: int):
 HBM_NOMINAL_GBPS = 819.0
 
 
-def device_kernel_stats(h, job, repeats: int = 5):
-    """Pure device time of the config-4 rounds kernel with resident
-    inputs, plus a rough HBM-traffic estimate, so the report grounds the
-    speedups in hardware terms (device_fraction + roofline) instead of
-    ratios alone.
-
-    Traffic model: per slot x round, score_all_nodes streams the four
-    [N, D] f32 fleet tensors (capacity/reserved/usage/job-counts) and
-    one [N] bool feasibility row -> G * rounds * N * (4*D*4 + 1) bytes.
-    An estimate, not a measurement — XLA keeps the scan carry in HBM and
-    may fuse reads — but it bounds the kernel's order of magnitude.
-    """
-    import jax
-    import numpy as np
-
-    from nomad_tpu.models.fleet import NDIMS
-    from nomad_tpu.ops.binpack import place_rounds
-    from nomad_tpu.parallel.devices import ensure_on_default
+def _deferred_args(h, job):
+    """One eval's deferred device args (the real scheduler prep)."""
     from nomad_tpu.scheduler.jax_binpack import JaxBinPackScheduler
 
     sched = JaxBinPackScheduler(h.state.snapshot(), h, batch=False)
     sched.eval = make_eval(job)
     sched.defer_device = True
     sched._begin()
-    _place, a = sched.deferred
+    return sched.deferred[1]
+
+
+def _best_of(run, repeats: int) -> float:
+    run()  # compile
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        run()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _est_traffic_bytes(a, lanes: int = 1) -> int:
+    """Rough HBM-traffic model: per slot x round, score_all_nodes
+    streams the four [N, D] f32 fleet tensors (capacity/reserved/
+    usage/job-counts) and one [N] bool feasibility row -> lanes * G *
+    rounds * N * (4*D*4 + 1) bytes.  An estimate, not a measurement —
+    XLA keeps the scan carry in HBM and may fuse reads — but it bounds
+    the kernel's order of magnitude."""
+    from nomad_tpu.models.fleet import NDIMS
+
+    g_pad, n_pad = a.feasible_h.shape
+    return lanes * g_pad * a.rounds * n_pad * (4 * NDIMS * 4 + 1)
+
+
+def device_kernel_stats(h, job, repeats: int = 5):
+    """Pure device time of the config-4 rounds kernel with resident
+    inputs, plus the rough HBM-traffic estimate (_est_traffic_bytes),
+    so the report grounds the speedups in hardware terms
+    (device_fraction + roofline) instead of ratios alone."""
+    import numpy as np
+
+    from nomad_tpu.ops.binpack import place_rounds
+    from nomad_tpu.parallel.devices import ensure_on_default
+
+    a = _deferred_args(h, job)
     cap_d, res_d = a.statics.device_capacity_reserved()
     feas_d = ensure_on_default(None, a.feasible_h)
     usage_d = ensure_on_default(None, a.view.usage)
@@ -246,15 +266,7 @@ def device_kernel_stats(h, job, repeats: int = 5):
         # honest fence, and it is what the scheduler does anyway.
         np.asarray(out[0])
 
-    run()  # compile
-    best = float("inf")
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        run()
-        best = min(best, time.perf_counter() - t0)
-    g_pad, n_pad = a.feasible_h.shape
-    est_bytes = g_pad * a.rounds * n_pad * (4 * NDIMS * 4 + 1)
-    return best, est_bytes
+    return _best_of(run, repeats), _est_traffic_bytes(a)
 
 
 def storm_kernel_stats(h, job, lanes: int, repeats: int = 2):
@@ -262,31 +274,22 @@ def storm_kernel_stats(h, job, lanes: int, repeats: int = 2):
     dispatch shape) with resident inputs; traffic model = per-lane
     config-4 traffic x lanes (each lane streams its own feasibility and
     evolves its own usage copy)."""
-    import jax
     import numpy as np
 
-    from nomad_tpu.models.fleet import NDIMS
     from nomad_tpu.ops.binpack import place_rounds_batch
     from nomad_tpu.parallel.devices import ensure_on_default
-    from nomad_tpu.scheduler.jax_binpack import JaxBinPackScheduler
 
-    sched = JaxBinPackScheduler(h.state.snapshot(), h, batch=False)
-    sched.eval = make_eval(job)
-    sched.defer_device = True
-    sched._begin()
-    _place, a = sched.deferred
+    a = _deferred_args(h, job)
     cap_d, res_d = a.statics.device_capacity_reserved()
     usage_d = ensure_on_default(None, a.view.usage)
-    jc_b = ensure_on_default(None, np.broadcast_to(
-        a.view.job_counts, (lanes,) + a.view.job_counts.shape).copy())
-    feas_b = ensure_on_default(None, np.broadcast_to(
-        a.feasible_h, (lanes,) + a.feasible_h.shape).copy())
-    asks_b = ensure_on_default(None, np.broadcast_to(
-        a.asks, (lanes,) + a.asks.shape).copy())
-    dist_b = ensure_on_default(None, np.broadcast_to(
-        a.distinct, (lanes,) + a.distinct.shape).copy())
-    counts_b = ensure_on_default(None, np.broadcast_to(
-        a.counts, (lanes,) + a.counts.shape).copy())
+
+    def lane_cast(x):
+        return ensure_on_default(None, np.broadcast_to(
+            x, (lanes,) + x.shape).copy())
+
+    jc_b, feas_b = lane_cast(a.view.job_counts), lane_cast(a.feasible_h)
+    asks_b, dist_b = lane_cast(a.asks), lane_cast(a.distinct)
+    counts_b = lane_cast(a.counts)
     pen_b = ensure_on_default(None, np.full(
         lanes, float(a.penalty), dtype=np.float32))
 
@@ -296,15 +299,7 @@ def storm_kernel_stats(h, job, lanes: int, repeats: int = 2):
                                  k_cap=a.k_cap, rounds=a.rounds)
         np.asarray(out[0])  # honest fence, see device_kernel_stats
 
-    run()  # compile
-    best = float("inf")
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        run()
-        best = min(best, time.perf_counter() - t0)
-    g_pad, n_pad = a.feasible_h.shape
-    est_bytes = lanes * g_pad * a.rounds * n_pad * (4 * NDIMS * 4 + 1)
-    return best, est_bytes
+    return _best_of(run, repeats), _est_traffic_bytes(a, lanes)
 
 
 def bench_storm_device(h, jobs, repeats: int):

@@ -1019,7 +1019,7 @@ PyMODINIT_FUNC PyInit__nomad_native(void) {
   // Bumped on any signature/behavior change of an existing function so a
   // stale prebuilt .so (same names, old ABI) is detected by the loader
   // (nomad_tpu/utils/native.py) instead of crashing mid-eval.
-  if (PyModule_AddIntConstant(m, "ABI_VERSION", 3) < 0) {
+  if (PyModule_AddIntConstant(m, "ABI_VERSION", 4) < 0) {
     Py_DECREF(m);
     return nullptr;
   }

@@ -423,9 +423,10 @@ def _monitor_eval(client: APIClient, eval_id: str,
     """Poll an eval until terminal, then report its allocations;
     follows rolling-update eval chains, with ``timeout`` bounding each
     eval in the chain (reference command/monitor.go).  Total runtime is
-    bounded: stagger sleeps are capped at ``timeout`` and at most
-    MONITOR_MAX_CHAIN chained evals are followed, so a pathological
-    stagger or an endless chain can't hang the CLI."""
+    bounded: stagger sleeps honor the job's full stagger but are capped
+    at an absolute 1h per hop, and at most MONITOR_MAX_CHAIN chained
+    evals are followed, so a pathological stagger or an endless chain
+    can't hang the CLI."""
     followed = 0
     while True:
         print(f"==> Monitoring evaluation \"{eval_id[:8]}\"")

@@ -127,22 +127,12 @@ class FleetStatics:
     def device_capacity_reserved_sharded(self, mesh):
         """Mesh-resident (node-axis-sharded) capacity/reserved, uploaded
         once per (fleet generation, mesh) and reused by every fused
-        multi-chip dispatch.  Keyed per mesh (bounded): _mesh_for hands
-        out different meshes for different fused batch sizes, and
-        alternating sizes must not thrash the residency."""
+        multi-chip dispatch (residency policy: _put_node_sharded)."""
         per_mesh = self.device_cache.setdefault("capres_mesh", {})
         hit = per_mesh.get(mesh)
         if hit is None:
-            import jax
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            from nomad_tpu.parallel.mesh import FLEET_AXIS
-            if len(per_mesh) >= 4:
-                per_mesh.clear()
-            node = NamedSharding(mesh, P(FLEET_AXIS))
-            hit = (jax.device_put(self.capacity, node),
-                   jax.device_put(self.reserved, node))
-            per_mesh[mesh] = hit
+            hit = _put_node_sharded(per_mesh, mesh,
+                                    (self.capacity, self.reserved))
         return hit
 
 
@@ -182,6 +172,31 @@ def build_fleet(nodes: list[Node]) -> FleetStatics:
         attr_rows=attr_rows,
         meta_rows=meta_rows,
     )
+
+
+def _put_node_sharded(cache: dict, mesh, arrays, counters=None,
+                      max_resident: int = 4):
+    """Upload ``arrays`` node-axis-sharded for ``mesh`` into ``cache``
+    and return the tuple.  ONE residency policy for every per-mesh
+    cache (statics capacity/reserved, the usage mirror's mesh twins):
+    bounded at ``max_resident`` meshes — everything is evicted at the
+    bound (alternating fused batch sizes get different meshes and must
+    not thrash each other below it) — with ``counters`` (a parallel
+    per-mesh dict, e.g. scatter counts) kept in sync."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from nomad_tpu.parallel.mesh import FLEET_AXIS
+    if len(cache) >= max_resident:
+        cache.clear()
+        if counters is not None:
+            counters.clear()
+    node = NamedSharding(mesh, P(FLEET_AXIS))
+    out = tuple(jax.device_put(a, node) for a in arrays)
+    cache[mesh] = out
+    if counters is not None:
+        counters[mesh] = 0
+    return out
 
 
 def net_base_for(statics: FleetStatics, node_index: int, node):
@@ -672,18 +687,10 @@ class UsageMirror:
                 return None
             buf = self._usage_m.get(mesh)
             if buf is None:
-                import jax
-                from jax.sharding import NamedSharding, \
-                    PartitionSpec as P
-
-                from nomad_tpu.parallel.mesh import FLEET_AXIS
-                if len(self._usage_m) >= 4:
-                    self._usage_m.clear()
-                    self._m_scatters.clear()
-                node = NamedSharding(mesh, P(FLEET_AXIS))
-                buf = jax.device_put(self.usage, node)
-                self._usage_m[mesh] = buf
-                self._m_scatters[mesh] = 0
+                (buf,) = _put_node_sharded(self._usage_m, mesh,
+                                           (self.usage,),
+                                           self._m_scatters)
+                self._usage_m[mesh] = buf  # store the bare array
             return buf
 
     # -- views -------------------------------------------------------------

@@ -1,0 +1,163 @@
+"""Opportunistic LIVE docker integration: runs real containers through
+AllocRunner/TaskRunner when a docker daemon is reachable, and skips
+cleanly otherwise — the same gating discipline as the reference's
+`dockerIsConnected` (client/driver/docker_test.go:20-60).
+
+Asserts the full driver contract against a real daemon: bind mounts
+(/alloc shared dir visible in-container), dynamic-port publishing,
+status aggregation through AllocRunner, and container cleanup.
+Image: ``busybox`` by default (override with NOMAD_TEST_DOCKER_IMAGE).
+"""
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import time
+
+import pytest
+
+from nomad_tpu.client.alloc_runner import AllocRunner
+from nomad_tpu.structs import (
+    Allocation,
+    Job,
+    NetworkResource,
+    Resources,
+    Task,
+    TaskGroup,
+    generate_uuid,
+)
+
+IMAGE = os.environ.get("NOMAD_TEST_DOCKER_IMAGE", "busybox")
+
+_READY: list = []  # memoized verdict, evaluated lazily at first test
+
+
+def _docker_ready() -> bool:
+    """Daemon reachable AND the test image present or pullable.  Every
+    subprocess call is timeout-bounded and exception-guarded so a hung
+    daemon or slow registry yields a SKIP, never a collection error."""
+    if _READY:
+        return _READY[0]
+    ok = False
+    try:
+        out = subprocess.run(["docker", "version", "--format",
+                              "{{.Server.Version}}"],
+                             capture_output=True, text=True, timeout=5)
+        if out.returncode == 0:
+            have = subprocess.run(["docker", "image", "inspect", "-f",
+                                   "{{.Id}}", IMAGE],
+                                  capture_output=True, timeout=10)
+            if have.returncode == 0:
+                ok = True
+            else:
+                pull = subprocess.run(["docker", "pull", IMAGE],
+                                      capture_output=True, timeout=120)
+                ok = pull.returncode == 0
+    except Exception:
+        ok = False
+    _READY.append(ok)
+    return ok
+
+
+# Lazy condition (string-less callable form would run at collection;
+# a deferred fixture keeps the probe out of `pytest tests/` entirely
+# unless these tests are selected).
+@pytest.fixture
+def docker_or_skip():
+    if not _docker_ready():
+        pytest.skip("docker daemon not reachable (reference skips the "
+                    "same way, docker_test.go:20-60)")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _docker_alloc(command: list, port: int | None = None) -> Allocation:
+    task = Task(
+        name="web", driver="docker",
+        config={"image": IMAGE, "command": command[0],
+                "args": command[1:]},
+        resources=Resources(cpu=100, memory_mb=64),
+    )
+    tg = TaskGroup(name="web", count=1, tasks=[task])
+    job = Job(id=generate_uuid(), name="live-docker", type="service",
+              task_groups=[tg])
+    nets = []
+    if port is not None:
+        # The scheduler's offer shape: assigned dynamic ports land in
+        # reserved_ports, labels in dynamic_ports (structs/model.py
+        # map_dynamic_ports).
+        nets = [NetworkResource(device="eth0", ip="127.0.0.1",
+                                reserved_ports=[port],
+                                dynamic_ports=["http"])]
+    return Allocation(
+        id=generate_uuid(), node_id="n1", job=job, job_id=job.id,
+        task_group="web",
+        resources=Resources(cpu=100, memory_mb=64, networks=nets),
+        task_resources={"web": Resources(cpu=100, memory_mb=64,
+                                         networks=nets)},
+        desired_status="run", client_status="pending",
+    )
+
+
+def _wait(cond, timeout=60.0, msg=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.25)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+@pytest.mark.slow
+def test_live_container_bind_mount_and_exit(tmp_path, docker_or_skip):
+    """A real container writes through the /alloc bind mount and exits;
+    AllocRunner aggregates to dead and the container is removed."""
+    alloc = _docker_alloc(["/bin/sh", "-c", "echo live > /alloc/out.txt"])
+    runner = AllocRunner(alloc, str(tmp_path / "alloc"))
+    runner.run()
+    _wait(lambda: runner.alloc.client_status == "dead",
+          msg="container exit")
+    out = os.path.join(runner.alloc_dir.shared_dir, "out.txt")
+    with open(out) as fh:
+        assert fh.read().strip() == "live"  # bind mount worked
+    name = f"nomad-{alloc.id[:8]}-web"
+    ps = subprocess.run(["docker", "ps", "-a", "--filter",
+                         f"name={name}", "--format", "{{.Names}}"],
+                        capture_output=True, text=True)
+    assert name not in ps.stdout  # cleanup removed the container
+
+
+@pytest.mark.slow
+def test_live_container_port_publish_and_kill(tmp_path, docker_or_skip):
+    """A long-running container publishes its assigned dynamic port;
+    destroy() stops and removes it."""
+    port = _free_port()
+    alloc = _docker_alloc(["/bin/sleep", "120"], port=port)
+    runner = AllocRunner(alloc, str(tmp_path / "alloc"))
+    runner.run()
+    name = f"nomad-{alloc.id[:8]}-web"
+
+    def running():
+        out = subprocess.run(["docker", "inspect", "-f",
+                              "{{.State.Running}}", name],
+                             capture_output=True, text=True)
+        return out.stdout.strip() == "true"
+
+    _wait(running, msg="container running")
+    ports = subprocess.run(["docker", "port", name],
+                           capture_output=True, text=True)
+    assert str(port) in ports.stdout  # dynamic port published
+
+    runner.destroy()
+    _wait(lambda: not running(), msg="container stopped")
+    ps = subprocess.run(["docker", "ps", "-a", "--filter",
+                         f"name={name}", "--format", "{{.Names}}"],
+                        capture_output=True, text=True)
+    assert name not in ps.stdout
