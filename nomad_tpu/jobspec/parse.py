@@ -62,7 +62,9 @@ def parse_file(path: str) -> Job:
 def _parse_job(obj: dict) -> Job:
     job = Job(
         id=obj.get("__label__", ""),
-        name=obj.get("__label__", ""),
+        # The label is the ID; an explicit ``name`` field may differ
+        # (reference test-fixtures/specify-job.hcl).
+        name=str(obj.get("name", obj.get("__label__", ""))),
         region=obj.get("region", "global"),
         type=obj.get("type", "service"),
         priority=int(obj.get("priority", 50)),
@@ -71,7 +73,10 @@ def _parse_job(obj: dict) -> Job:
         meta=_parse_meta(obj),
     )
     job.constraints = _parse_constraints(obj)
-    for upd in obj.get("update", []):
+    updates = obj.get("update", [])
+    if len(updates) > 1:
+        raise ParseError("only one 'update' block allowed per job")
+    for upd in updates:
         job.update = UpdateStrategy(
             stagger=_parse_duration(upd.get("stagger", 0)),
             max_parallel=int(upd.get("max_parallel", 0)),
@@ -116,7 +121,12 @@ def _parse_task(obj: dict) -> Task:
     for env in obj.get("env", []):
         task.env = {k: str(v) for k, v in env.items()
                     if k != "__label__"}
-    for res in obj.get("resources", []):
+    resources = obj.get("resources", [])
+    if len(resources) > 1:
+        # Message verbatim from the reference (parse.go parseResources),
+        # singular 'resource' and all, so error-matching stays portable.
+        raise ParseError("only one 'resource' block allowed per task")
+    for res in resources:
         task.resources = _parse_resources(res)
     return task
 
@@ -128,17 +138,29 @@ def _parse_resources(obj: dict) -> Resources:
         disk_mb=int(obj.get("disk", 0)),
         iops=int(obj.get("iops", 0)),
     )
-    for net in obj.get("network", []):
+    nets = obj.get("network", [])
+    if len(nets) > 1:
+        raise ParseError("only one 'network' resource allowed")
+    for net in nets:
         n = NetworkResource(
             mbits=int(net.get("mbits", 10)),
             reserved_ports=[int(p) for p in
                             net.get("reserved_ports", [])],
         )
+        # Labels become environment variables, so they must not collide
+        # case-insensitively (parse.go:411-426).
+        seen: dict = {}
         for label in net.get("dynamic_ports", []):
             label = str(label)
             if not _DYNAMIC_PORT_RE.match(label):
                 raise ParseError(
                     f"invalid dynamic port label {label!r}")
+            first = seen.get(label.lower())
+            if first is not None:
+                raise ParseError(
+                    f"Found a port label collision: `{label}` "
+                    f"overlaps with previous `{first}`")
+            seen[label.lower()] = label
             n.dynamic_ports.append(label)
         res.networks.append(n)
     return res

@@ -29,3 +29,16 @@ jax.config.update("jax_default_device", jax.devices("cpu")[0])
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running smoke tests (driver entry points)")
+
+
+def wait_until(fn, timeout=15.0, msg="condition"):
+    """The universal convergence helper (reference testutil/wait.go
+    WaitForResult); shared by the agent/HTTP suites."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timeout waiting for {msg}")

@@ -1,0 +1,295 @@
+"""Second scheduler-util scenario suite: the reference util_test.go /
+context_test.go / worker_test.go cases not covered by test_scheduler.py
+— shuffle, set_status eval chaining, the three inplace_update verdicts,
+the evict_and_place limit boundary cases, task_group_constraints
+aggregation, EvalContext.proposed_allocs, and the worker's
+missing-node plan refresh (worker_test.go:317-383)."""
+from __future__ import annotations
+
+import random
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler import EvalContext, GenericStack, Harness
+from nomad_tpu.scheduler.util import (
+    DiffResult,
+    evict_and_place,
+    inplace_update,
+    retry_max,
+    set_status,
+    shuffle_nodes,
+    task_group_constraints,
+    AllocTuple,
+)
+from nomad_tpu.structs import (
+    ALLOC_DESIRED_STATUS_RUN,
+    ALLOC_DESIRED_STATUS_STOP,
+    EVAL_STATUS_COMPLETE,
+    Allocation,
+    Constraint,
+    Evaluation,
+    NetworkResource,
+    Resources,
+    Task,
+    generate_uuid,
+)
+
+
+def _harness(n_nodes=4):
+    h = Harness()
+    nodes = [mock.node(i) for i in range(n_nodes)]
+    for n in nodes:
+        h.state.upsert_node(h.next_index(), n)
+    return h, nodes
+
+
+def _ctx(h):
+    from nomad_tpu.structs import Plan
+    return EvalContext(h.state.snapshot(), Plan())
+
+
+# ---------------------------------------------------------------------------
+# shuffle / retry / set_status (util_test.go:220-247, 290-312, 400-433)
+# ---------------------------------------------------------------------------
+
+def test_shuffle_nodes_permutes_in_place():
+    nodes = list(range(50))
+    orig = list(nodes)
+    shuffle_nodes(nodes, rng=random.Random(1))
+    assert sorted(nodes) == orig
+    assert nodes != orig  # 50 elements: astronomically unlikely to match
+
+
+def test_retry_max_counts_attempts():
+    calls = []
+
+    def cb():
+        calls.append(1)
+        return len(calls) >= 3
+
+    retry_max(5, cb)
+    assert len(calls) == 3
+
+    import pytest
+
+    from nomad_tpu.scheduler.interfaces import SetStatusError
+    with pytest.raises(SetStatusError):
+        retry_max(2, lambda: False)
+
+
+def test_set_status_links_next_eval():
+    h, _ = _harness(1)
+    job = mock.job()
+    ev = Evaluation(id=generate_uuid(), job_id=job.id, status="pending")
+    nxt = Evaluation(id=generate_uuid(), job_id=job.id)
+    set_status(h, ev, nxt, EVAL_STATUS_COMPLETE, "done")
+    updated = [e for e in h.evals if e.id == ev.id]
+    assert updated, "planner must receive the status update"
+    got = updated[-1]
+    assert got.status == EVAL_STATUS_COMPLETE
+    assert got.status_description == "done"
+    assert got.next_eval == nxt.id
+    # The original eval object is untouched (update is a copy).
+    assert ev.status == "pending"
+
+
+# ---------------------------------------------------------------------------
+# inplace_update verdicts (util_test.go:435-570)
+# ---------------------------------------------------------------------------
+
+def _existing_alloc(job, node, ev_id="e0"):
+    tg = job.task_groups[0]
+    a = Allocation(
+        id=generate_uuid(), eval_id=ev_id, node_id=node.id,
+        job=job, job_id=job.id, task_group=tg.name,
+        name=f"{job.name}.{tg.name}[0]",
+        resources=Resources(cpu=500, memory_mb=256),
+        task_resources={"web": Resources(
+            cpu=500, memory_mb=256,
+            networks=[NetworkResource(device="eth0", ip="1.2.3.4",
+                                      reserved_ports=[5000],
+                                      mbits=50)])},
+        desired_status=ALLOC_DESIRED_STATUS_RUN,
+    )
+    return a
+
+
+def _update_rig(h, job, nodes):
+    ev = Evaluation(id=generate_uuid(), job_id=job.id, priority=50)
+    ctx = _ctx(h)
+    stack = GenericStack(False, ctx)
+    stack.set_job(job)
+    return ev, ctx, stack
+
+
+def test_inplace_update_success_keeps_node_and_networks():
+    h, nodes = _harness(2)
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    alloc = _existing_alloc(job, nodes[0])
+    h.state.upsert_allocs(h.next_index(), [alloc])
+
+    # Same task group shape, bumped job version: in-place eligible.
+    new_job = mock.job()
+    new_job.id = job.id
+    new_job.name = job.name
+    new_job.task_groups = [tg.copy() for tg in job.task_groups]
+    ev, ctx, stack = _update_rig(h, new_job, nodes)
+    updates = [AllocTuple(alloc.name, new_job.task_groups[0], alloc)]
+    remaining = inplace_update(ctx, ev, new_job, stack, updates)
+    assert remaining == []
+    placed = [a for allocs in ctx.plan().node_allocation.values()
+              for a in allocs]
+    assert len(placed) == 1
+    got = placed[0]
+    assert got.id == alloc.id              # same alloc, updated in place
+    assert got.node_id == nodes[0].id      # never moves
+    # Network assignment is immutable across in-place updates.
+    assert got.task_resources["web"].networks[0].reserved_ports == [5000]
+    assert got.eval_id == ev.id
+
+
+def test_inplace_update_changed_task_group_is_destructive():
+    h, nodes = _harness(2)
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    alloc = _existing_alloc(job, nodes[0])
+    h.state.upsert_allocs(h.next_index(), [alloc])
+
+    new_job = mock.job()
+    new_job.id = job.id
+    new_job.task_groups = [tg.copy() for tg in job.task_groups]
+    # Adding a task forbids in-place (util.tasks_updated).
+    new_job.task_groups[0].tasks = list(new_job.task_groups[0].tasks) + [
+        Task(name="sidecar", driver="exec",
+             resources=Resources(cpu=50, memory_mb=32))]
+    ev, ctx, stack = _update_rig(h, new_job, nodes)
+    updates = [AllocTuple(alloc.name, new_job.task_groups[0], alloc)]
+    remaining = inplace_update(ctx, ev, new_job, stack, updates)
+    assert remaining == updates            # falls to evict + place
+    assert not ctx.plan().node_allocation
+
+
+def test_inplace_update_no_longer_fits_is_destructive():
+    h, nodes = _harness(1)
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    alloc = _existing_alloc(job, nodes[0])
+    # Another job fills the node so re-selection on it must fail.
+    filler = Allocation(
+        id=generate_uuid(), node_id=nodes[0].id, job_id="other",
+        task_group="f",
+        resources=Resources(cpu=3300, memory_mb=7600),
+        desired_status=ALLOC_DESIRED_STATUS_RUN)
+    h.state.upsert_allocs(h.next_index(), [alloc, filler])
+
+    new_job = mock.job()
+    new_job.id = job.id
+    new_job.task_groups = [tg.copy() for tg in job.task_groups]
+    # Same shape but a bigger ask than the speculative eviction frees.
+    new_job.task_groups[0].tasks[0].resources = Resources(
+        cpu=900, memory_mb=600,
+        networks=new_job.task_groups[0].tasks[0].resources.networks)
+    ev, ctx, stack = _update_rig(h, new_job, nodes)
+    updates = [AllocTuple(alloc.name, new_job.task_groups[0], alloc)]
+    remaining = inplace_update(ctx, ev, new_job, stack, updates)
+    assert remaining == updates
+
+
+# ---------------------------------------------------------------------------
+# evict_and_place limit boundaries (util_test.go:352-399, 571-594)
+# ---------------------------------------------------------------------------
+
+def _tuples(job, nodes, n):
+    tg = job.task_groups[0]
+    out = []
+    for i in range(n):
+        a = _existing_alloc(job, nodes[i % len(nodes)])
+        out.append(AllocTuple(f"{job.name}.{tg.name}[{i}]", tg, a))
+    return out
+
+
+def test_evict_and_place_limit_boundaries():
+    h, nodes = _harness(4)
+    job = mock.job()
+    for n_allocs, limit, want_limited, want_left in (
+            (4, 2, True, 0),    # less than allocs: budget exhausted
+            (4, 4, False, 0),   # equal: all moved, budget zero
+            (4, 6, False, 2)):  # greater: all moved, budget remains
+        ctx = _ctx(h)
+        diff = DiffResult()
+        budget = [limit]
+        limited = evict_and_place(ctx, diff, _tuples(job, nodes, n_allocs),
+                                  "test", budget)
+        assert limited is want_limited, (n_allocs, limit)
+        moved = min(n_allocs, limit)
+        assert len(diff.place) == moved
+        stops = sum(len(v) for v in ctx.plan().node_update.values())
+        assert stops == moved
+        assert budget[0] == want_left
+
+
+# ---------------------------------------------------------------------------
+# task_group_constraints aggregation (util_test.go:595+)
+# ---------------------------------------------------------------------------
+
+def test_task_group_constraints_aggregates():
+    tg = mock.job().task_groups[0]
+    tg.constraints = [Constraint(l_target="a", r_target="1")]
+    tg.tasks[0].constraints = [Constraint(l_target="b", r_target="2")]
+    tg.tasks.append(Task(name="extra", driver="qemu",
+                         resources=Resources(cpu=100, memory_mb=64),
+                         constraints=[Constraint(l_target="c",
+                                                 r_target="3")]))
+    c = task_group_constraints(tg)
+    assert {cc.l_target for cc in c.constraints} == {"a", "b", "c"}
+    assert c.drivers == {"exec", "qemu"}
+    want_cpu = sum(t.resources.cpu for t in tg.tasks)
+    assert c.size.cpu == want_cpu
+
+
+# ---------------------------------------------------------------------------
+# EvalContext.proposed_allocs (context_test.go:28-77)
+# ---------------------------------------------------------------------------
+
+def test_proposed_allocs_folds_plan_deltas():
+    h, nodes = _harness(1)
+    job = mock.job()
+    existing = _existing_alloc(job, nodes[0])
+    stopped = _existing_alloc(job, nodes[0])
+    stopped.desired_status = ALLOC_DESIRED_STATUS_STOP  # terminal: invisible
+    h.state.upsert_allocs(h.next_index(), [existing, stopped])
+
+    ctx = _ctx(h)
+    ids = {a.id for a in ctx.proposed_allocs(nodes[0].id)}
+    assert ids == {existing.id}
+
+    # Plan eviction removes it; plan placement adds the new one.
+    ctx.plan().append_update(existing, ALLOC_DESIRED_STATUS_STOP, "bye")
+    newcomer = _existing_alloc(job, nodes[0])
+    ctx.plan().append_alloc(newcomer)
+    ids = {a.id for a in ctx.proposed_allocs(nodes[0].id)}
+    assert ids == {newcomer.id}
+
+
+# ---------------------------------------------------------------------------
+# worker submit-plan missing-node refresh (worker_test.go:317-383)
+# ---------------------------------------------------------------------------
+
+def test_plan_on_unknown_node_is_dropped_with_refresh():
+    from nomad_tpu.server.plan_apply import evaluate_plan
+    from nomad_tpu.state.store import StateStore
+    from nomad_tpu.structs import Plan
+
+    state = StateStore()
+    known = mock.node(0)
+    state.upsert_node(10, known)
+    ghost = mock.node(99)  # never registered
+    job = mock.job()
+    plan = Plan(node_allocation={
+        known.id: [_existing_alloc(job, known)],
+        ghost.id: [_existing_alloc(job, ghost)],
+    })
+    result = evaluate_plan(state, plan)
+    assert known.id in result.node_allocation
+    assert ghost.id not in result.node_allocation
+    assert result.refresh_index > 0  # scheduler must refresh its state

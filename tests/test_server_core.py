@@ -636,3 +636,40 @@ class TestCoreGC:
             assert srv.fsm.state.eval_by_id(e2) is None
         finally:
             srv.shutdown()
+
+    def test_node_gc_deregisters_down_empty_nodes(self):
+        """Down nodes with no remaining allocs are deregistered; down
+        nodes still carrying allocs, and ready nodes, survive
+        (reference nomad/core_sched_test.go:72-130)."""
+        from nomad_tpu.server.core_sched import CoreScheduler
+        from nomad_tpu.structs import CORE_JOB_NODE_GC, NODE_STATUS_DOWN
+
+        srv = make_server()
+        srv.config.node_gc_threshold = 0.0
+        try:
+            empty_down = mock.node(1)
+            busy_down = mock.node(2)
+            alive = mock.node(3)
+            for n in (empty_down, busy_down, alive):
+                srv.node_register(n)
+            # An alloc pins busy_down.
+            a = mock.alloc()
+            a.node_id = busy_down.id
+            srv.raft_apply(codec.ALLOC_UPDATE_REQUEST,
+                           {"alloc": [a.to_dict()]})
+            for nid in (empty_down.id, busy_down.id):
+                srv.raft_apply(codec.NODE_UPDATE_STATUS_REQUEST,
+                               {"node_id": nid,
+                                "status": NODE_STATUS_DOWN})
+            srv.fsm.timetable.granularity = 0.0
+            srv.fsm.timetable.witness(srv.raft.applied_index() + 1,
+                                      time.time())
+            gc_eval = Evaluation(id=generate_uuid(), type="_core",
+                                 job_id=CORE_JOB_NODE_GC)
+            CoreScheduler(srv, srv.fsm.state.snapshot()).process(gc_eval)
+            state = srv.fsm.state
+            assert state.node_by_id(empty_down.id) is None
+            assert state.node_by_id(busy_down.id) is not None
+            assert state.node_by_id(alive.id) is not None
+        finally:
+            srv.shutdown()

@@ -1,0 +1,232 @@
+"""Second state-store scenario suite, mirroring the reference's table
+coverage (nomad/state/state_store_test.go): per-table CRUD + raft-index
+bumps, secondary-index maintenance on delete/replace, JobsByScheduler,
+eval deletion cascading to its allocations' index, client-vs-scheduler
+authoritative merge on replace, and restore of every table."""
+from __future__ import annotations
+
+from nomad_tpu import mock
+from nomad_tpu.state.store import StateStore
+from nomad_tpu.structs import (
+    ALLOC_DESIRED_STATUS_RUN,
+    ALLOC_DESIRED_STATUS_STOP,
+    Allocation,
+    Evaluation,
+    Resources,
+    generate_uuid,
+)
+
+
+def _alloc(node_id="n1", job_id="j1", eval_id="e1", **kw):
+    defaults = dict(
+        id=generate_uuid(), node_id=node_id, job_id=job_id,
+        eval_id=eval_id, task_group="web",
+        resources=Resources(cpu=100, memory_mb=64),
+        desired_status=ALLOC_DESIRED_STATUS_RUN,
+    )
+    defaults.update(kw)
+    return Allocation(**defaults)
+
+
+# ---------------------------------------------------------------------------
+# nodes (state_store_test.go:24-214)
+# ---------------------------------------------------------------------------
+
+def test_delete_node_removes_and_bumps_index():
+    s = StateStore()
+    n = mock.node(0)
+    s.upsert_node(1000, n)
+    assert s.get_index("nodes") == 1000
+    s.delete_node(1001, n.id)
+    assert s.node_by_id(n.id) is None
+    assert s.get_index("nodes") == 1001
+    assert list(s.nodes()) == []
+
+
+def test_nodes_iterates_all():
+    s = StateStore()
+    nodes = [mock.node(i) for i in range(5)]
+    for i, n in enumerate(nodes):
+        s.upsert_node(1000 + i, n)
+    assert {n.id for n in s.nodes()} == {n.id for n in nodes}
+
+
+def test_upsert_node_replaces_existing():
+    s = StateStore()
+    n = mock.node(0)
+    s.upsert_node(1000, n)
+    n2 = mock.node(0)
+    n2.id = n.id
+    n2.datacenter = "dc9"
+    s.upsert_node(1001, n2)
+    assert s.node_by_id(n.id).datacenter == "dc9"
+    assert len(list(s.nodes())) == 1
+
+
+# ---------------------------------------------------------------------------
+# jobs (state_store_test.go:215-443)
+# ---------------------------------------------------------------------------
+
+def test_update_job_keeps_create_index():
+    s = StateStore()
+    job = mock.job()
+    s.upsert_job(1000, job)
+    assert s.job_by_id(job.id).create_index == 1000
+    assert s.job_by_id(job.id).modify_index == 1000
+    j2 = mock.job()
+    j2.id = job.id
+    s.upsert_job(1010, j2)
+    got = s.job_by_id(job.id)
+    assert got.create_index == 1000      # preserved across update
+    assert got.modify_index == 1010      # bumped
+    assert s.get_index("jobs") == 1010
+
+
+def test_delete_job():
+    s = StateStore()
+    job = mock.job()
+    s.upsert_job(1000, job)
+    s.delete_job(1001, job.id)
+    assert s.job_by_id(job.id) is None
+    assert s.get_index("jobs") == 1001
+    assert list(s.jobs()) == []
+
+
+def test_jobs_by_scheduler():
+    s = StateStore()
+    svc, system = mock.job(), mock.system_job()
+    s.upsert_job(1000, svc)
+    s.upsert_job(1001, system)
+    assert [j.id for j in s.jobs_by_scheduler("service")] == [svc.id]
+    assert [j.id for j in s.jobs_by_scheduler("system")] == [system.id]
+    assert s.jobs_by_scheduler("batch") == []
+
+
+# ---------------------------------------------------------------------------
+# evals (state_store_test.go:502-746)
+# ---------------------------------------------------------------------------
+
+def test_upsert_evals_update_and_index():
+    s = StateStore()
+    ev = mock.eval()
+    s.upsert_evals(1000, [ev])
+    got = s.eval_by_id(ev.id)
+    assert got.create_index == 1000 and got.modify_index == 1000
+    ev2 = ev.copy()
+    ev2.status = "complete"
+    s.upsert_evals(1003, [ev2])
+    got = s.eval_by_id(ev.id)
+    assert got.status == "complete"
+    assert got.create_index == 1000 and got.modify_index == 1003
+    assert s.get_index("evals") == 1003
+
+
+def test_delete_eval_cascades_to_allocs():
+    s = StateStore()
+    ev = mock.eval()
+    s.upsert_evals(1000, [ev])
+    a1 = _alloc(eval_id=ev.id)
+    a2 = _alloc(eval_id=ev.id)
+    keeper = _alloc(eval_id="other-eval")
+    s.upsert_allocs(1001, [a1, a2, keeper])
+    s.delete_eval(1002, [ev.id], [a1.id, a2.id])
+    assert s.eval_by_id(ev.id) is None
+    assert s.alloc_by_id(a1.id) is None
+    assert s.alloc_by_id(a2.id) is None
+    assert s.alloc_by_id(keeper.id) is not None
+    assert s.get_index("evals") == 1002
+    assert s.get_index("allocs") == 1002
+    # Secondary indexes must not resurrect the dead.
+    assert s.allocs_by_eval(ev.id) == []
+
+
+def test_evals_by_job_multiple():
+    s = StateStore()
+    evs = [mock.eval() for _ in range(3)]
+    for ev in evs:
+        ev.job_id = "j-common"
+    s.upsert_evals(1000, evs)
+    assert {e.id for e in s.evals_by_job("j-common")} == \
+        {e.id for e in evs}
+    assert {e.id for e in s.evals()} == {e.id for e in evs}
+
+
+# ---------------------------------------------------------------------------
+# allocs (state_store_test.go:747-1008)
+# ---------------------------------------------------------------------------
+
+def test_alloc_replace_moves_secondary_indexes():
+    s = StateStore()
+    a = _alloc(node_id="n1")
+    s.upsert_allocs(1000, [a])
+    assert [x.id for x in s.allocs_by_node("n1")] == [a.id]
+    moved = _alloc(node_id="n2")
+    moved.id = a.id
+    s.upsert_allocs(1001, [moved])
+    assert s.allocs_by_node("n1") == []
+    assert [x.id for x in s.allocs_by_node("n2")] == [a.id]
+    assert len(list(s.allocs())) == 1
+
+
+def test_evict_alloc_keeps_record_desired_stop():
+    s = StateStore()
+    a = _alloc()
+    s.upsert_allocs(1000, [a])
+    evicted = a.copy()
+    evicted.desired_status = ALLOC_DESIRED_STATUS_STOP
+    s.upsert_allocs(1001, [evicted])
+    got = s.alloc_by_id(a.id)
+    assert got.desired_status == ALLOC_DESIRED_STATUS_STOP
+    assert got.terminal_status()
+    # Still listed (the reference keeps evicted allocs until GC).
+    assert [x.id for x in s.allocs_by_job(a.job_id)] == [a.id]
+
+
+def test_allocs_by_node_and_job():
+    s = StateStore()
+    batch = [_alloc(node_id="nA", job_id="j1"),
+             _alloc(node_id="nA", job_id="j2"),
+             _alloc(node_id="nB", job_id="j1")]
+    s.upsert_allocs(1000, batch)
+    assert len(s.allocs_by_node("nA")) == 2
+    assert len(s.allocs_by_job("j1")) == 2
+    assert s.has_allocs_on_node("nA") and not s.has_allocs_on_node("nC")
+
+
+def test_watch_allocs_fires_on_upsert():
+    s = StateStore()
+    ev = s.watch.watch(("allocs",))
+    s.upsert_allocs(1000, [_alloc()])
+    assert ev.wait(1.0)
+
+
+# ---------------------------------------------------------------------------
+# restore of every table (state_store_test.go:189, 418, 476, 721, 1009)
+# ---------------------------------------------------------------------------
+
+def test_restore_every_table_and_indexes():
+    s = StateStore()
+    s.upsert_node(1, mock.node(0))  # pre-restore world, to be replaced
+
+    node, job, ev = mock.node(1), mock.job(), mock.eval()
+    alloc = _alloc(node_id=node.id, job_id=job.id, eval_id=ev.id)
+    r = s.restore()
+    r.node_restore(node)
+    r.job_restore(job)
+    r.eval_restore(ev)
+    r.alloc_restore(alloc)
+    r.index_restore("nodes", 5000)
+    r.index_restore("jobs", 5001)
+    r.index_restore("evals", 5002)
+    r.index_restore("allocs", 5003)
+    r.commit()
+
+    assert {n.id for n in s.nodes()} == {node.id}
+    assert s.job_by_id(job.id) is not None
+    assert s.eval_by_id(ev.id) is not None
+    assert s.alloc_by_id(alloc.id) is not None
+    assert [x.id for x in s.allocs_by_node(node.id)] == [alloc.id]
+    assert [x.id for x in s.allocs_by_eval(ev.id)] == [alloc.id]
+    assert s.get_index("nodes") == 5000
+    assert s.get_index("allocs") == 5003
+    assert s.latest_index() >= 5003
