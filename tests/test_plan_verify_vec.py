@@ -303,6 +303,32 @@ def victim_node(nodes, alloc):
     raise AssertionError(alloc.node_id)
 
 
+def test_optimistic_overlay_nodes_use_scalar_truth(rig):
+    """The real PlanApplier verifies against an OptimisticSnapshot
+    (base + in-flight allocs).  Overlay-touched nodes must punt to the
+    scalar walk (verdict None) and the public evaluate_plan must match
+    the scalar truth computed over the SAME overlay view."""
+    from nomad_tpu.server.plan_apply import OptimisticSnapshot
+
+    state, nodes, cell = rig
+    n = nodes[0]
+    state.upsert_allocs(bump(cell), [make_alloc(n, cpu=1000)])
+    snap = OptimisticSnapshot(state)
+    # An in-flight plan's alloc fills most of the node.
+    snap.upsert_allocs([make_alloc(n, cpu=2500, mem=7000)])
+
+    plan = Plan(node_allocation={n.id: [make_alloc(n, cpu=600)]})
+    verdicts = _evaluate_plan_vec(snap, plan, {n.id})
+    assert verdicts[n.id] is None  # overlay: scalar path decides
+    result = evaluate_plan(snap, plan)
+    want = scalar_truth(snap, plan)[n.id]
+    assert (n.id in result.node_allocation) == want
+    # And the overlay genuinely matters: without it the placement fits,
+    # with it the node is full.
+    assert want is False
+    assert scalar_truth(state, plan)[n.id] is True
+
+
 def test_incremental_net_mirror_matches_rebuild(rig):
     """After arbitrary churn, the incrementally-maintained net state must
     equal a from-scratch rebuild (same invariant style as the usage
