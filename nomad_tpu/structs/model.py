@@ -316,6 +316,19 @@ class Constraint(_Struct):
             errs.append("missing constraint operand")
         if not self.hard and self.weight == 0:
             errs.append("soft constraint needs a weight")
+        # Operand-specific checks (reference structs.go Constraint.Validate).
+        if self.operand == "regexp":
+            import re as _re
+            try:
+                _re.compile(self.r_target)
+            except _re.error as e:
+                errs.append(
+                    f"regular expression failed to compile: {e}")
+        elif self.operand == "version":
+            from nomad_tpu.utils.versions import parse_constraint
+            if parse_constraint(self.r_target) is None:
+                errs.append(
+                    f"version constraint is invalid: {self.r_target!r}")
         return errs
 
 
@@ -451,6 +464,8 @@ class Job(_Struct):
             errs.append("missing job region")
         if not self.id:
             errs.append("missing job id")
+        elif " " in self.id:
+            errs.append("job id contains a space")
         if not self.name:
             errs.append("missing job name")
         if self.type not in (JOB_TYPE_CORE, JOB_TYPE_SERVICE, JOB_TYPE_BATCH,
@@ -470,6 +485,10 @@ class Job(_Struct):
             if tg.name in seen:
                 errs.append(f"duplicate task group {tg.name!r}")
             seen.add(tg.name)
+            if self.type == JOB_TYPE_SYSTEM and tg.count != 1:
+                errs.append(
+                    f"system job task group {tg.name!r} should have "
+                    "a count of 1")
             errs.extend(tg.validate())
         for c in self.constraints:
             errs.extend(c.validate())

@@ -66,11 +66,16 @@ _CONSTRAINT_RE = re.compile(r"^\s*(>=|<=|!=|~>|=|>|<)?\s*([\w.+-]+)\s*$")
 
 
 def parse_constraint(spec: str) -> Optional[list]:
-    """Parse "">= 1.0, < 1.4"" into [(op, version), ...]."""
+    """Parse "">= 1.0, < 1.4"" into [(op, version), ...].  Each rhs must
+    itself parse as a version (go-version's NewConstraint rejects
+    unparseable versions at parse time — without this, ">= banana"
+    would validate clean and then silently never match any node)."""
     out = []
     for clause in spec.split(","):
         m = _CONSTRAINT_RE.match(clause)
         if not m:
+            return None
+        if parse_version(m.group(2)) is None:
             return None
         out.append((m.group(1) or "=", m.group(2)))
     return out

@@ -229,6 +229,58 @@ class TestFSM:
         # ignorable flag: no error
         fsm.apply(2, codec.encode(99 | codec.IGNORE_UNKNOWN_TYPE_FLAG, {}))
 
+    def test_apply_every_remaining_type(self):
+        """The apply table rows not covered above: node deregister /
+        status / drain, job deregister, eval delete (reference
+        fsm_test.go:100-366)."""
+        fsm = NomadFSM()
+        node = mock.node()
+        fsm.apply(1, codec.encode(codec.NODE_REGISTER_REQUEST,
+                                  {"node": node.to_dict()}))
+        fsm.apply(2, codec.encode(codec.NODE_UPDATE_STATUS_REQUEST,
+                                  {"node_id": node.id, "status": "down"}))
+        assert fsm.state.node_by_id(node.id).status == "down"
+        fsm.apply(3, codec.encode(codec.NODE_UPDATE_DRAIN_REQUEST,
+                                  {"node_id": node.id, "drain": True}))
+        assert fsm.state.node_by_id(node.id).drain is True
+        fsm.apply(4, codec.encode(codec.NODE_DEREGISTER_REQUEST,
+                                  {"node_id": node.id}))
+        assert fsm.state.node_by_id(node.id) is None
+
+        job = mock.job()
+        fsm.apply(5, codec.encode(codec.JOB_REGISTER_REQUEST,
+                                  {"job": job.to_dict()}))
+        fsm.apply(6, codec.encode(codec.JOB_DEREGISTER_REQUEST,
+                                  {"job_id": job.id}))
+        assert fsm.state.job_by_id(job.id) is None
+
+        ev = make_eval()
+        alloc = mock.alloc()
+        alloc.eval_id = ev.id
+        fsm.apply(7, codec.encode(codec.EVAL_UPDATE_REQUEST,
+                                  {"evals": [ev.to_dict()]}))
+        fsm.apply(8, codec.encode(codec.ALLOC_UPDATE_REQUEST,
+                                  {"alloc": [alloc.to_dict()]}))
+        fsm.apply(9, codec.encode(codec.EVAL_DELETE_REQUEST,
+                                  {"evals": [ev.id],
+                                   "allocs": [alloc.id]}))
+        assert fsm.state.eval_by_id(ev.id) is None
+        assert fsm.state.alloc_by_id(alloc.id) is None
+        assert fsm.state.get_index("evals") == 9
+
+    def test_snapshot_restores_timetable(self):
+        """TimeTable witnesses ride the snapshot so GC cutoffs survive a
+        restore (reference fsm_test.go:590-626)."""
+        fsm = NomadFSM()
+        fsm.timetable.granularity = 0.0
+        fsm.timetable.witness(1000, 12345.0)
+        fsm.timetable.witness(2000, 23456.0)
+        blob = fsm.snapshot()
+        fsm2 = NomadFSM()
+        fsm2.restore(blob)
+        assert fsm2.timetable.nearest_index(20000.0) == 1000
+        assert fsm2.timetable.nearest_index(30000.0) == 2000
+
     def test_client_update_merges_status_only(self):
         fsm = NomadFSM()
         alloc = mock.alloc()

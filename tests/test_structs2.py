@@ -1,0 +1,180 @@
+"""Second structs suite: validation verdicts and port-slicing edge
+cases from the reference's structs_test.go / network_test.go /
+funcs_test.go not covered by test_structs.py."""
+from __future__ import annotations
+
+import re
+
+from nomad_tpu.structs import (
+    Allocation,
+    Constraint,
+    Job,
+    NetworkIndex,
+    NetworkResource,
+    Resources,
+    Task,
+    TaskGroup,
+    allocs_fit,
+    generate_uuid,
+    generate_uuids,
+)
+from nomad_tpu import mock
+
+
+# ---------------------------------------------------------------------------
+# validation (structs_test.go:11-164)
+# ---------------------------------------------------------------------------
+
+def test_job_validate_collects_all_errors():
+    job = Job()  # everything missing (type/region carry defaults)
+    errs = job.validate()
+    text = " ".join(errs).lower()
+    for needle in ("id", "name", "datacenter", "task group"):
+        assert needle in text, (needle, errs)
+
+    # ID with a space (reference structs.go Job.Validate).
+    job = mock.job()
+    job.id = "has space"
+    assert any("space" in e for e in job.validate())
+
+    # System jobs require count == 1 per group.
+    sysjob = mock.system_job()
+    sysjob.task_groups[0].count = 3
+    assert any("count of 1" in e for e in sysjob.validate())
+
+    # Duplicate group names are rejected.
+    job = mock.job()
+    job.task_groups = [job.task_groups[0], job.task_groups[0]]
+    errs = job.validate()
+    assert any("2 times" in e or "duplicate" in e.lower() for e in errs)
+
+
+def test_task_group_validate():
+    tg = TaskGroup()  # no name, no tasks, count 1? -> errors
+    errs = tg.validate()
+    text = " ".join(errs).lower()
+    assert "name" in text and "task" in text
+
+    tg = TaskGroup(name="web", count=-1,
+                   tasks=[Task(name="t", driver="exec"),
+                          Task(name="t", driver="exec")])
+    errs = tg.validate()
+    text = " ".join(errs).lower()
+    assert "count" in text
+    assert any("2 times" in e or "duplicate" in e.lower() for e in errs)
+
+
+def test_task_validate():
+    errs = Task().validate()
+    text = " ".join(errs).lower()
+    assert "name" in text and "driver" in text
+
+
+def test_constraint_validate():
+    errs = Constraint(operand="").validate()
+    assert errs
+    # Bad regexp is surfaced (reference Constraint.Validate).
+    errs = Constraint(operand="regexp", l_target="$attr.x",
+                      r_target="(unclosed").validate()
+    assert any("regular expression" in e.lower() for e in errs)
+    # Bad version constraint too.
+    errs = Constraint(operand="version", l_target="$attr.v",
+                      r_target=">> nope ><").validate()
+    assert any("version constraint" in e.lower() for e in errs)
+    # Valid forms pass.
+    assert Constraint(operand="=", l_target="a", r_target="b") \
+        .validate() == []
+    assert Constraint(operand="regexp", r_target="[0-9]+") \
+        .validate() == []
+    assert Constraint(operand="version", r_target=">= 1.0, < 2.0") \
+        .validate() == []
+
+
+# ---------------------------------------------------------------------------
+# port slicing edges (structs_test.go:306-423)
+# ---------------------------------------------------------------------------
+
+def test_port_slicing_edges():
+    # Empty network: nothing to slice.
+    n = NetworkResource()
+    assert n.map_dynamic_ports() == {}
+    assert n.list_static_ports() == []
+    # Static only.
+    n = NetworkResource(reserved_ports=[22, 80])
+    assert n.map_dynamic_ports() == {}
+    assert n.list_static_ports() == [22, 80]
+    # Dynamic only: assigned ports fill reserved_ports.
+    n = NetworkResource(reserved_ports=[20001, 20002],
+                        dynamic_ports=["http", "https"])
+    assert n.map_dynamic_ports() == {"http": 20001, "https": 20002}
+    assert n.list_static_ports() == []
+    # Mixed: statics first, assigned dynamics last.
+    n = NetworkResource(reserved_ports=[22, 20005],
+                        dynamic_ports=["admin"])
+    assert n.map_dynamic_ports() == {"admin": 20005}
+    assert n.list_static_ports() == [22]
+
+
+# ---------------------------------------------------------------------------
+# fit: ports overcommitted (funcs_test.go:42-88)
+# ---------------------------------------------------------------------------
+
+def test_allocs_fit_ports_overcommitted():
+    node = mock.node(0)
+    ip = node.reserved.networks[0].ip
+
+    def holder(port):
+        return Allocation(
+            id=generate_uuid(), node_id=node.id, job_id="j",
+            task_group="g",
+            resources=Resources(cpu=100, memory_mb=64),
+            task_resources={"t": Resources(
+                cpu=100, memory_mb=64,
+                networks=[NetworkResource(device="eth0", ip=ip,
+                                          reserved_ports=[port],
+                                          mbits=10)])},
+            desired_status="run")
+
+    a1, a2 = holder(30100), holder(30100)
+    fit, dim, _util = allocs_fit(node, [a1, a2])
+    assert not fit and "port" in dim.lower()
+    fit, _dim, _util = allocs_fit(node, [a1, holder(30101)])
+    assert fit
+
+
+# ---------------------------------------------------------------------------
+# NetworkIndex ip yielding (network_test.go:175-212)
+# ---------------------------------------------------------------------------
+
+def test_network_index_yields_cidr_ips():
+    idx = NetworkIndex()
+    node = mock.node(0)
+    node.resources.networks[0].cidr = "192.168.7.0/30"
+    node.resources.networks[0].ip = ""
+    node.reserved = None
+    idx.set_node(node)
+    ips = [ip for _n, ip in idx._yield_ips()]
+    assert "192.168.7.0" in ips and "192.168.7.1" in ips
+    assert len(ips) == 4  # a /30 yields 4 addresses
+
+
+# ---------------------------------------------------------------------------
+# uuids (funcs_test.go:215-230)
+# ---------------------------------------------------------------------------
+
+UUID_RE = re.compile(
+    r"^[0-9a-f]{8}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{12}$")
+
+
+def test_generate_uuid_format_and_uniqueness():
+    seen = set()
+    for _ in range(100):
+        u = generate_uuid()
+        assert UUID_RE.match(u), u
+        seen.add(u)
+    assert len(seen) == 100
+    batch = generate_uuids(50)
+    assert len(batch) == 50
+    for u in batch:
+        assert UUID_RE.match(u), u
+    assert len(set(batch) | seen) == 150
