@@ -280,6 +280,11 @@ def cmd_validate(args) -> int:
     except ParseError as e:
         print(f"Job validation failed: {e}", file=sys.stderr)
         return 1
+    except OSError as e:
+        # Without this, a missing file would fall through to main()'s
+        # connection-error handler and report a bogus agent error.
+        print(f"Error reading {args.file}: {e}", file=sys.stderr)
+        return 1
     print("Job validation successful")
     return 0
 
@@ -291,6 +296,9 @@ def cmd_run(args) -> int:
         job = parse_file(args.file)
     except ParseError as e:
         print(f"Error parsing job: {e}", file=sys.stderr)
+        return 1
+    except OSError as e:
+        print(f"Error reading {args.file}: {e}", file=sys.stderr)
         return 1
     client = APIClient(args.address)
     resp = client.job_register(job)

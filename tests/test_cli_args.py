@@ -1,0 +1,76 @@
+"""CLI argument-handling suite: every command's bad-args behavior, the
+reference's per-command *_test.go "fails on misuse" checks
+(command/{run,status,stop,validate,node_status,...}_test.go).  All
+in-process via cli.main(argv) — no agent needed for arg errors."""
+from __future__ import annotations
+
+import pytest
+
+from nomad_tpu.cli.main import main
+
+
+def run_cli(argv, capsys):
+    try:
+        rc = main(argv)
+    except SystemExit as e:  # argparse errors exit(2)
+        rc = e.code
+    out = capsys.readouterr()
+    return rc, out.out, out.err
+
+
+@pytest.mark.parametrize("argv", [
+    ["validate"],                 # missing file
+    ["run"],                      # missing file
+    ["stop"],                     # missing job id
+    ["status", "--bogus-flag"],
+    ["node-drain"],               # missing node + mode
+    ["alloc-status"],             # missing alloc id
+    ["eval-monitor"],             # missing eval id
+    ["server-join"],              # missing address
+    ["server-force-leave"],       # missing node
+    ["no-such-command"],
+])
+def test_bad_args_fail_with_usage(argv, capsys):
+    rc, out, err = run_cli(argv, capsys)
+    assert rc not in (0, None), argv
+    assert "usage" in (out + err).lower(), argv
+
+
+def test_validate_missing_file_errors(tmp_path, capsys):
+    rc, out, err = run_cli(
+        ["validate", str(tmp_path / "nope.hcl")], capsys)
+    assert rc != 0
+    # A real file error, not a bogus agent connection message.
+    assert "Error reading" in err
+    assert "connecting" not in err
+
+    rc, out, err = run_cli(["run", str(tmp_path / "nope.hcl")], capsys)
+    assert rc != 0 and "Error reading" in err
+
+
+def test_validate_bad_spec_errors(tmp_path, capsys):
+    bad = tmp_path / "bad.hcl"
+    bad.write_text('job "x" { priority = "high" }')
+    rc, out, err = run_cli(["validate", str(bad)], capsys)
+    assert rc != 0
+    assert "validation failed" in (out + err).lower()
+
+
+def test_init_refuses_to_clobber(tmp_path, capsys, monkeypatch):
+    """init + validate roundtrip is covered by test_agent_api; the
+    clobber refusal (reference init_test.go) is the new bit."""
+    monkeypatch.chdir(tmp_path)
+    rc, _out, _err = run_cli(["init"], capsys)
+    assert rc == 0
+    rc, _out, _err = run_cli(["init"], capsys)
+    assert rc != 0
+
+
+def test_connection_refused_is_clean_error(capsys):
+    """Commands against a dead agent fail with a clean message; an
+    uncaught exception would propagate out of run_cli and ERROR the
+    test, which IS the traceback check (reference meta_test paths)."""
+    rc, out, err = run_cli(
+        ["-address", "http://127.0.0.1:1", "status"], capsys)
+    assert rc != 0
+    assert "Error connecting" in err
