@@ -42,3 +42,21 @@ def wait_until(fn, timeout=15.0, msg="condition"):
             return
         time.sleep(0.02)
     raise AssertionError(f"timeout waiting for {msg}")
+
+
+def boot_dev_agent(data_dir: str):
+    """ONE boot sequence for in-process dev-agent rigs: returns
+    (agent, api_client) with the client node registered.  Every suite's
+    module fixture delegates here so a future boot change (new config
+    knob, different readiness condition) lands once."""
+    from nomad_tpu.agent import Agent, AgentConfig
+    from nomad_tpu.api import APIClient
+
+    cfg = AgentConfig.dev()
+    cfg.data_dir = data_dir
+    cfg.client_options["fingerprint.skip_accel"] = "1"
+    agent = Agent(cfg)
+    client = APIClient(f"http://127.0.0.1:{agent.http.address[1]}")
+    wait_until(lambda: agent.server.fsm.state.nodes(),
+               msg="client node registration")
+    return agent, client

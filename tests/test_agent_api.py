@@ -177,13 +177,8 @@ job "sugar" {
 
 @pytest.fixture(scope="module")
 def dev_agent(tmp_path_factory):
-    cfg = AgentConfig.dev()
-    cfg.data_dir = str(tmp_path_factory.mktemp("agent"))
-    cfg.client_options["fingerprint.skip_accel"] = "1"
-    agent = Agent(cfg)
-    client = APIClient(f"http://127.0.0.1:{agent.http.address[1]}")
-    wait_until(lambda: agent.server.fsm.state.nodes(),
-               msg="client node registration")
+    from tests.conftest import boot_dev_agent
+    agent, client = boot_dev_agent(str(tmp_path_factory.mktemp("agent")))
     yield agent, client
     agent.shutdown()
 

@@ -13,9 +13,8 @@ import urllib.request
 
 import pytest
 
-from nomad_tpu.agent import Agent, AgentConfig
 from nomad_tpu.jobspec import parse
-from tests.conftest import wait_until
+from tests.conftest import boot_dev_agent, wait_until
 
 JOBSPEC = """
 job "pings" {
@@ -40,12 +39,8 @@ job "pings" {
 
 @pytest.fixture(scope="module")
 def agent(tmp_path_factory):
-    cfg = AgentConfig.dev()
-    cfg.data_dir = str(tmp_path_factory.mktemp("agent-http2"))
-    cfg.client_options["fingerprint.skip_accel"] = "1"
-    a = Agent(cfg)
-    wait_until(lambda: a.server.fsm.state.nodes(),
-               msg="client node registration")
+    a, _client = boot_dev_agent(
+        str(tmp_path_factory.mktemp("agent-http2")))
     yield a
     a.shutdown()
 
