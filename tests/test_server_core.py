@@ -323,7 +323,8 @@ def test_raft_log_replay_and_snapshot(tmp_path):
 # ---------------------------------------------------------------------------
 
 def make_server(**kw) -> Server:
-    cfg = ServerConfig(num_schedulers=2, **kw)
+    kw.setdefault("num_schedulers", 2)
+    cfg = ServerConfig(**kw)
     srv = Server(cfg)
     srv.establish_leadership()
     return srv
@@ -650,6 +651,48 @@ class TestNodeLifecycle:
             allocs = [a for a in srv.fsm.state.allocs_by_job(job.id)
                       if not a.terminal_status()]
             assert len({a.node_id for a in allocs}) == 4
+        finally:
+            srv.shutdown()
+
+
+class TestLeaderLifecycle:
+    def test_reap_failed_eval(self):
+        """An eval nacked past the delivery limit lands in the failed
+        queue and the leader's reaper marks it failed in replicated
+        state (reference leader_test.go:309-360)."""
+        srv = make_server(num_schedulers=0, eval_delivery_limit=1)
+        try:
+            ev = mock.eval()
+            srv.eval_broker.enqueue(ev)
+            out, token = srv.eval_broker.dequeue(["service"], timeout=2)
+            assert out.id == ev.id
+            srv.eval_broker.nack(out.id, token)
+
+            srv.wait_for_evals([ev.id], timeout=10)
+            got = srv.fsm.state.eval_by_id(ev.id)
+            assert got.status == "failed"
+            assert "delivery limit" in got.status_description
+        finally:
+            srv.shutdown()
+
+    def test_periodic_dispatch_enqueues_core_evals(self):
+        """Tiny GC intervals: the leader's periodic loop mints _core
+        evals for eval-gc and node-gc (reference
+        leader_test.go:289-307 + leader.go:171-199)."""
+        from nomad_tpu.structs import CORE_JOB_EVAL_GC, CORE_JOB_NODE_GC
+
+        srv = make_server(num_schedulers=0, eval_gc_interval=0.05,
+                          node_gc_interval=0.05)
+        try:
+            seen = set()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and len(seen) < 2:
+                ev, token = srv.eval_broker.dequeue(["_core"],
+                                                    timeout=0.5)
+                if ev is not None:
+                    seen.add(ev.job_id)
+                    srv.eval_broker.ack(ev.id, token)
+            assert seen == {CORE_JOB_EVAL_GC, CORE_JOB_NODE_GC}
         finally:
             srv.shutdown()
 
