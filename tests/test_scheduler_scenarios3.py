@@ -1,0 +1,198 @@
+"""Third scheduler scenario suite: the generic_sched_test.go /
+system_sched_test.go cases not yet mirrored — destructive JobModify
+(all allocs replaced), service NodeDrain, system AddNode / JobModify
+(destructive + in-place) / NodeDrain / RetryLimit."""
+from __future__ import annotations
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler import Harness, RejectPlan
+from nomad_tpu.structs import (
+    ALLOC_DESIRED_STATUS_STOP,
+    EVAL_STATUS_COMPLETE,
+    EVAL_STATUS_FAILED,
+    EVAL_TRIGGER_JOB_REGISTER,
+    EVAL_TRIGGER_NODE_UPDATE,
+    Evaluation,
+    generate_uuid,
+)
+
+
+from tests.test_scheduler import make_eval  # one eval factory
+
+
+def _flat(plan):
+    """(stopped, placed) across the plan's per-node buckets."""
+    stopped = [a for ups in plan.node_update.values() for a in ups]
+    placed = [a for al in plan.node_allocation.values() for a in al]
+    return stopped, placed
+
+
+def _rig(n_nodes, job):
+    h = Harness()
+    nodes = [mock.node(i) for i in range(n_nodes)]
+    for n in nodes:
+        h.state.upsert_node(h.next_index(), n)
+    h.state.upsert_job(h.next_index(), job)
+    return h, nodes
+
+
+def _seed_allocs(h, job, nodes, count, old_config=None,
+                 stale_version=False, per_node=False):
+    """Existing allocs: against the CURRENT job version by default (so
+    drain/add-node tests isolate their trigger), or an older version
+    (``stale_version``/``old_config``) for the update scenarios."""
+    if old_config is not None or stale_version:
+        alloc_job = job.copy()
+        alloc_job.modify_index = 1
+        if old_config is not None:
+            alloc_job.task_groups[0].tasks[0].config = old_config
+    else:
+        alloc_job = h.state.job_by_id(job.id)
+    allocs = []
+    for i in range(count):
+        a = mock.alloc()
+        a.job = alloc_job
+        a.job_id = job.id
+        a.node_id = nodes[i % len(nodes)].id
+        # System jobs run ONE copy per node: every alloc is name [0]
+        # (diff_system_allocs matches required names per node).
+        a.name = "my-job.web[0]" if per_node else f"my-job.web[{i}]"
+        allocs.append(a)
+    h.state.upsert_allocs(h.next_index(), allocs)
+    return allocs
+
+
+# ---------------------------------------------------------------------------
+# service (generic_sched_test.go:116-538)
+# ---------------------------------------------------------------------------
+
+def test_service_job_modify_destructive_replaces_all():
+    """Changed task config with no rolling limit: every alloc is
+    stopped and replaced in one pass (generic_sched_test.go:116-213)."""
+    job = mock.job()
+    job.task_groups[0].count = 6
+    job.task_groups[0].tasks[0].config = {"command": "/bin/sleep"}
+    h, nodes = _rig(6, job)
+    old = _seed_allocs(h, job, nodes, 6,
+                       old_config={"command": "/bin/date"})
+
+    h.process("service", make_eval(job))
+    plan = h.plans[0]
+    stopped, placed = _flat(plan)
+    assert len(stopped) == 6 and len(placed) == 6
+    assert {a.id for a in stopped} == {a.id for a in old}
+    assert all(a.desired_status == ALLOC_DESIRED_STATUS_STOP
+               for a in stopped)
+    assert all(a.id not in {o.id for o in old} for a in placed)
+    assert h.evals[-1].status == EVAL_STATUS_COMPLETE
+
+
+def test_service_node_drain_migrates():
+    """Draining a node migrates its allocs elsewhere
+    (generic_sched_test.go:462-538)."""
+    job = mock.job()
+    job.task_groups[0].count = 4
+    h, nodes = _rig(5, job)
+    allocs = _seed_allocs(h, job, nodes[:4], 4)
+    h.state.update_node_drain(h.next_index(), nodes[0].id, True)
+
+    h.process("service", make_eval(job, EVAL_TRIGGER_NODE_UPDATE))
+    plan = h.plans[0]
+    stopped, placed = _flat(plan)
+    assert [a.id for a in stopped] == [allocs[0].id]
+    assert len(placed) == 1
+    assert placed[0].node_id != nodes[0].id  # never back onto drained
+
+
+def test_service_retry_limit_fails_eval():
+    """Plans rejected past the retry limit fail the eval
+    (generic_sched_test.go:539-583)."""
+    job = mock.job()
+    h, nodes = _rig(3, job)
+    h.planner = RejectPlan(h)
+
+    h.process("service", make_eval(job))
+    assert h.evals[-1].status == EVAL_STATUS_FAILED
+    assert "attempts" in h.evals[-1].status_description
+
+
+# ---------------------------------------------------------------------------
+# system (system_sched_test.go:65-664)
+# ---------------------------------------------------------------------------
+
+def test_system_add_node_places_only_there():
+    """A node-update eval after a node joins places the system job on
+    the NEW node only (system_sched_test.go:65-151)."""
+    job = mock.system_job()
+    h, nodes = _rig(3, job)
+    _seed_allocs(h, job, nodes, 3, per_node=True)
+
+    newcomer = mock.node(99)
+    h.state.upsert_node(h.next_index(), newcomer)
+    h.process("system", make_eval(job, EVAL_TRIGGER_NODE_UPDATE))
+    plan = h.plans[0]
+    _stopped, placed = _flat(plan)
+    assert not plan.node_update
+    assert [a.node_id for a in placed] == [newcomer.id]
+
+
+def test_system_job_modify_destructive():
+    """Changed config: every node's alloc replaced in place — same
+    node, new alloc (system_sched_test.go:182-279)."""
+    job = mock.system_job()
+    job.task_groups[0].tasks[0].config = {"command": "/bin/sleep"}
+    h, nodes = _rig(4, job)
+    old = _seed_allocs(h, job, nodes, 4, per_node=True,
+                       old_config={"command": "/bin/date"})
+
+    h.process("system", make_eval(job))
+    plan = h.plans[0]
+    stopped, placed = _flat(plan)
+    assert len(stopped) == 4 and len(placed) == 4
+    assert {a.node_id for a in placed} == {n.id for n in nodes}
+    assert {a.id for a in stopped} == {a.id for a in old}
+    assert all(a.id not in {o.id for o in old} for a in placed)
+
+
+def test_system_job_modify_in_place():
+    """Version bump without task changes: in-place update on every
+    node, no evictions (system_sched_test.go:381-474)."""
+    job = mock.system_job()
+    h, nodes = _rig(4, job)
+    old = _seed_allocs(h, job, nodes, 4, stale_version=True,
+                       per_node=True)
+
+    h.process("system", make_eval(job))
+    plan = h.plans[0]
+    _stopped, placed = _flat(plan)
+    assert not plan.node_update
+    assert len(placed) == 4
+    assert {a.id for a in placed} == {a.id for a in old}  # same allocs
+    current = h.state.job_by_id(job.id)
+    assert all(a.job.modify_index == current.modify_index
+               for a in placed)
+
+
+def test_system_node_drain_stops_there():
+    """Draining a node stops its system alloc; system jobs never
+    migrate it elsewhere (system_sched_test.go:540-606)."""
+    job = mock.system_job()
+    h, nodes = _rig(3, job)
+    allocs = _seed_allocs(h, job, nodes, 3, per_node=True)
+    h.state.update_node_drain(h.next_index(), nodes[1].id, True)
+
+    h.process("system", make_eval(job, EVAL_TRIGGER_NODE_UPDATE))
+    plan = h.plans[0]
+    stopped, placed = _flat(plan)
+    assert [a.id for a in stopped] == [allocs[1].id]
+    assert not placed  # nothing re-placed on other nodes
+
+
+def test_system_retry_limit_fails_eval():
+    """System scheduler retry cap (system_sched_test.go:607-664)."""
+    job = mock.system_job()
+    h, nodes = _rig(3, job)
+    h.planner = RejectPlan(h)
+
+    h.process("system", make_eval(job))
+    assert h.evals[-1].status == EVAL_STATUS_FAILED
