@@ -303,6 +303,40 @@ def victim_node(nodes, alloc):
     raise AssertionError(alloc.node_id)
 
 
+def test_net_mirror_rebuilds_after_snapshot_restore(rig):
+    """A snapshot restore swaps the world (new lineage): the mirror's
+    full rebuild must rebuild the net tracking too, not serve port
+    counts from the dead world."""
+    state, nodes, cell = rig
+    n = nodes[0]
+    state.upsert_allocs(bump(cell), [make_alloc(n, port=34000)])
+    statics = fleet_cache.statics_for(state)
+    mirror = mirror_for(statics)
+    assert mirror.sync_net(state)
+    assert any(34000 in pc for pc in mirror.node_ports.values())
+
+    # Restore a world where a DIFFERENT port is held.
+    r = state.restore()
+    for node in nodes:
+        r.node_restore(node)
+    other = make_alloc(n, port=35000)
+    r.alloc_restore(other)
+    r.index_restore("allocs", 9000)
+    r.commit()
+
+    assert mirror.sync_net(state)
+    held = {p for pc in mirror.node_ports.values() for p in pc}
+    assert held == {35000}  # dead world's 34000 is gone
+    assert other.id in mirror.net_rows
+    # And the verifier judges against the restored world.
+    plan = Plan(node_allocation={n.id: [make_alloc(n, port=35000)]})
+    v = assert_parity(state, plan)
+    assert v[n.id] is False
+    plan = Plan(node_allocation={n.id: [make_alloc(n, port=34000)]})
+    v = assert_parity(state, plan)
+    assert v[n.id] is True
+
+
 def test_optimistic_overlay_nodes_use_scalar_truth(rig):
     """The real PlanApplier verifies against an OptimisticSnapshot
     (base + in-flight allocs).  Overlay-touched nodes must punt to the
