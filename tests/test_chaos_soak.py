@@ -158,6 +158,114 @@ def test_chaos_storm_with_heartbeat_expiry(seed):
         srv.shutdown()
 
 
+def test_chaos_storm_with_drain():
+    """Drain-mid-storm soak: nodes drain over real RPC while the worker
+    pool is placing; at quiescence drained nodes hold no live allocs,
+    nothing is oversubscribed, and the round-5 NET tracking
+    (sync_net's incremental port/bandwidth state, which the vectorized
+    plan verifier consumed throughout the storm) equals a from-scratch
+    rebuild."""
+    rng = np.random.default_rng(11)
+    srv = Server(ServerConfig(num_schedulers=4, enable_rpc=True))
+    srv.establish_leadership()
+    pool = ConnPool()
+    try:
+        addr = srv.rpc_address()
+        n_nodes = 30
+        node_ids = []
+        for i in range(n_nodes):
+            node = mock.node(i)
+            pool.call(addr, "Node.Register", {"node": node.to_dict()})
+            node_ids.append(node.id)
+
+        eval_ids = []
+        for _ in range(14):
+            job = _storm_job(rng, 10)
+            resp = pool.call(addr, "Job.Register",
+                             {"job": job.to_dict()})
+            eval_ids.append(resp["eval_id"])
+
+        time.sleep(0.1)
+        drained = [node_ids[int(i)] for i in
+                   rng.choice(n_nodes, size=8, replace=False)]
+        for nid in drained:
+            pool.call(addr, "Node.UpdateDrain",
+                      {"node_id": nid, "drain": True})
+
+        survivors = [nid for nid in node_ids if nid not in set(drained)]
+        deadline = time.monotonic() + 55
+        last_beat = 0.0
+        while time.monotonic() < deadline:
+            if time.monotonic() - last_beat > 4.0:
+                for nid in node_ids:
+                    pool.call(addr, "Node.Heartbeat", {"node_id": nid})
+                last_beat = time.monotonic()
+            evals = srv.fsm.state.evals()
+            if evals and all(e.status in TERMINAL for e in evals) and \
+                    len(evals) >= len(eval_ids):
+                break
+            time.sleep(0.2)
+
+        state = srv.fsm.state
+        stuck = [(e.id, e.status) for e in state.evals()
+                 if e.status not in TERMINAL]
+        assert not stuck, f"non-terminal evals after soak: {stuck[:5]}"
+
+        # A placement can slip onto a draining node inside the
+        # applier's optimistic verify window (plan verified against the
+        # snapshot taken just before the drain committed — the same
+        # window the reference's overlapped verify/apply has,
+        # plan_apply.go:68-85).  Drain is ENFORCED by node evals, so a
+        # follow-up node evaluation must clear any straggler.
+        n_evals = len(srv.fsm.state.evals())
+        for nid in drained:
+            pool.call(addr, "Node.Evaluate", {"node_id": nid})
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if time.monotonic() - last_beat > 4.0:
+                for nid in node_ids:
+                    pool.call(addr, "Node.Heartbeat", {"node_id": nid})
+                last_beat = time.monotonic()
+            evals = srv.fsm.state.evals()
+            if len(evals) > n_evals and \
+                    all(e.status in TERMINAL for e in evals):
+                break
+            time.sleep(0.2)
+        state = srv.fsm.state
+
+        # Drained nodes end empty; survivors are never oversubscribed.
+        total_live = 0
+        for nid in node_ids:
+            live = [a for a in state.allocs_by_node(nid)
+                    if not a.terminal_status() and a.node_id]
+            if nid in set(drained):
+                assert not live, f"drained node {nid} still has allocs"
+                continue
+            total_live += len(live)
+            node = state.node_by_id(nid)
+            fit, dim, _util = allocs_fit(node, live)
+            assert fit, f"node {nid} oversubscribed on {dim}"
+        assert total_live > 0, "storm placed nothing on survivors"
+
+        # Round-5 net tracking: incremental == rebuild after the storm.
+        snap = state.snapshot()
+        statics = fleet_cache.statics_for(snap)
+        mirror = mirror_for(statics)
+        assert mirror.sync_net(snap)
+        from nomad_tpu.models.fleet import UsageMirror
+        fresh = UsageMirror(statics)
+        fresh.sync_net(snap)
+        assert mirror.net_rows == fresh.net_rows
+        assert mirror.node_ports == fresh.node_ports
+        assert mirror.node_bw == fresh.node_bw
+        assert mirror.node_dup == fresh.node_dup
+        np.testing.assert_allclose(mirror.usage, fresh.usage,
+                                   rtol=0, atol=0)
+    finally:
+        pool.shutdown()
+        srv.shutdown()
+
+
 def test_leader_failover_mid_storm():
     """Raft-failover chaos: the leader dies while a storm is in flight;
     the new leader restores the eval broker from replicated state,
