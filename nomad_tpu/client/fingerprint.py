@@ -153,18 +153,22 @@ def consul_fingerprint(cfg, node: Node) -> bool:
 
 def env_aws_fingerprint(cfg, node: Node) -> bool:
     """AWS metadata service probe; off unless explicitly enabled (zero
-    egress in tests; reference env_aws.go probes 169.254.169.254)."""
+    egress in tests; reference env_aws.go probes 169.254.169.254).
+    The endpoint is overridable for tests, the same trick the
+    reference's env_aws_test.go plays with a local httptest server."""
     if not cfg.read_bool("fingerprint.env_aws"):
         return False
-    return _probe_metadata(cfg, node, "http://169.254.169.254",
-                           "platform.aws")
+    url = cfg.read("fingerprint.env_aws.url") or \
+        "http://169.254.169.254"
+    return _probe_metadata(cfg, node, url, "platform.aws")
 
 
 def env_gce_fingerprint(cfg, node: Node) -> bool:
     if not cfg.read_bool("fingerprint.env_gce"):
         return False
-    return _probe_metadata(cfg, node, "http://metadata.google.internal",
-                           "platform.gce")
+    url = cfg.read("fingerprint.env_gce.url") or \
+        "http://metadata.google.internal"
+    return _probe_metadata(cfg, node, url, "platform.gce")
 
 
 def _probe_metadata(cfg, node: Node, url: str, prefix: str) -> bool:

@@ -76,6 +76,67 @@ def test_driver_fingerprints():
 # alloc dir + env
 # ---------------------------------------------------------------------------
 
+def test_env_cloud_fingerprints():
+    """AWS/GCE metadata probes: off by default, detect against a local
+    fake metadata server when enabled (reference env_aws_test.go /
+    gce_test.go with httptest)."""
+    import http.server
+    import threading
+
+    from nomad_tpu.client.fingerprint import (
+        env_aws_fingerprint,
+        env_gce_fingerprint,
+    )
+
+    class _Meta(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(b"ok")
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), _Meta)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        # Off by default: no probe, no attributes.
+        node = mock.node()
+        assert not env_aws_fingerprint(ClientConfig(), node)
+        assert not env_gce_fingerprint(ClientConfig(), node)
+        assert "platform.aws.detected" not in node.attributes
+
+        cfg = ClientConfig(options={
+            "fingerprint.env_aws": "1",
+            "fingerprint.env_aws.url": url,
+            "fingerprint.env_gce": "1",
+            "fingerprint.env_gce.url": url,
+        })
+        node = mock.node()
+        assert env_aws_fingerprint(cfg, node)
+        assert node.attributes["platform.aws.detected"] == "true"
+        assert env_gce_fingerprint(cfg, node)
+        assert node.attributes["platform.gce.detected"] == "true"
+
+        # Unreachable endpoint: enabled but cleanly not-detected.
+        # A freshly bound-then-closed port is deterministically dead.
+        import socket
+        s2 = socket.socket()
+        s2.bind(("127.0.0.1", 0))
+        dead_port = s2.getsockname()[1]
+        s2.close()
+        cfg = ClientConfig(options={
+            "fingerprint.env_aws": "1",
+            "fingerprint.env_aws.url": f"http://127.0.0.1:{dead_port}",
+        })
+        node = mock.node()
+        assert not env_aws_fingerprint(cfg, node)
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
 def test_alloc_dir_tree(tmp_path):
     ad = AllocDir(str(tmp_path / "a1"))
     ad.build([raw_task("t1"), raw_task("t2")])

@@ -272,6 +272,28 @@ def test_proposed_allocs_folds_plan_deltas():
 
 
 # ---------------------------------------------------------------------------
+# lexical-order constraint operands (feasible_test.go:275-314)
+# ---------------------------------------------------------------------------
+
+def test_check_lexical_order_operands():
+    from nomad_tpu.scheduler.feasible import check_constraint_values
+
+    cases = [
+        ("<", "abc", "abd", True),
+        ("<", "abd", "abc", False),
+        ("<=", "abc", "abc", True),
+        (">", "abd", "abc", True),
+        (">", "abc", "abd", False),
+        (">=", "abc", "abc", True),
+    ]
+    for op, l, r, want in cases:
+        assert check_constraint_values(None, op, l, r) is want, \
+            (op, l, r)
+    # Non-string operands never satisfy an order constraint.
+    assert check_constraint_values(None, "<", 1, "a") is False
+
+
+# ---------------------------------------------------------------------------
 # worker submit-plan missing-node refresh (worker_test.go:317-383)
 # ---------------------------------------------------------------------------
 
