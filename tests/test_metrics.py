@@ -1,0 +1,82 @@
+"""Telemetry suite (utils/metrics.py): InmemSink aggregation + snapshot
+shape, the statsd UDP wire format against a real bound socket, timer
+plumbing, and the multi-sink fanout — the go-metrics capability set the
+reference wires in command/agent/command.go:487-533."""
+from __future__ import annotations
+
+import socket
+import time
+
+from nomad_tpu.utils.metrics import InmemSink, Metrics, StatsdSink
+
+
+def test_inmem_sink_aggregates():
+    sink = InmemSink()
+    sink.incr_counter("nomad.rpc.query", 1)
+    sink.incr_counter("nomad.rpc.query", 2)
+    sink.set_gauge("nomad.broker.ready", 7)
+    sink.set_gauge("nomad.broker.ready", 3)  # last write wins
+    for v in (0.1, 0.2, 0.3):
+        sink.add_sample("nomad.plan.evaluate", v)
+    snap = sink.snapshot()
+    assert snap["counters"]["nomad.rpc.query"] == 3
+    assert snap["gauges"]["nomad.broker.ready"] == 3
+    s = snap["samples"]["nomad.plan.evaluate"]
+    assert s["count"] == 3
+    assert abs(s["mean"] - 0.2) < 1e-9
+    assert s["max"] == 0.3
+
+
+def test_inmem_sample_ring_bounded():
+    sink = InmemSink()
+    for i in range(5000):
+        sink.add_sample("k", float(i))
+    assert sink.snapshot()["samples"]["k"]["count"] == 4096
+
+
+def test_statsd_wire_format():
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.settimeout(2.0)
+    try:
+        sink = StatsdSink(rx.getsockname())
+        sink.incr_counter("nomad.worker.dequeue", 1.0)
+        sink.set_gauge("nomad.broker.ready", 4.0)
+        sink.add_sample("nomad.plan.apply", 0.25)
+        got = {rx.recv(1024).decode() for _ in range(3)}
+        assert "nomad.worker.dequeue:1.0|c" in got
+        assert "nomad.broker.ready:4.0|g" in got
+        assert "nomad.plan.apply:250.000|ms" in got
+    finally:
+        rx.close()
+
+
+def test_metrics_fanout_and_timer():
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.settimeout(2.0)
+    try:
+        m = Metrics()
+        host, port = rx.getsockname()
+        m.add_statsd(host, port)
+        with m.timer("nomad.test.op"):
+            time.sleep(0.01)
+        # Both sinks saw the sample.
+        snap = m.inmem.snapshot()
+        assert snap["samples"]["nomad.test.op"]["count"] == 1
+        assert snap["samples"]["nomad.test.op"]["max"] >= 0.01
+        wire = rx.recv(1024).decode()
+        assert wire.startswith("nomad.test.op:") and wire.endswith("|ms")
+
+        m.incr_counter("nomad.test.count")
+        assert m.inmem.snapshot()["counters"]["nomad.test.count"] == 1
+        assert rx.recv(1024).decode() == "nomad.test.count:1.0|c"
+    finally:
+        rx.close()
+
+
+def test_statsd_send_failure_is_silent():
+    # A closed socket must never raise into the measured code path.
+    sink = StatsdSink(("127.0.0.1", 9))
+    sink.sock.close()
+    sink.incr_counter("k", 1)  # no exception
