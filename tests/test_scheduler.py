@@ -417,6 +417,82 @@ def test_binpack_scores_and_skips_overfull():
     assert out[1].score > out[0].score
 
 
+def _packing_task(cpu=1024, mem=1024):
+    task = mock.job().task_groups[0].tasks[0].copy()
+    task.resources = Resources(cpu=cpu, memory_mb=mem)
+    return task
+
+
+def _packing_node(idx, cpu=2048, mem=2048):
+    n = mock.node(idx)
+    n.resources = Resources(cpu=cpu, memory_mb=mem,
+                            networks=n.resources.networks)
+    n.reserved = None
+    return n
+
+
+def test_binpack_counts_planned_allocs():
+    """Allocs already staged in the PLAN consume capacity during
+    ranking; an unplanned twin node still places
+    (rank_test.go:98-168 TestBinPackIterator_PlannedAlloc)."""
+    h, ctx = _ctx()
+    n = _packing_node(1)
+    free = _packing_node(2)
+    for node in (n, free):
+        h.state.upsert_node(h.next_index(), node)
+    ctx.set_state(h.state.snapshot())
+    planned = mock.alloc()
+    planned.node_id = n.id
+    planned.resources = Resources(cpu=2048, memory_mb=2048)
+    ctx.plan().append_alloc(planned)
+
+    it = BinPackIterator(ctx, StaticRankIterator(
+        ctx, [RankedNode(n), RankedNode(free)]))
+    it.set_tasks([_packing_task()])
+    out = []
+    while (o := it.next()) is not None:
+        out.append(o)
+    # The plan-staged alloc fills n; only the free twin places.
+    assert [o.node.id for o in out] == [free.id]
+
+
+def test_binpack_counts_existing_allocs():
+    """Committed allocs consume capacity (rank_test.go:169-242)."""
+    h, ctx = _ctx()
+    n = _packing_node(1)
+    h.state.upsert_node(h.next_index(), n)
+    existing = mock.alloc()
+    existing.node_id = n.id
+    existing.resources = Resources(cpu=2048, memory_mb=2048)
+    h.state.upsert_allocs(h.next_index(), [existing])
+    ctx.set_state(h.state.snapshot())
+
+    it = BinPackIterator(ctx, StaticRankIterator(ctx, [RankedNode(n)]))
+    it.set_tasks([_packing_task()])
+    assert it.next() is None  # existing alloc fills the node
+
+
+def test_binpack_planned_evict_frees_capacity():
+    """An eviction staged in the plan releases the evicted alloc's
+    resources for ranking (rank_test.go:243-323)."""
+    h, ctx = _ctx()
+    n = _packing_node(1)
+    h.state.upsert_node(h.next_index(), n)
+    existing = mock.alloc()
+    existing.node_id = n.id
+    existing.resources = Resources(cpu=2048, memory_mb=2048)
+    h.state.upsert_allocs(h.next_index(), [existing])
+    ctx.set_state(h.state.snapshot())
+    ctx.plan().append_update(existing, ALLOC_DESIRED_STATUS_STOP,
+                             "making room")
+
+    it = BinPackIterator(ctx, StaticRankIterator(ctx, [RankedNode(n)]))
+    it.set_tasks([_packing_task()])
+    out = it.next()
+    assert out is not None and out.node.id == n.id
+    assert out.score > 0
+
+
 def test_job_anti_affinity_penalty():
     h, ctx = _ctx()
     n = mock.node()
