@@ -1,0 +1,63 @@
+"""Utility-module suite: duration strings (one implementation shared by
+the jobspec parser and the HTTP ?wait layer), version encoding/
+constraint semantics (the compiled-mask twin of go-version), and
+gc_pause nesting."""
+from __future__ import annotations
+
+import gc
+
+import pytest
+
+from nomad_tpu.utils.duration import parse_duration
+from nomad_tpu.utils.gctune import gc_pause
+from nomad_tpu.utils.versions import (
+    check_constraint,
+    parse_constraint,
+    parse_version,
+)
+
+
+def test_parse_duration_units():
+    cases = [("500ms", 0.5), ("30s", 30.0), ("1m", 60.0), ("2h", 7200.0),
+             ("1.5s", 1.5), ("90", 90.0), (15, 15.0), (0.25, 0.25)]
+    for value, want in cases:
+        assert parse_duration(value) == want, value
+
+
+def test_parse_duration_rejects_garbage():
+    for bad in ("", "fast", "10x", "s", "1d", "-5s"):
+        with pytest.raises(ValueError):
+            parse_duration(bad)
+
+
+def test_version_parse_and_order():
+    assert parse_version("banana") is None
+    assert parse_version("1.2.3") is not None
+    # go-version semantics: pre-releases sort before the release.
+    assert check_constraint("1.2.3", ">= 1.2.3")
+    assert check_constraint("1.2.3", "> 1.2.2")
+    assert not check_constraint("1.2.3", "> 1.2.3")
+    assert check_constraint("1.2.3-beta1", "< 1.2.3")
+    assert check_constraint("v1.4.0", ">= 1.2, < 2.0")  # v-prefix + multi
+    assert not check_constraint("2.1.0", ">= 1.2, < 2.0")
+
+
+def test_parse_constraint_rejects_unparseable_versions():
+    # Pessimistic-operator and encode-ordering semantics live in
+    # test_scheduler.py (test_version_constraints /
+    # test_version_encoding_order); this covers only the round-5
+    # parse-time rejection.
+    assert parse_constraint(">= banana") is None
+    assert parse_constraint(">= 1.0, < nope") is None
+    got = parse_constraint(">= 1.0, < 2.0")
+    assert got == [(">=", "1.0"), ("<", "2.0")]
+
+
+def test_gc_pause_nesting_restores_state():
+    assert gc.isenabled()
+    with gc_pause():
+        assert not gc.isenabled()
+        with gc_pause():  # nest-safe
+            assert not gc.isenabled()
+        assert not gc.isenabled()
+    assert gc.isenabled()
