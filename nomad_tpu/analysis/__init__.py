@@ -1,0 +1,129 @@
+"""Static analysis: the repo's `go vet` + `-race` analogue.
+
+The reference ships its concurrency story as tooling — `go test -race`
+(scripts/test.sh:12-13) and `go vet` on every CI run.  This package is the
+Python/JAX equivalent, purpose-built for the two invariant classes this
+codebase lives on:
+
+  - **Lock discipline** (`lockcheck`): per-class classification of
+    attributes into lock-guarded vs bare, flagging guarded state mutated
+    outside any lock (the Go race detector's bread-and-butter bug class),
+    plus a cross-module lock-order graph with deadlock-cycle detection.
+  - **JAX tracer safety** (`jaxlint`): walks every `jax.jit` kernel and
+    its intra-package callees for impurity, tracer concretization and
+    traced-value branching — the silent retrace/incorrectness modes that
+    would erode kernel parity without ever failing a behavioral test.
+  - **Runtime sanitizers** (`sanitizers`): a lock-order witness
+    (instrumented locks record REAL acquisition chains; observed cycles
+    fail the suite) and a jit-recompile sentinel (a kernel retracing past
+    its budget fails the test run) cross-check the static results.
+
+Findings are gated through a reviewed allowlist (`LINT_ALLOWLIST.txt` at
+the repo root); `nomad-tpu lint` and `tests/test_static_analysis.py` run
+the pass over `nomad_tpu/` and fail on any unallowlisted finding.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+__all__ = [
+    "Finding", "run_lint", "load_allowlist", "partition_findings",
+    "default_package_root", "default_allowlist_path",
+]
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer finding.
+
+    ``key`` (the allowlist identity) deliberately excludes line numbers so
+    entries survive unrelated edits; ``line`` is for humans.
+    """
+
+    rule: str         # e.g. "bare-write", "lock-cycle", "traced-branch"
+    path: str         # repo-relative file path
+    where: str        # Class.attr, Class.method, or function qualname
+    message: str
+    line: int = 0
+    severity: str = "error"   # "error" gates CI; "info" is advisory
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}:{self.path}:{self.where}"
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: [{self.rule}] {self.where}: {self.message}"
+
+
+def default_package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def default_allowlist_path() -> str:
+    root = default_package_root()
+    return os.path.join(os.path.dirname(root), "LINT_ALLOWLIST.txt")
+
+
+def load_allowlist(path: str) -> dict:
+    """Parse the allowlist: one ``finding-key # justification`` per line.
+
+    Every entry MUST carry a justification comment — an allowlist is a
+    reviewed ledger of accepted risk, not a mute button; entries without
+    one are rejected so they can't slip through review.
+    """
+    entries: dict = {}
+    if not os.path.exists(path):
+        return entries
+    with open(path) as fh:
+        for lineno, raw in enumerate(fh, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            key, sep, why = line.partition("#")
+            key = key.strip()
+            why = why.strip()
+            if not sep or not why:
+                raise ValueError(
+                    f"{path}:{lineno}: allowlist entry {key!r} has no "
+                    f"justification comment (format: 'key  # why')")
+            entries[key] = why
+    return entries
+
+
+def run_lint(package_dir: Optional[str] = None,
+             strict: bool = False) -> list:
+    """Run every static pass over a package tree; returns [Finding]."""
+    from . import jaxlint, lockcheck
+
+    package_dir = package_dir or default_package_root()
+    if not os.path.isdir(package_dir):
+        raise FileNotFoundError(package_dir)
+    findings: list = []
+    findings.extend(lockcheck.analyze_package(package_dir, strict=strict))
+    findings.extend(jaxlint.analyze_package(package_dir))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def partition_findings(findings: Iterable[Finding], allowlist: dict
+                       ) -> tuple[list, list, list]:
+    """Split findings into (gating, allowlisted, stale_allowlist_keys).
+
+    ``stale`` entries — allowlist keys matching no current finding — are
+    surfaced so the ledger shrinks as real fixes land instead of
+    accreting dead waivers.
+    """
+    gating: list = []
+    allowed: list = []
+    seen: set = set()
+    for f in findings:
+        if f.key in allowlist:
+            seen.add(f.key)
+            allowed.append(f)
+        elif f.severity == "error":
+            gating.append(f)
+    stale = [k for k in allowlist if k not in seen]
+    return gating, allowed, stale

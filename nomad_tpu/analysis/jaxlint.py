@@ -1,0 +1,456 @@
+"""JAX tracer-safety lint: keep the jit kernels pure and retrace-stable.
+
+Every ``jax.jit`` entry point in the package — decorator form
+(``@jax.jit``, ``@partial(jax.jit, static_argnames=...)``) or wrapper
+form (``name = jax.jit(_fn, ...)``) — is walked together with its
+intra-package callees for the failure modes behavioral tests cannot see:
+
+  - ``impure-call``: ``time``/``random``/``print``/``open``/``os``/
+    ``datetime``/``uuid``/``logging`` calls trace ONCE and then freeze
+    (or worse, silently leak host state into the compiled graph);
+  - ``attr-mutation`` / ``global-mutation``: writes to object attributes
+    or module globals inside traced code run at trace time only — the
+    kernel looks right until the cache stops missing;
+  - ``concretize``: ``float()``/``int()``/``bool()``/``.item()``/
+    ``.tolist()``/``np.asarray()`` on a traced value aborts tracing (or
+    forces a device sync on every call);
+  - ``traced-branch``: Python ``if``/``while`` on a traced expression —
+    the ConcretizationTypeError class, and with ``jnp`` scalars the
+    silent one-retrace-per-value cache explosion.
+
+The taint model is deliberately simple and conservative: non-static
+parameters are traced; any expression built from a traced value is
+traced; ``.shape``/``.ndim``/``.dtype``/``len()`` of a traced value are
+STATIC (shapes are compile-time under jit, so shape-dependent branching
+is legal and common).  ``static_argnames``/``static_argnums`` from the
+jit declaration are honored — branching on ``unroll`` or ``k_cap`` is
+exactly what static args are for.  Callees get every parameter marked
+traced (an intra-package helper may be called with tracers even if some
+call sites pass host values); helpers that are genuinely host-only earn
+an allowlist line instead of a lint pass, which keeps the reviewed
+ledger honest about what runs under trace.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Optional
+
+from . import Finding
+
+IMPURE_ROOTS = {"time", "random", "os", "datetime", "uuid", "logging",
+                "threading", "subprocess", "socket"}
+IMPURE_CALLS = {"print", "open", "input", "exec", "eval", "perf_counter",
+                "monotonic"}
+# numpy RNG is impure under trace; jax.random is fine (explicit keys).
+IMPURE_ATTR_CHAINS = {("np", "random"), ("numpy", "random")}
+CONCRETIZE_FUNCS = {"float", "int", "bool", "complex"}
+CONCRETIZE_METHODS = {"item", "tolist", "__bool__", "__float__"}
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+MAX_CALLEE_DEPTH = 3
+
+
+def _dotted(node: ast.expr) -> Optional[tuple]:
+    """a.b.c -> ("a","b","c") for Name/Attribute chains."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+class _Module:
+    def __init__(self, rel: str, modname: str, tree: ast.Module,
+                 dotted: str = "", is_pkg: bool = False) -> None:
+        self.rel = rel
+        self.modname = modname
+        self.tree = tree
+        self.dotted = dotted or modname
+        self.functions: dict = {}   # name -> FunctionDef (incl. nested)
+        self.imports: dict = {}     # local name -> (dotted module, name)
+        # The package a relative import resolves against: the module's
+        # parent for plain files, the package itself for __init__.py.
+        parts = self.dotted.split(".")
+        pkg = parts if is_pkg else parts[:-1]
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.setdefault(node.name, node)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level == 0:
+                    src = node.module or ""
+                else:
+                    base = pkg[:len(pkg) - (node.level - 1)]
+                    src = ".".join(base + ([node.module]
+                                           if node.module else []))
+                if not src:
+                    continue
+                for alias in node.names:
+                    self.imports[alias.asname or alias.name] = \
+                        (src, alias.name)
+
+
+class _JitRoot:
+    def __init__(self, module: _Module, fn: ast.FunctionDef,
+                 static: set, line: int) -> None:
+        self.module = module
+        self.fn = fn
+        self.static = static
+        self.line = line
+
+
+def _static_names_from_call(call: ast.Call, fn: ast.FunctionDef) -> set:
+    """static_argnames=(...) / static_argnums=(...) -> param name set."""
+    static: set = set()
+    params = [a.arg for a in fn.args.args]
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for el in ast.walk(kw.value):
+                if isinstance(el, ast.Constant) and \
+                        isinstance(el.value, str):
+                    static.add(el.value)
+        elif kw.arg == "static_argnums":
+            for el in ast.walk(kw.value):
+                if isinstance(el, ast.Constant) and \
+                        isinstance(el.value, int) and \
+                        0 <= el.value < len(params):
+                    static.add(params[el.value])
+    return static
+
+
+def _is_jax_jit(node: ast.expr) -> bool:
+    return _dotted(node) in (("jax", "jit"), ("jit",))
+
+
+def _find_jit_roots(mod: _Module) -> list:
+    roots = []
+    for node in ast.walk(mod.tree):
+        # @jax.jit / @partial(jax.jit, ...) decorators.
+        if isinstance(node, ast.FunctionDef):
+            for deco in node.decorator_list:
+                if _is_jax_jit(deco):
+                    roots.append(_JitRoot(mod, node, set(), node.lineno))
+                elif isinstance(deco, ast.Call):
+                    if _is_jax_jit(deco.func):
+                        roots.append(_JitRoot(
+                            mod, node,
+                            _static_names_from_call(deco, node),
+                            node.lineno))
+                    elif _dotted(deco.func) in (("partial",),
+                                                ("functools", "partial")) \
+                            and deco.args and _is_jax_jit(deco.args[0]):
+                        roots.append(_JitRoot(
+                            mod, node,
+                            _static_names_from_call(deco, node),
+                            node.lineno))
+        # name = jax.jit(_fn, ...) wrapper form (possibly nested in
+        # vmap/partial: jax.jit(jax.vmap(partial(_fn, ...), ...))).
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                _is_jax_jit(node.value.func):
+            jit_call = node.value
+            fn_node = _unwrap_fn(mod, jit_call.args[0]) \
+                if jit_call.args else None
+            if fn_node is not None:
+                roots.append(_JitRoot(
+                    mod, fn_node,
+                    _static_names_from_call(jit_call, fn_node),
+                    node.lineno))
+    return roots
+
+
+def _unwrap_fn(mod: _Module, expr: ast.expr
+               ) -> Optional[ast.FunctionDef]:
+    """Resolve jit(vmap(partial(_fn, ...)))-style wrapping to _fn."""
+    for _ in range(6):
+        if isinstance(expr, ast.Name):
+            return mod.functions.get(expr.id)
+        if isinstance(expr, ast.Call):
+            d = _dotted(expr.func)
+            if d and d[-1] in ("vmap", "partial", "pmap", "shard_map",
+                               "checkpoint", "remat", "grad"):
+                if expr.args:
+                    expr = expr.args[0]
+                    continue
+            return None
+        return None
+    return None
+
+
+class _TaintVisitor(ast.NodeVisitor):
+    """One function body, forward taint pass (run twice for loops)."""
+
+    def __init__(self, lint: "_Lint", mod: _Module, fn: ast.FunctionDef,
+                 tainted: set, chain: str, depth: int) -> None:
+        self.lint = lint
+        self.mod = mod
+        self.fn = fn
+        self.tainted = set(tainted)
+        self.chain = chain
+        self.depth = depth
+        self.reported: set = set()
+
+    # -- taint computation -------------------------------------------------
+    def is_tainted(self, node: ast.expr) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d and d[0] == "len":
+                return False
+            if d and d[-1] in ("range", "arange", "iota") and \
+                    not any(self.is_tainted(a) for a in node.args):
+                return False
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("astype", "reshape", "sum", "at",
+                                       "add", "get", "set", "mean", "min",
+                                       "max"):
+                if self.is_tainted(node.func.value):
+                    return True
+            return any(self.is_tainted(a) for a in node.args) or \
+                any(self.is_tainted(kw.value) for kw in node.keywords)
+        if isinstance(node, ast.BinOp):
+            return self.is_tainted(node.left) or self.is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.is_tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            return self.is_tainted(node.left) or \
+                any(self.is_tainted(c) for c in node.comparators)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.is_tainted(el) for el in node.elts)
+        if isinstance(node, ast.IfExp):
+            return self.is_tainted(node.body) or \
+                self.is_tainted(node.orelse) or self.is_tainted(node.test)
+        if isinstance(node, ast.Starred):
+            return self.is_tainted(node.value)
+        return False
+
+    def _report(self, rule: str, line: int, msg: str) -> None:
+        key = (rule, self.mod.rel, self.chain, line)
+        if key in self.lint.reported:
+            return
+        self.lint.reported.add(key)
+        self.lint.findings.append(Finding(
+            rule, self.mod.rel, self.chain, msg, line))
+
+    # -- statements --------------------------------------------------------
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.visit(node.value)
+        tainted = self.is_tainted(node.value)
+        for tgt in node.targets:
+            self._bind(tgt, tainted)
+            if isinstance(tgt, ast.Attribute):
+                self._report(
+                    "attr-mutation", node.lineno,
+                    f"attribute store `{ast.unparse(tgt)} = ...` inside "
+                    "traced code runs at trace time only")
+            elif isinstance(tgt, ast.Subscript) and \
+                    isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id in {a.arg for a in self.fn.args.args}:
+                self._report(
+                    "attr-mutation", node.lineno,
+                    f"in-place subscript store into parameter "
+                    f"`{tgt.value.id}` inside traced code")
+
+    def _bind(self, tgt: ast.expr, tainted: bool) -> None:
+        if isinstance(tgt, ast.Name):
+            if tainted:
+                self.tainted.add(tgt.id)
+            else:
+                self.tainted.discard(tgt.id)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._bind(el, tainted)
+        elif isinstance(tgt, ast.Starred):
+            self._bind(tgt.value, tainted)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.visit(node.value)
+        if isinstance(node.target, ast.Name):
+            if self.is_tainted(node.value):
+                self.tainted.add(node.target.id)
+        elif isinstance(node.target, ast.Attribute):
+            self._report("attr-mutation", node.lineno,
+                         f"augmented attribute store "
+                         f"`{ast.unparse(node.target)}` in traced code")
+
+    def visit_Global(self, node: ast.Global) -> None:
+        self._report("global-mutation", node.lineno,
+                     f"`global {', '.join(node.names)}` inside traced "
+                     "code mutates host state at trace time only")
+
+    def visit_If(self, node: ast.If) -> None:
+        self.visit(node.test)
+        if self.is_tainted(node.test):
+            self._report(
+                "traced-branch", node.lineno,
+                f"Python `if {ast.unparse(node.test)}` on a traced "
+                "value (use jnp.where / lax.cond, or make it static)")
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    def visit_While(self, node: ast.While) -> None:
+        self.visit(node.test)
+        if self.is_tainted(node.test):
+            self._report(
+                "traced-branch", node.lineno,
+                f"Python `while {ast.unparse(node.test)}` on a traced "
+                "value (use lax.while_loop)")
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    def visit_For(self, node: ast.For) -> None:
+        self.visit(node.iter)
+        if self.is_tainted(node.iter):
+            self._report(
+                "traced-branch", node.lineno,
+                f"Python `for` over traced `{ast.unparse(node.iter)}` "
+                "unrolls at trace time (use lax.scan / fori_loop)")
+        self._bind(node.target, self.is_tainted(node.iter))
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    # -- calls -------------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        d = _dotted(node.func)
+        if d is not None:
+            self._check_call(node, d)
+        self.generic_visit(node)
+
+    def _check_call(self, node: ast.Call, d: tuple) -> None:
+        line = node.lineno
+        args_tainted = any(self.is_tainted(a) for a in node.args)
+        if d[0] in IMPURE_ROOTS or d[-1] in IMPURE_CALLS:
+            self._report("impure-call", line,
+                         f"impure call `{'.'.join(d)}(...)` in traced "
+                         "code executes at trace time only")
+            return
+        if len(d) >= 2 and (d[0], d[1]) in IMPURE_ATTR_CHAINS:
+            self._report("impure-call", line,
+                         f"`{'.'.join(d)}` is host RNG; use jax.random "
+                         "with an explicit key")
+            return
+        if len(d) == 1 and d[0] in CONCRETIZE_FUNCS and args_tainted:
+            self._report("concretize", line,
+                         f"`{d[0]}()` concretizes a traced value")
+            return
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in CONCRETIZE_METHODS and \
+                self.is_tainted(node.func.value):
+            self._report("concretize", line,
+                         f"`.{node.func.attr}()` on a traced value "
+                         "forces host materialization")
+            return
+        if d[0] in ("np", "numpy") and d[-1] in ("asarray", "array") \
+                and args_tainted:
+            self._report("concretize", line,
+                         f"`{'.'.join(d)}` materializes a traced value "
+                         "on host (use jnp)")
+            return
+        # Intra-package callee: descend (all params traced).
+        if len(d) == 1 and self.depth < MAX_CALLEE_DEPTH:
+            self.lint.check_callee(self.mod, d[0],
+                                   f"{self.chain} -> {d[0]}",
+                                   self.depth + 1)
+
+    # -- nested defs: traced closures (lax.scan bodies etc.) ---------------
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        inner = _TaintVisitor(
+            self.lint, self.mod, node,
+            self.tainted | {a.arg for a in node.args.args},
+            f"{self.chain}.{node.name}", self.depth)
+        inner.run()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def run(self) -> None:
+        # Two passes: loop-carried taint (x set late, used early in a
+        # `for`) stabilizes in the second pass; reports dedup globally.
+        for _ in range(2):
+            for stmt in self.fn.body:
+                self.visit(stmt)
+
+
+class _Lint:
+    def __init__(self, modules: dict) -> None:
+        self.modules = modules      # modname -> _Module
+        self.findings: list = []
+        self.reported: set = set()  # (rule, rel, chain, line) dedup
+        self._seen: set = set()     # (module, fn name) analyzed as callee
+
+    def check_root(self, root: _JitRoot) -> None:
+        params = {a.arg for a in root.fn.args.args}
+        tainted = params - root.static
+        v = _TaintVisitor(self, root.module, root.fn, tainted,
+                          f"{root.module.modname}.{root.fn.name}", 0)
+        v.run()
+
+    def check_callee(self, mod: _Module, name: str, chain: str,
+                     depth: int) -> None:
+        target_mod, fn = self._resolve(mod, name)
+        if fn is None:
+            return
+        key = (target_mod.dotted, fn.name)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        tainted = {a.arg for a in fn.args.args}
+        v = _TaintVisitor(self, target_mod, fn, tainted, chain, depth)
+        v.run()
+
+    def _resolve(self, mod: _Module, name: str):
+        fn = mod.functions.get(name)
+        if fn is not None:
+            return mod, fn
+        imp = mod.imports.get(name)
+        if imp is not None:
+            src_module, src_name = imp
+            # Dotted lookup first (exact); a `from pkg import helper`
+            # where pkg is a package falls through to pkg/__init__.
+            target = self.modules.get(src_module)
+            if target is not None:
+                return target, target.functions.get(src_name)
+        return mod, None
+
+
+def analyze_package(package_dir: str) -> list:
+    modules: dict = {}   # dotted module path -> _Module
+    mods: list = []
+    base = os.path.dirname(os.path.abspath(package_dir))
+    for root, dirs, files in os.walk(package_dir):
+        dirs[:] = sorted(d for d in dirs if not d.startswith("__pycache"))
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            with open(path) as fh:
+                try:
+                    tree = ast.parse(fh.read(), filename=path)
+                except SyntaxError:
+                    continue  # lockcheck reports parse errors
+            rel = os.path.relpath(path, base)
+            modname = os.path.splitext(fname)[0]
+            is_pkg = fname == "__init__.py"
+            dotted_parts = os.path.splitext(rel)[0].split(os.sep)
+            if is_pkg:
+                dotted_parts = dotted_parts[:-1]
+            dotted = ".".join(dotted_parts)
+            m = _Module(rel, modname, tree, dotted=dotted, is_pkg=is_pkg)
+            modules[dotted] = m
+            mods.append(m)
+
+    lint = _Lint(modules)
+    for m in mods:
+        for jit_root in _find_jit_roots(m):
+            lint.check_root(jit_root)
+    return lint.findings

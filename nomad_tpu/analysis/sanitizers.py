@@ -1,0 +1,317 @@
+"""Runtime sanitizers: cross-check the static analysis with real runs.
+
+Static analysis sees possible orders; these see ACTUAL ones.
+
+**LockOrderWitness** — `threading.Lock/RLock/Condition` constructors are
+wrapped so every lock created from package code carries its creation
+*site* (file:line).  Each thread keeps a held-site stack; acquiring B
+while holding A records the edge A→B.  A cycle in the observed graph
+means two real code paths took the same pair of lock classes in
+opposite orders — the textbook deadlock precondition, caught even when
+the test run never actually deadlocks (exactly what `-race`-style
+sanitizers are for).  Edges are keyed by site, not instance; same-site
+nesting of distinct instances is collected separately (``self_edges``,
+advisory — hierarchical same-class locking is often legitimate).
+
+**RecompileSentinel** — snapshots the jit caches of the package's
+registered kernels and fails any test session that retraces a kernel
+past its budget.  Unbounded retracing is the silent performance failure
+mode of the device path: every new (shape, static-arg) combination
+costs a full XLA compile, and a kernel whose shapes aren't properly
+bucketed erodes the bench headline without failing a single behavioral
+test.
+
+Both are opt-in via install()/uninstall() and wired into the test suite
+by tests/test_static_analysis.py (and conftest, env-gated) — see
+README "Static analysis & sanitizers".
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Optional
+
+_real_lock = threading.Lock
+_real_rlock = threading.RLock
+_real_condition = threading.Condition
+
+
+class _WrappedLock:
+    """Order-tracking proxy around a real Lock/RLock."""
+
+    __slots__ = ("_inner", "_site", "_witness")
+
+    def __init__(self, inner, site: str, witness: "LockOrderWitness"):
+        self._inner = inner
+        self._site = site
+        self._witness = witness
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._witness._on_acquire(self._site, id(self))
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        self._witness._on_release(self._site, id(self))
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    # Condition(wrapped_lock) support: Condition feature-detects
+    # _release_save/_acquire_restore/_is_owned via attribute existence,
+    # so these must exist exactly when the INNER lock has them (RLock
+    # yes, Lock no) — hence __getattr__, not plain methods.  The
+    # save/restore round-trip stays order-tracked.
+    def __getattr__(self, name: str):
+        if name == "_release_save":
+            inner_fn = self._inner._release_save
+            witness, site, me = self._witness, self._site, id(self)
+
+            def _release_save():
+                state = inner_fn()
+                witness._on_release(site, me)
+                return state
+            return _release_save
+        if name == "_acquire_restore":
+            inner_fn = self._inner._acquire_restore
+            witness, site, me = self._witness, self._site, id(self)
+
+            def _acquire_restore(state):
+                inner_fn(state)
+                witness._on_acquire(site, me)
+            return _acquire_restore
+        if name in ("_is_owned", "_at_fork_reinit"):
+            return getattr(self._inner, name)
+        raise AttributeError(name)
+
+
+class LockOrderWitness:
+    """Records real lock-acquisition chains; reports order cycles."""
+
+    def __init__(self, package_prefix: Optional[str] = None) -> None:
+        if package_prefix is None:
+            package_prefix = os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))
+        self.package_prefix = os.path.abspath(package_prefix)
+        self._tls = threading.local()
+        self._graph_lock = _real_lock()
+        self.edges: dict = {}     # (site_a, site_b) -> count
+        self.self_edges: set = set()  # same-site, distinct-instance nests
+        self.sites: set = set()
+        self._installed = False
+        self._saved: Optional[tuple] = None
+
+    # -- recording ---------------------------------------------------------
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _on_acquire(self, site: str, lock_id: int) -> None:
+        stack = self._stack()
+        if stack:
+            top_site, top_id = stack[-1]
+            if top_site != site:
+                edge = (top_site, site)
+                with self._graph_lock:
+                    self.edges[edge] = self.edges.get(edge, 0) + 1
+            elif top_id != lock_id:
+                # Two INSTANCES of one lock class nested: advisory only
+                # (hierarchical same-class locks are legitimate), kept
+                # for inspection alongside the static
+                # nested-self-acquire rule.
+                with self._graph_lock:
+                    self.self_edges.add(site)
+        stack.append((site, lock_id))
+
+    def _on_release(self, site: str, lock_id: int) -> None:
+        stack = self._stack()
+        # Locks are not always released LIFO: drop the innermost match.
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == (site, lock_id):
+                del stack[i]
+                return
+
+    def _site_of_caller(self) -> Optional[str]:
+        frame = sys._getframe(2)
+        fname = frame.f_code.co_filename
+        if not os.path.abspath(fname).startswith(self.package_prefix):
+            return None
+        rel = os.path.relpath(os.path.abspath(fname),
+                              os.path.dirname(self.package_prefix))
+        return f"{rel}:{frame.f_lineno}"
+
+    # -- install / uninstall ----------------------------------------------
+    def install(self) -> "LockOrderWitness":
+        """Patch the threading lock constructors; only locks created
+        from files under ``package_prefix`` are wrapped."""
+        if self._installed:
+            return self
+        # Save whatever is installed NOW (possibly another witness's
+        # factories) so nested install/uninstall pairs restore correctly.
+        self._saved = (threading.Lock, threading.RLock,
+                       threading.Condition)
+        witness = self
+
+        def _wrap(inner, site):
+            if site is None:
+                return inner
+            witness.sites.add(site)
+            return _WrappedLock(inner, site, witness)
+
+        def make_lock():
+            return _wrap(_real_lock(), witness._site_of_caller())
+
+        def make_rlock():
+            return _wrap(_real_rlock(), witness._site_of_caller())
+
+        def make_condition(lock=None):
+            # A Condition over an (already wrapped) lock tracks through
+            # the wrapper; a bare Condition() gets its own wrapped RLock
+            # when created from package code (site = the Condition()
+            # call, resolved HERE — one frame up would blame this file).
+            if lock is None:
+                lock = _wrap(_real_rlock(), witness._site_of_caller())
+            return _real_condition(lock)
+
+        threading.Lock = make_lock
+        threading.RLock = make_rlock
+        threading.Condition = make_condition
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        threading.Lock, threading.RLock, threading.Condition = self._saved
+        self._installed = False
+        self._saved = None
+
+    def __enter__(self) -> "LockOrderWitness":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # -- reporting ---------------------------------------------------------
+    def find_cycles(self) -> list:
+        """Elementary cycles in the observed order graph (site level)."""
+        from .lockcheck import find_cycles
+
+        graph: dict = {}
+        with self._graph_lock:
+            for (a, b) in self.edges:
+                graph.setdefault(a, set()).add(b)
+        return find_cycles(graph)
+
+    def check(self) -> None:
+        """Raise AssertionError when an order cycle was observed."""
+        cycles = self.find_cycles()
+        if cycles:
+            lines = [" -> ".join(c + (c[0],)) for c in cycles]
+            raise AssertionError(
+                "lock-order cycles observed at runtime:\n  " +
+                "\n  ".join(lines))
+
+
+# ---------------------------------------------------------------------------
+# Recompile sentinel
+# ---------------------------------------------------------------------------
+
+# Kernels the sentinel watches: (import path, attribute).  Each entry is
+# the *wrapped* jit object whose cache growth is budgeted.
+KERNEL_REGISTRY = (
+    ("nomad_tpu.ops.binpack", "place_sequence"),
+    ("nomad_tpu.ops.binpack", "place_rounds"),
+    ("nomad_tpu.ops.binpack", "place_rounds_batch"),
+    ("nomad_tpu.ops.binpack", "place_sequence_batch"),
+)
+
+# One kernel serves many (fleet size, placement bucket, static-arg)
+# shapes per suite; buckets are powers of two so a healthy run stays far
+# under this.  A kernel whose inputs stop hitting the buckets shows up
+# as hundreds of entries, not tens.
+DEFAULT_BUDGET = 24
+
+
+def _cache_size(jitted) -> Optional[int]:
+    for attr in ("_cache_size",):
+        fn = getattr(jitted, attr, None)
+        if callable(fn):
+            try:
+                return int(fn())
+            except Exception:
+                return None
+    return None
+
+
+class RecompileSentinel:
+    """Budgets jit-cache growth for the registered kernels."""
+
+    def __init__(self, budget: int = DEFAULT_BUDGET,
+                 extra: Optional[dict] = None) -> None:
+        self.budget = budget
+        self.extra = dict(extra or {})   # name -> jitted object
+        self._baseline: dict = {}
+        self.supported = True
+
+    def _kernels(self) -> dict:
+        import importlib
+
+        out: dict = {}
+        for mod_path, attr in KERNEL_REGISTRY:
+            try:
+                mod = importlib.import_module(mod_path)
+            except Exception:
+                continue
+            fn = getattr(mod, attr, None)
+            if fn is not None:
+                out[f"{mod_path}.{attr}"] = fn
+        out.update(self.extra)
+        return out
+
+    def install(self) -> "RecompileSentinel":
+        sizes = {}
+        for name, fn in self._kernels().items():
+            size = _cache_size(fn)
+            if size is None:
+                self.supported = False
+                continue
+            sizes[name] = size
+        self._baseline = sizes
+        return self
+
+    def report(self) -> dict:
+        """name -> traces since install (only kernels with a baseline)."""
+        out = {}
+        for name, fn in self._kernels().items():
+            if name not in self._baseline:
+                continue
+            size = _cache_size(fn)
+            if size is not None:
+                out[name] = size - self._baseline[name]
+        return out
+
+    def check(self) -> None:
+        """Raise AssertionError when any kernel exceeded its budget."""
+        over = {name: n for name, n in self.report().items()
+                if n > self.budget}
+        if over:
+            detail = ", ".join(f"{k}: {v} traces (budget {self.budget})"
+                               for k, v in sorted(over.items()))
+            raise AssertionError(
+                f"jit recompile budget exceeded — {detail}; either a "
+                "shape stopped hitting its power-of-two bucket or a new "
+                "call site passes unbucketed shapes (see "
+                "nomad_tpu/ops/binpack.py docstring)")

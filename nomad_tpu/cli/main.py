@@ -144,6 +144,21 @@ def main(argv=None) -> int:
     sub.add_parser("agent-info", help="agent diagnostics")
     sub.add_parser("version", help="print version")
 
+    p = sub.add_parser(
+        "lint", help="static analysis: lock discipline + JAX tracer "
+                     "safety (the repo's `go vet`/-race analogue)")
+    p.add_argument("path", nargs="?", default="",
+                   help="package dir to analyze (default: the installed "
+                        "nomad_tpu package)")
+    p.add_argument("-allowlist", default="",
+                   help="allowlist file (default: LINT_ALLOWLIST.txt "
+                        "next to the package)")
+    p.add_argument("-strict", action="store_true",
+                   help="also report advisory findings (bare reads of "
+                        "guarded attributes)")
+    p.add_argument("-json", dest="as_json", action="store_true",
+                   help="machine-readable output")
+
     args = parser.parse_args(argv)
     if not args.command:
         parser.print_help()
@@ -586,6 +601,55 @@ def cmd_version(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """Run the static analyzers; exit 1 on unallowlisted findings.
+
+    This is the CI gate (tests/test_static_analysis.py runs it over the
+    package on every tier-1 run) and the local pre-commit loop: a new
+    finding is either fixed or earns a justified line in the allowlist.
+    """
+    from nomad_tpu.analysis import (default_allowlist_path, load_allowlist,
+                                    partition_findings, run_lint)
+
+    allowlist_path = args.allowlist or default_allowlist_path()
+    try:
+        allowlist = load_allowlist(allowlist_path)
+    except ValueError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    # Always analyze at full strictness so allowlist staleness is
+    # computed against every finding; -strict only controls whether
+    # unallowlisted advisory findings are *displayed*.
+    try:
+        findings = run_lint(args.path or None, strict=True)
+    except FileNotFoundError as e:
+        print(f"Error: no such package directory: {e}", file=sys.stderr)
+        return 1
+    gating, allowed, stale = partition_findings(findings, allowlist)
+    advisory = [f for f in findings
+                if f.severity != "error" and f.key not in allowlist]
+
+    if args.as_json:
+        print(json.dumps({
+            "gating": [f.__dict__ for f in gating],
+            "advisory": [f.__dict__ for f in advisory],
+            "allowlisted": len(allowed),
+            "stale_allowlist": stale,
+        }, indent=2))
+    else:
+        for f in gating:
+            print(f.render())
+        if args.strict:
+            for f in advisory:
+                print(f"{f.render()}  [advisory]")
+        for key in stale:
+            print(f"stale allowlist entry (no matching finding): {key}",
+                  file=sys.stderr)
+        print(f"{len(gating)} finding(s), {len(allowed)} allowlisted, "
+              f"{len(stale)} stale allowlist entr(ies)")
+    return 1 if gating or stale else 0
+
+
 COMMANDS = {
     "agent": cmd_agent,
     "init": cmd_init,
@@ -604,4 +668,5 @@ COMMANDS = {
     "monitor": cmd_monitor,
     "agent-info": cmd_agent_info,
     "version": cmd_version,
+    "lint": cmd_lint,
 }

@@ -12,6 +12,7 @@ import json
 import logging
 import os
 import threading
+from collections import deque
 from typing import Callable, Optional
 
 from nomad_tpu.structs import (
@@ -46,6 +47,22 @@ class AllocRunner:
         self.task_states: dict = {}
         self._destroy = threading.Event()
         self._lock = threading.Lock()
+        # Publication sequencing: _on_task_state stamps each aggregate
+        # with a sequence under _lock; _publish_lock serializes the
+        # publish (alloc swap + persist + server sync) and drops
+        # aggregates older than one already published, so two runner
+        # threads finishing together can't publish newest-first and let
+        # the stale status win.
+        self._publish_lock = threading.Lock()
+        self._state_seq = 0
+        self._published_seq = 0
+        # Server-sync outbox: on_status is a blocking RPC with retries —
+        # it must run OUTSIDE _publish_lock (or one unreachable server
+        # stalls every sibling publish and update()), but still in
+        # publish order.  Appends happen under _publish_lock; a single
+        # drainer at a time delivers FIFO.
+        self._status_outbox: "deque" = deque()
+        self._outbox_lock = threading.Lock()
 
     # -- state persistence -------------------------------------------------
     def _state_path(self) -> str:
@@ -89,19 +106,30 @@ class AllocRunner:
             return
         self.alloc_dir.build(tasks)
         self.save_state()
+        runners = []
         for task in tasks:
             # Use per-task resources from the scheduler when present.
             task_resources = self.alloc.task_resources.get(task.name)
             if task_resources is not None:
                 task = task.copy()
                 task.resources = task_resources
-            tr = TaskRunner(self.ctx, task, state_dir=self.state_dir,
-                            on_state=self._on_task_state)
-            self.task_runners[task.name] = tr
-            if restore and tr.restore_state():
-                # Re-attached to the live process: supervise it.
-                tr.start()
-                continue
+            runners.append(TaskRunner(self.ctx, task,
+                                      state_dir=self.state_dir,
+                                      on_state=self._on_task_state))
+        # Publish the COMPLETE runner set before starting any task: the
+        # first started task can die (or report running) immediately,
+        # firing _on_task_state from its runner thread — _aggregate must
+        # see every sibling, or a fast-exiting first task marks the whole
+        # alloc dead/failed with its siblings not yet created (and the
+        # dict would be mutated mid-iteration under _aggregate's walk).
+        with self._lock:
+            for tr in runners:
+                self.task_runners[tr.task.name] = tr
+        for tr in runners:
+            if restore:
+                # Re-attach to the live process when its handle is still
+                # valid; start() supervises either way.
+                tr.restore_state()
             tr.start()
 
     def _on_task_state(self, task_name: str, state: str,
@@ -110,8 +138,17 @@ class AllocRunner:
             self.task_states[task_name] = {"state": state,
                                            "description": description}
             status, desc = self._aggregate()
-        if status != self.alloc.client_status:
-            self._set_client_status(status, desc)
+            # Snapshot + sequence under the lock: a sibling task's runner
+            # thread may be inserting its own state while we publish ours,
+            # and the sequence lets the publisher drop this aggregate if a
+            # newer one already went out.
+            states = dict(self.task_states)
+            self._state_seq += 1
+            seq = self._state_seq
+        # No unlocked status pre-check here: even a "no change" aggregate
+        # must consume its seq under the publish lock, or an older
+        # in-flight aggregate slips past the fence afterwards.
+        self._set_client_status(status, desc, states, seq)
 
     def _aggregate(self) -> tuple[str, str]:
         """Task states -> alloc client status
@@ -127,27 +164,64 @@ class AllocRunner:
             return ALLOC_CLIENT_STATUS_RUNNING, ""
         return ALLOC_CLIENT_STATUS_PENDING, ""
 
-    def _set_client_status(self, status: str, description: str) -> None:
-        updated = self.alloc.copy()
-        updated.client_status = status
-        updated.client_description = description
-        updated.task_states = dict(self.task_states)
-        self.alloc = updated
-        self.save_state()
-        try:
-            self.on_status(updated)
-        except Exception:
-            logger.exception("alloc %s status sync failed", self.alloc.id)
+    def _set_client_status(self, status: str, description: str,
+                           task_states: Optional[dict] = None,
+                           seq: Optional[int] = None) -> None:
+        with self._publish_lock:
+            if seq is not None:
+                if seq <= self._published_seq:
+                    return  # a newer aggregate already published
+                # Consume the seq BEFORE the no-change skip: a skipped
+                # newest aggregate must still fence out older ones.
+                self._published_seq = seq
+                if status == self.alloc.client_status:
+                    return
+            if task_states is None:
+                with self._lock:
+                    task_states = dict(self.task_states)
+            updated = self.alloc.copy()
+            updated.client_status = status
+            updated.client_description = description
+            updated.task_states = task_states
+            self.alloc = updated
+            self.save_state()
+            self._status_outbox.append(updated)
+        self._drain_outbox()
+
+    def _drain_outbox(self) -> None:
+        """Deliver queued status syncs FIFO, one drainer at a time, with
+        the publish lock NOT held (on_status blocks on RPC retries).
+        The outer re-check closes the race where an appender bounces off
+        a drainer that is just finishing."""
+        while self._status_outbox:
+            if not self._outbox_lock.acquire(blocking=False):
+                return  # current drainer re-checks after releasing
+            try:
+                while True:
+                    try:
+                        updated = self._status_outbox.popleft()
+                    except IndexError:
+                        break
+                    try:
+                        self.on_status(updated)
+                    except Exception:
+                        logger.exception("alloc %s status sync failed",
+                                         updated.id)
+            finally:
+                self._outbox_lock.release()
 
     def update(self, alloc: Allocation) -> None:
         """Server pushed a new version of this alloc."""
         # Keep client-authoritative fields; take the server's view of the
-        # rest (desired status, job version, modify index).
+        # rest (desired status, job version, modify index).  The
+        # read-merge-write of self.alloc must hold the publish lock or a
+        # task thread's concurrent status publish is silently lost.
         alloc = alloc.copy()
-        alloc.client_status = self.alloc.client_status
-        alloc.client_description = self.alloc.client_description
-        alloc.task_states = self.alloc.task_states
-        self.alloc = alloc
+        with self._publish_lock:
+            alloc.client_status = self.alloc.client_status
+            alloc.client_description = self.alloc.client_description
+            alloc.task_states = self.alloc.task_states
+            self.alloc = alloc
         if alloc.desired_status != ALLOC_DESIRED_STATUS_RUN:
             self.destroy_tasks()
             return

@@ -314,72 +314,113 @@ class PlanApplier:
             pending = self.plan_queue.dequeue(0)
             if pending is None:
                 return  # queue disabled: leadership lost
-
-            plan = pending.plan
-            # Token fencing: the eval must be outstanding and the token
-            # must match (guards split-brain schedulers, plan_apply.go:53).
-            token, ok = self.eval_broker.outstanding(plan.eval_id)
-            if not ok:
-                pending.respond(None, RuntimeError(
-                    "evaluation is not outstanding"))
-                continue
-            if plan.eval_token != token:
-                pending.respond(None, RuntimeError(
-                    "evaluation token does not match"))
-                continue
-
-            # If the previous apply finished, drop the stale overlay; else
-            # keep verifying against the optimistic view (this is the
-            # verify/apply overlap, plan_apply.go:68-85).
-            if wait_future is not None and wait_future.done():
-                wait_future = None
-                snap = None
-            if snap is None:
-                snap = OptimisticSnapshot(self.state_fn().snapshot())
-
-            result = evaluate_plan(snap, plan)
-            if result.is_noop():
-                pending.respond(result, None)
-                continue
-
-            # One apply in flight at a time: wait for the previous one and
-            # refresh the snapshot before dispatching (plan_apply.go:100-110;
-            # the evaluation above already ran against the optimistic view).
-            if wait_future is not None:
-                try:
-                    wait_future.wait()
-                except Exception:
-                    pass
-                wait_future = None
-                snap = OptimisticSnapshot(self.state_fn().snapshot())
-
-            # Apply through raft; respond when committed.
-            allocs = []
-            for updates in result.node_update.values():
-                allocs.extend(updates)
-            for placements in result.node_allocation.values():
-                allocs.extend(placements)
-            allocs.extend(result.failed_allocs)
-            entry = codec.encode(codec.ALLOC_UPDATE_REQUEST,
-                                 {"alloc": [a.to_dict() for a in allocs]})
             try:
-                future = self.raft.apply(entry)
+                wait_future, snap = self._apply_one(pending, wait_future,
+                                                    snap)
             except Exception as e:
+                # A popped future must ALWAYS be responded: an applier
+                # dying with one in hand would park its worker forever
+                # (workers probe queue liveness, and the queue is still
+                # alive — only this thread died).  Only PRE-commit
+                # exceptions reach here (_apply_one handles its own
+                # post-raft.apply failures), so an error respond is
+                # truthful.  Serialize out the in-flight apply before
+                # dropping the overlay: the next plan's fresh snapshot
+                # must include it or verification re-admits conflicts.
+                logger.exception("plan applier: unexpected failure")
                 pending.respond(None, e)
-                continue
+                if wait_future is not None:
+                    try:
+                        wait_future.wait()
+                    except Exception:
+                        pass
+                wait_future, snap = None, None
 
-            # Optimistically fold the result into the overlay so the next
-            # plan verifies against it.
+    def _apply_one(self, pending, wait_future, snap):
+        """Process one popped plan; returns the (wait_future, snap)
+        verify/apply-overlap state carried to the next iteration."""
+        plan = pending.plan
+        # Token fencing: the eval must be outstanding and the token
+        # must match (guards split-brain schedulers, plan_apply.go:53).
+        token, ok = self.eval_broker.outstanding(plan.eval_id)
+        if not ok:
+            pending.respond(None, RuntimeError(
+                "evaluation is not outstanding"))
+            return wait_future, snap
+        if plan.eval_token != token:
+            pending.respond(None, RuntimeError(
+                "evaluation token does not match"))
+            return wait_future, snap
+
+        # If the previous apply finished, drop the stale overlay; else
+        # keep verifying against the optimistic view (this is the
+        # verify/apply overlap, plan_apply.go:68-85).
+        if wait_future is not None and wait_future.done():
+            wait_future = None
+            snap = None
+        if snap is None:
+            snap = OptimisticSnapshot(self.state_fn().snapshot())
+
+        result = evaluate_plan(snap, plan)
+        if result.is_noop():
+            pending.respond(result, None)
+            return wait_future, snap
+
+        # One apply in flight at a time: wait for the previous one and
+        # refresh the snapshot before dispatching (plan_apply.go:100-110;
+        # the evaluation above already ran against the optimistic view).
+        if wait_future is not None:
+            try:
+                wait_future.wait()
+            except Exception:
+                pass
+            wait_future = None
+            snap = OptimisticSnapshot(self.state_fn().snapshot())
+
+        # Apply through raft; respond when committed.
+        allocs = []
+        for updates in result.node_update.values():
+            allocs.extend(updates)
+        for placements in result.node_allocation.values():
+            allocs.extend(placements)
+        allocs.extend(result.failed_allocs)
+        entry = codec.encode(codec.ALLOC_UPDATE_REQUEST,
+                             {"alloc": [a.to_dict() for a in allocs]})
+        try:
+            future = self.raft.apply(entry)
+        except Exception as e:
+            pending.respond(None, e)
+            return wait_future, snap
+
+        # From here the entry is committed (or committing): failures in
+        # the bookkeeping below must not surface as plan errors — the
+        # worker would retry an already-applied plan and double-place.
+        def respond(fut=future, res=result, pend=pending) -> None:
+            try:
+                index, _ = fut.wait()
+            except Exception as e:
+                pend.respond(None, e)
+                return
+            res.alloc_index = index
+            pend.respond(res, None)
+
+        try:
+            # Optimistically fold the result into the overlay so the
+            # next plan verifies against it.
             snap.upsert_allocs(allocs)
             wait_future = future
-
-            def respond(fut=future, res=result, pend=pending) -> None:
-                try:
-                    index, _ = fut.wait()
-                except Exception as e:
-                    pend.respond(None, e)
-                    return
-                res.alloc_index = index
-                pend.respond(res, None)
-
+        except Exception:
+            # Overlay lost: serialize this apply out and start the next
+            # plan from a fresh post-commit snapshot.
+            logger.exception("plan applier: overlay fold failed; "
+                             "serializing this apply")
+            try:
+                future.wait()
+            except Exception:
+                pass
+            wait_future, snap = None, None
+        try:
             threading.Thread(target=respond, daemon=True).start()
+        except Exception:
+            respond()  # degraded (blocks the applier) but always answers
+        return wait_future, snap

@@ -302,10 +302,15 @@ class InmemRaft:
         return self.applied_index()
 
     def _maybe_snapshot(self) -> None:
-        if self.snapshots is None or \
-                self._entries_since_snap < self.snapshot_threshold:
-            return
+        if self.snapshots is None:
+            return  # set once in __init__, safe to read bare
         with self._lock:
+            # Threshold check and counter reset must be one atomic step:
+            # checked bare, two concurrent appliers both pass it and both
+            # snapshot+truncate (duplicate compaction work, and the
+            # second truncate races the first's fresh appends).
+            if self._entries_since_snap < self.snapshot_threshold:
+                return
             blob = self.fsm.snapshot()
             # Term 0: the single-node backend has no elections; NetRaft
             # reading this snapshot starts with a base term of 0.

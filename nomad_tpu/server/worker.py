@@ -28,6 +28,7 @@ logger = logging.getLogger("nomad_tpu.server.worker")
 RAFT_SYNC_LIMIT = 5.0  # reference worker.go:34-37
 BACKOFF_BASE = 0.05
 BACKOFF_LIMIT = 3.0
+PLAN_WAIT_POLL = 2.0   # liveness probe interval while awaiting a plan
 
 
 class Worker:
@@ -120,11 +121,25 @@ class Worker:
         metrics.measure_since("nomad.worker.invoke_scheduler." + name,
                               start)
 
+    def _wait_plan(self, future):
+        """Bounded future wait with a liveness probe: the applier always
+        responds while the leader is alive, but leadership loss (or a
+        test teardown) can orphan an already-submitted plan — a worker
+        blocked forever here pins its whole dispatch (including the
+        gc_pause the fused path runs under) for the process lifetime."""
+        while True:
+            try:
+                return future.wait(PLAN_WAIT_POLL)
+            except TimeoutError:
+                if not self.server.plan_queue.enabled():
+                    raise RuntimeError(
+                        "plan queue closed while awaiting plan result")
+
     # -- Planner seam ------------------------------------------------------
     def submit_plan(self, plan: Plan) -> tuple[PlanResult, Optional[object]]:
         plan.eval_token = self.eval_token
         future = self.server.plan_queue.enqueue(plan)
-        result = future.wait()
+        result = self._wait_plan(future)
         state = None
         if result is not None and result.refresh_index > 0:
             # Stale scheduler data: catch up and hand back a fresh view.
@@ -209,7 +224,7 @@ class _BatchPlanner:
     def submit_plan(self, plan: Plan):
         plan.eval_token = self.worker._tokens.get(plan.eval_id, "")
         future = self.worker.server.plan_queue.enqueue(plan)
-        result = future.wait()
+        result = self.worker._wait_plan(future)
         state = None
         if result is not None and result.refresh_index > 0:
             self.worker._wait_for_index(result.refresh_index,

@@ -21,6 +21,11 @@ from __future__ import annotations
 
 import contextlib
 import gc
+import threading
+
+_pause_lock = threading.Lock()
+_pause_depth = 0
+_pause_was_enabled = False
 
 
 @contextlib.contextmanager
@@ -31,14 +36,26 @@ def gc_pause():
     collections mid-burst promote every survivor (the plans stay
     referenced) and cost ~20% of storm throughput.  The burst is
     bounded, the domain objects are reference-acyclic, and collection
-    resumes on exit — deferral, not leakage.  Nest-safe."""
-    was_enabled = gc.isenabled()
-    gc.disable()
+    resumes on exit — deferral, not leakage.
+
+    Nest-safe AND thread-safe via a refcount: bursts overlap across
+    batch-worker threads, and the old save/restore-per-caller scheme let
+    one thread's exit re-enable gc in the middle of another thread's
+    burst (and an interleaved save could restore the wrong state).  The
+    outermost enter saves, the last exit restores."""
+    global _pause_depth, _pause_was_enabled
+    with _pause_lock:
+        if _pause_depth == 0:
+            _pause_was_enabled = gc.isenabled()
+            gc.disable()
+        _pause_depth += 1
     try:
         yield
     finally:
-        if was_enabled:
-            gc.enable()
+        with _pause_lock:
+            _pause_depth -= 1
+            if _pause_depth == 0 and _pause_was_enabled:
+                gc.enable()
 
 
 def tune_gc(gen0: int = 50_000, gen1: int = 50, gen2: int = 50,

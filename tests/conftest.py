@@ -31,6 +31,46 @@ def pytest_configure(config):
         "markers", "slow: long-running smoke tests (driver entry points)")
 
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def runtime_sanitizers():
+    """Suite-wide runtime sanitizers (nomad_tpu/analysis/sanitizers.py):
+
+    - lock-order witness: every package lock created during the suite is
+      instrumented; an observed lock-order cycle (the deadlock
+      precondition) fails the session at teardown.
+    - recompile sentinel: a jit kernel retracing past its budget fails
+      the session — the silent perf-erosion mode behavioral tests miss.
+
+    Disable with NOMAD_TPU_SANITIZERS=0 (e.g. when bisecting an
+    unrelated failure).  Both only observe; no test behavior changes.
+    """
+    if os.environ.get("NOMAD_TPU_SANITIZERS", "1") == "0":
+        yield
+        return
+    from nomad_tpu.analysis.sanitizers import (LockOrderWitness,
+                                               RecompileSentinel)
+
+    witness = LockOrderWitness().install()
+    sentinel = RecompileSentinel().install()
+    try:
+        yield
+    finally:
+        witness.uninstall()
+    # Collect-then-raise so one sanitizer tripping doesn't mask the
+    # other's report for the same session.
+    errors = []
+    for check in (witness.check, sentinel.check):
+        try:
+            check()
+        except AssertionError as e:
+            errors.append(str(e))
+    if errors:
+        raise AssertionError("\n".join(errors))
+
+
 def wait_until(fn, timeout=15.0, msg="condition"):
     """The universal convergence helper (reference testutil/wait.go
     WaitForResult); shared by the agent/HTTP suites."""

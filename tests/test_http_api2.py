@@ -207,6 +207,11 @@ def test_eval_endpoints(agent):
 
 
 def test_blocking_query_returns_on_change(agent):
+    # Self-containment: on a fresh agent the jobs table index is 0 and
+    # `?index=0` takes the immediate-return path without ever parking a
+    # watcher — seed one write so the long-poll actually blocks.
+    seed, _ = _register(agent)
+    _req(agent, f"/v1/job/{seed.id}", "DELETE")
     _c, headers, _raw = _req(agent, "/v1/jobs")
     index = int(headers["X-Nomad-Index"])
 
@@ -219,7 +224,11 @@ def test_blocking_query_returns_on_change(agent):
 
     t = threading.Thread(target=blocked)
     t.start()
-    time.sleep(0.2)
+    # Event-driven: the query is parked once the store has a watcher on
+    # the jobs table (was a fixed 0.2s sleep).
+    wait_until(lambda: ("jobs",) in
+               agent.server.fsm.state.watch._groups,
+               msg="blocking query parked server-side")
     job, _ = _register(agent)
     t.join(timeout=10)
     assert not t.is_alive(), "blocking query must return on the write"

@@ -7,6 +7,7 @@ import time
 import pytest
 
 import nomad_tpu.mock as mock
+from tests.conftest import wait_until
 from nomad_tpu.server import (
     EvalBroker,
     NomadFSM,
@@ -87,7 +88,9 @@ class TestEvalBroker:
         ev = make_eval()
         b.enqueue(ev)
         got, token = b.dequeue(["service"], timeout=1)
-        time.sleep(0.15)  # nack timer auto-fires
+        # Event-driven: the timer's auto-nack shows up as a ready eval.
+        wait_until(lambda: b.stats()["total_ready"] == 1,
+                   msg="nack timer requeue")
         got2, _ = b.dequeue(["service"], timeout=1)
         assert got2.id == ev.id
 
@@ -133,9 +136,156 @@ class TestEvalBroker:
         b.ack(ev.id, token)
 
 
+class TestEvalBrokerEdgeTable:
+    """The reference's eval_broker_test.go scenario table
+    (/root/reference/nomad/eval_broker_test.go): nack-timer redelivery
+    accounting, delivery-limit -> `_failed` lifecycle, token rotation,
+    and ordering guarantees."""
+
+    def test_nack_timer_redeliveries_count_toward_limit(self):
+        """TestEvalBroker_Nack_Timeout + delivery limit: redeliveries
+        caused by the nack TIMER (a worker died silently) are deliveries
+        too — enough of them routes the eval to `_failed`, it does not
+        ping-pong forever."""
+        b = EvalBroker(nack_timeout=0.05, delivery_limit=2)
+        b.set_enabled(True)
+        ev = make_eval()
+        b.enqueue(ev)
+        for _ in range(2):  # two deliveries, neither acked
+            got, _token = b.dequeue(["service"], timeout=1)
+            assert got.id == ev.id
+            wait_until(lambda: b.stats()["total_ready"] == 1,
+                       msg="nack timer requeue")
+        # Past the limit: the timer's own nack routed it to _failed.
+        got, token = b.dequeue(["_failed"], timeout=1)
+        assert got.id == ev.id
+        b.ack(ev.id, token)
+
+    def test_token_rotates_on_timer_redelivery(self):
+        """After a nack-timer redelivery the OLD delivery token is dead:
+        a zombie worker acking with it must be rejected, and
+        `outstanding` reports the new token."""
+        b = EvalBroker(nack_timeout=0.05, delivery_limit=5)
+        b.set_enabled(True)
+        ev = make_eval()
+        b.enqueue(ev)
+        _got, token1 = b.dequeue(["service"], timeout=1)
+        wait_until(lambda: b.stats()["total_ready"] == 1,
+                   msg="nack timer requeue")
+        _got2, token2 = b.dequeue(["service"], timeout=1)
+        assert token1 != token2
+        out_token, ok = b.outstanding(ev.id)
+        assert ok and out_token == token2
+        with pytest.raises(ValueError):
+            b.ack(ev.id, token1)
+        b.ack(ev.id, token2)
+
+    def test_failed_queue_ack_releases_job_serialization(self):
+        """TestEvalBroker_DeliveryLimit: an eval nacked past the limit is
+        dequeued from `_failed` like any queue; acking it releases the
+        per-job serialization so the job's NEXT eval flows."""
+        b = EvalBroker(nack_timeout=5, delivery_limit=1)
+        b.set_enabled(True)
+        ev = make_eval()
+        b.enqueue(ev)
+        got, token = b.dequeue(["service"], timeout=1)
+        b.nack(ev.id, token)
+        # Delivery limit 1: straight to the failed queue.
+        stats = b.stats()
+        assert stats["by_scheduler"].get("_failed") == 1
+        got, token = b.dequeue(["_failed"], timeout=1)
+        assert got.id == ev.id
+        # While outstanding from _failed, a sibling eval stays blocked.
+        ev2 = make_eval(job_id=ev.job_id)
+        b.enqueue(ev2)
+        assert b.stats()["total_blocked"] == 1
+        b.ack(ev.id, token)
+        got2, token2 = b.dequeue(["service"], timeout=1)
+        assert got2.id == ev2.id
+        b.ack(ev2.id, token2)
+        assert b.stats()["total_ready"] == 0
+
+    def test_fifo_within_priority(self):
+        """TestEvalBroker_Dequeue_FIFO: same priority drains in create
+        order (create_index ascending)."""
+        b = EvalBroker(5, 3)
+        b.set_enabled(True)
+        evs = []
+        for i in range(5):
+            ev = make_eval(priority=50)
+            ev.create_index = 100 + i
+            evs.append(ev)
+        for ev in reversed(evs):  # enqueue newest first on purpose
+            b.enqueue(ev)
+        got = [b.dequeue(["service"], timeout=1)[0].id for _ in evs]
+        assert got == [ev.id for ev in evs]
+
+    def test_blocked_promotion_is_priority_ordered(self):
+        """Blocked same-job evals promote highest-priority first when the
+        in-flight eval acks (PendingEvaluations heap ordering)."""
+        b = EvalBroker(5, 3)
+        b.set_enabled(True)
+        first = make_eval(priority=50)
+        b.enqueue(first)
+        low = make_eval(priority=10, job_id=first.job_id)
+        high = make_eval(priority=90, job_id=first.job_id)
+        b.enqueue(low)
+        b.enqueue(high)
+        got, token = b.dequeue(["service"], timeout=1)
+        assert got.id == first.id
+        assert b.stats()["total_blocked"] == 2
+        b.ack(first.id, token)
+        got2, token2 = b.dequeue(["service"], timeout=1)
+        assert got2.id == high.id
+        b.ack(high.id, token2)
+        got3, token3 = b.dequeue(["service"], timeout=1)
+        assert got3.id == low.id
+        b.ack(low.id, token3)
+
+    def test_nack_resets_delivery_token_immediately(self):
+        """An explicit Nack invalidates the old token synchronously (no
+        timer involved) — the redelivered eval carries a fresh one."""
+        b = EvalBroker(nack_timeout=5, delivery_limit=3)
+        b.set_enabled(True)
+        ev = make_eval()
+        b.enqueue(ev)
+        _got, token1 = b.dequeue(["service"], timeout=1)
+        b.nack(ev.id, token1)
+        _token, ok = b.outstanding(ev.id)
+        assert not ok  # nothing outstanding until redelivered
+        _got2, token2 = b.dequeue(["service"], timeout=1)
+        assert token2 != token1
+        with pytest.raises(ValueError):
+            b.ack(ev.id, token1)
+        b.ack(ev.id, token2)
+
+
 # ---------------------------------------------------------------------------
 # PlanQueue
 # ---------------------------------------------------------------------------
+
+def test_worker_unblocks_when_plan_queue_dies(monkeypatch):
+    """A worker awaiting a plan future whose applier died (leadership
+    loss mid-pop) must error out once the queue is closed, not block
+    forever — a parked worker pins its dispatch's gc_pause for the
+    process lifetime (runtime-sanitizer regression)."""
+    from nomad_tpu.server import worker as worker_mod
+
+    monkeypatch.setattr(worker_mod, "PLAN_WAIT_POLL", 0.05)
+    pq = PlanQueue()
+    pq.set_enabled(True)
+
+    class FakeServer:
+        plan_queue = pq
+
+    w = worker_mod.Worker(FakeServer())
+    future = pq.enqueue(Plan())
+    pending = pq.dequeue(timeout=1)   # the applier popped it...
+    assert pending is not None
+    pq.set_enabled(False)             # ...then leadership died: no respond
+    with pytest.raises(RuntimeError, match="plan queue closed"):
+        w._wait_plan(future)
+
 
 class TestPlanQueue:
     def test_priority_order_and_future(self):

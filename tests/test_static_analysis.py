@@ -1,0 +1,791 @@
+"""Tier-1 gate for the static analyzers + runtime sanitizers.
+
+Three layers, mirroring the reference's `go vet` + `go test -race` CI
+discipline (reference scripts/test.sh:12-13):
+
+1. **The standing gate**: `nomad-tpu lint` over the real package must be
+   clean — zero unallowlisted findings, zero stale allowlist entries,
+   every allowlist line justified.
+2. **Analyzer unit tests** on synthetic packages: each rule (bare-write,
+   lock-cycle, nested-self-acquire, impure-call, concretize,
+   traced-branch, static-arg exemptions) proves it fires — a lint that
+   cannot fail gates nothing.
+3. **Runtime sanitizers** cross-checking the static results: the
+   lock-order witness observes real acquisition chains through a real
+   EvalBroker/plan-queue workload (cycles fail), and the recompile
+   sentinel fails a kernel retracing past its budget.
+"""
+from __future__ import annotations
+
+import textwrap
+import threading
+import time
+
+import pytest
+
+from nomad_tpu.analysis import (
+    Finding,
+    default_allowlist_path,
+    load_allowlist,
+    partition_findings,
+    run_lint,
+)
+from nomad_tpu.analysis import jaxlint, lockcheck
+from nomad_tpu.analysis.sanitizers import (
+    DEFAULT_BUDGET,
+    LockOrderWitness,
+    RecompileSentinel,
+)
+
+
+def write_pkg(tmp_path, name, source) -> str:
+    d = tmp_path / name
+    d.mkdir(exist_ok=True)
+    (d / "mod.py").write_text(textwrap.dedent(source))
+    return str(d)
+
+
+# ---------------------------------------------------------------------------
+# 1. the standing gate
+# ---------------------------------------------------------------------------
+
+class TestLintGate:
+    def test_package_is_clean(self):
+        """THE gate: every finding over nomad_tpu/ is fixed or carries a
+        justified allowlist line, and no allowlist line is stale."""
+        allowlist = load_allowlist(default_allowlist_path())
+        findings = run_lint(strict=True)
+        gating, allowed, stale = partition_findings(findings, allowlist)
+        assert not gating, "unallowlisted findings:\n" + "\n".join(
+            f.render() for f in gating)
+        assert not stale, f"stale allowlist entries (remove them): {stale}"
+
+    def test_every_allowlist_entry_is_justified(self):
+        # load_allowlist raises on an unjustified line; also sanity-check
+        # the parsed justifications are real sentences, not "x".
+        allowlist = load_allowlist(default_allowlist_path())
+        for key, why in allowlist.items():
+            assert len(why) > 10, f"throwaway justification for {key}"
+
+    def test_unjustified_entry_rejected(self, tmp_path):
+        p = tmp_path / "allow.txt"
+        p.write_text("bare-write:a.py:C.x\n")
+        with pytest.raises(ValueError, match="justification"):
+            load_allowlist(str(p))
+
+    def test_cli_lint_runs_clean(self, capsys):
+        from nomad_tpu.cli.main import main
+
+        assert main(["lint"]) == 0
+        out = capsys.readouterr().out
+        assert "0 finding(s)" in out
+
+    def test_stale_allowlist_entry_gates(self):
+        findings = [Finding("bare-write", "a.py", "C.x", "m")]
+        gating, allowed, stale = partition_findings(
+            findings, {"bare-write:a.py:C.x": "ok",
+                       "bare-write:gone.py:D.y": "fixed long ago"})
+        assert not gating and len(allowed) == 1
+        assert stale == ["bare-write:gone.py:D.y"]
+
+
+# ---------------------------------------------------------------------------
+# 2a. lock-discipline analyzer units
+# ---------------------------------------------------------------------------
+
+class TestLockcheck:
+    def test_bare_write_flagged(self, tmp_path):
+        pkg = write_pkg(tmp_path, "p1", """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+                def inc(self):
+                    with self._lock:
+                        self.n += 1
+                def bad(self):
+                    self.n = 0
+        """)
+        fs = lockcheck.analyze_package(pkg)
+        assert [f.rule for f in fs] == ["bare-write"]
+        assert fs[0].where == "C.n"
+        assert "bad" in fs[0].message
+
+    def test_locked_suffix_convention_trusted(self, tmp_path):
+        pkg = write_pkg(tmp_path, "p2", """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+                def inc(self):
+                    with self._lock:
+                        self._inc_locked()
+                def _inc_locked(self):
+                    self.n += 1
+        """)
+        assert lockcheck.analyze_package(pkg) == []
+
+    def test_private_helper_called_under_lock_inferred(self, tmp_path):
+        pkg = write_pkg(tmp_path, "p3", """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+                def inc(self):
+                    with self._lock:
+                        self._bump()
+                def dec(self):
+                    with self._lock:
+                        self._bump()
+                def _bump(self):
+                    self.n += 1
+        """)
+        assert lockcheck.analyze_package(pkg) == []
+
+    def test_ctor_only_helper_exempt(self, tmp_path):
+        pkg = write_pkg(tmp_path, "p4", """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+                    self._restore()
+                def _restore(self):
+                    self.n = 42
+                def inc(self):
+                    with self._lock:
+                        self.n += 1
+        """)
+        assert lockcheck.analyze_package(pkg) == []
+
+    def test_threadsafe_containers_exempt(self, tmp_path):
+        pkg = write_pkg(tmp_path, "p5", """
+            import queue
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+                def locked_put(self, x):
+                    with self._lock:
+                        self._q.put(x)
+                def bare_put(self, x):
+                    self._q.put(x)
+        """)
+        assert lockcheck.analyze_package(pkg) == []
+
+    def test_condition_aliases_its_lock(self, tmp_path):
+        pkg = write_pkg(tmp_path, "p6", """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cond = threading.Condition(self._lock)
+                    self.items = []
+                def put(self, x):
+                    with self._cond:
+                        self.items.append(x)
+                def drain(self):
+                    with self._lock:
+                        self.items.clear()
+        """)
+        assert lockcheck.analyze_package(pkg) == []
+
+    def test_lock_cycle_reported(self, tmp_path):
+        pkg = write_pkg(tmp_path, "p7", """
+            import threading
+            class Inner:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def poke(self, outer):
+                    with self._lock:
+                        outer.touch()
+            class Outer:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.inner = Inner()
+                def go(self):
+                    with self._lock:
+                        self.inner.poke(self)
+                def touch(self):
+                    with self._lock:
+                        pass
+        """)
+        fs = lockcheck.analyze_package(pkg)
+        cycles = [f for f in fs if f.rule == "lock-cycle"]
+        assert cycles and "Inner._lock" in cycles[0].message \
+            and "Outer._lock" in cycles[0].message
+
+    def test_nested_self_acquire_of_plain_lock(self, tmp_path):
+        pkg = write_pkg(tmp_path, "p8", """
+            import threading
+            _LOCK = threading.Lock()
+            def outer():
+                with _LOCK:
+                    inner()
+            def inner():
+                with _LOCK:
+                    pass
+        """)
+        fs = lockcheck.analyze_package(pkg)
+        assert any(f.rule == "nested-self-acquire" for f in fs)
+
+    def test_nested_rlock_not_flagged(self, tmp_path):
+        pkg = write_pkg(tmp_path, "p9", """
+            import threading
+            _LOCK = threading.RLock()
+            def outer():
+                with _LOCK:
+                    inner()
+            def inner():
+                with _LOCK:
+                    pass
+        """)
+        assert lockcheck.analyze_package(pkg) == []
+
+    def test_module_global_discipline(self, tmp_path):
+        pkg = write_pkg(tmp_path, "p10", """
+            import threading
+            _LOCK = threading.Lock()
+            _cache = None
+            def set_locked(v):
+                global _cache
+                with _LOCK:
+                    _cache = v
+            def set_bare(v):
+                global _cache
+                _cache = v
+        """)
+        fs = lockcheck.analyze_package(pkg)
+        assert any(f.rule == "bare-write" and
+                   f.where.endswith("mod._cache") for f in fs)
+
+    def test_conditionally_guarded_global_not_flagged(self, tmp_path):
+        """A `with LOCK:` write nested under if/for/try is guarded; the
+        walker must not rescan it at the enclosing bare depth
+        (code-review regression)."""
+        pkg = write_pkg(tmp_path, "p12", """
+            import threading
+            _LOCK = threading.Lock()
+            _cache = None
+            def set_maybe(c, v):
+                global _cache
+                if c:
+                    with _LOCK:
+                        _cache = v
+            def reader():
+                with _LOCK:
+                    return _cache
+        """)
+        assert lockcheck.analyze_package(pkg) == []
+
+    def test_thread_body_does_not_inherit_lock(self, tmp_path):
+        """A nested def (thread target) started under the lock runs
+        WITHOUT it — its writes are bare."""
+        pkg = write_pkg(tmp_path, "p11", """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+                def inc(self):
+                    with self._lock:
+                        self.n += 1
+                def spawn(self):
+                    with self._lock:
+                        def body():
+                            self.n = 99
+                        threading.Thread(target=body).start()
+        """)
+        fs = lockcheck.analyze_package(pkg)
+        assert [f.rule for f in fs] == ["bare-write"]
+
+
+# ---------------------------------------------------------------------------
+# 2b. JAX tracer-safety lint units
+# ---------------------------------------------------------------------------
+
+class TestJaxlint:
+    def test_impure_branch_concretize(self, tmp_path):
+        pkg = write_pkg(tmp_path, "j1", """
+            import time
+            import jax
+
+            @jax.jit
+            def bad(x):
+                t = time.time()
+                if x > 0:
+                    x = x + t
+                return float(x)
+        """)
+        rules = {f.rule for f in jaxlint.analyze_package(pkg)}
+        assert rules == {"impure-call", "traced-branch", "concretize"}
+
+    def test_static_args_and_shapes_exempt(self, tmp_path):
+        pkg = write_pkg(tmp_path, "j2", """
+            from functools import partial
+            import jax
+            import jax.numpy as jnp
+
+            @partial(jax.jit, static_argnames=("unroll",))
+            def ok(x, unroll):
+                if unroll > 1:
+                    x = x * 2
+                if x.shape[0] > 4:
+                    x = x[:4]
+                for _ in range(3):
+                    x = x + 1
+                return jnp.sum(x)
+        """)
+        assert jaxlint.analyze_package(pkg) == []
+
+    def test_wrapper_form_and_static_argnums(self, tmp_path):
+        pkg = write_pkg(tmp_path, "j3", """
+            import jax
+
+            def _impl(x, n):
+                if n > 2:
+                    return x
+                if x > 0:
+                    return -x
+                return x
+
+            kernel = jax.jit(_impl, static_argnums=(1,))
+        """)
+        fs = jaxlint.analyze_package(pkg)
+        assert [f.rule for f in fs] == ["traced-branch"]
+        assert "if x > 0" in fs[0].message
+
+    def test_callee_walk(self, tmp_path):
+        pkg = write_pkg(tmp_path, "j4", """
+            import jax
+
+            def helper(y):
+                return y.item()
+
+            @jax.jit
+            def root(x):
+                return helper(x)
+        """)
+        fs = jaxlint.analyze_package(pkg)
+        assert [f.rule for f in fs] == ["concretize"]
+        assert "root -> helper" in fs[0].where
+
+    def test_scan_closure_analyzed(self, tmp_path):
+        pkg = write_pkg(tmp_path, "j5", """
+            import jax
+            from jax import lax
+
+            @jax.jit
+            def root(xs):
+                def step(carry, x):
+                    if x > 0:
+                        carry = carry + x
+                    return carry, x
+                return lax.scan(step, 0.0, xs)
+        """)
+        fs = jaxlint.analyze_package(pkg)
+        assert [f.rule for f in fs] == ["traced-branch"]
+        assert "root.step" in fs[0].where
+
+    def test_attr_mutation_flagged(self, tmp_path):
+        pkg = write_pkg(tmp_path, "j6", """
+            import jax
+
+            state = {}
+
+            @jax.jit
+            def root(x, obj):
+                obj.cache = x
+                return x
+        """)
+        fs = jaxlint.analyze_package(pkg)
+        assert [f.rule for f in fs] == ["attr-mutation"]
+
+    def test_colliding_basenames_resolve_by_dotted_path(self, tmp_path):
+        """Two modules named helper.py in different subpackages: the
+        callee walk must follow the IMPORTED one, not the first basename
+        match (code-review regression)."""
+        root = tmp_path / "pkg"
+        (root / "a").mkdir(parents=True)
+        (root / "b").mkdir()
+        (root / "__init__.py").write_text("")
+        (root / "a" / "__init__.py").write_text("")
+        (root / "b" / "__init__.py").write_text("")
+        (root / "a" / "helper.py").write_text(textwrap.dedent("""
+            def work(y):
+                return y  # clean
+        """))
+        (root / "b" / "helper.py").write_text(textwrap.dedent("""
+            def work(y):
+                return y.item()  # concretizes
+        """))
+        (root / "b" / "kern.py").write_text(textwrap.dedent("""
+            import jax
+            from pkg.b.helper import work
+
+            @jax.jit
+            def root_fn(x):
+                return work(x)
+        """))
+        fs = jaxlint.analyze_package(str(root))
+        assert [f.rule for f in fs] == ["concretize"]
+        assert fs[0].path.endswith("b/helper.py")
+
+    def test_repo_kernels_are_clean(self):
+        """The real kernels (ops/, parallel/, models/) carry no tracer
+        hazards — this is what keeps the 98.6x headline's parity
+        guarantees enforceable per-PR."""
+        assert jaxlint.analyze_package("nomad_tpu") == []
+
+
+# ---------------------------------------------------------------------------
+# 3a. lock-order witness
+# ---------------------------------------------------------------------------
+
+class TestLockOrderWitness:
+    def _mkmod(self, tmp_path, source):
+        import importlib.util
+        import sys
+
+        p = tmp_path / f"wit_{abs(hash(source)) % 10**8}.py"
+        p.write_text(textwrap.dedent(source))
+        spec = importlib.util.spec_from_file_location(p.stem, p)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[p.stem] = mod
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_cycle_detected(self, tmp_path):
+        w = LockOrderWitness(package_prefix=str(tmp_path))
+        with w:
+            mod = self._mkmod(tmp_path, """
+                import threading
+                def make():
+                    a = threading.Lock()
+                    b = threading.Lock()
+                    return a, b
+                def ab(a, b):
+                    with a:
+                        with b: pass
+                def ba(a, b):
+                    with b:
+                        with a: pass
+            """)
+            a, b = mod.make()
+            mod.ab(a, b)
+            mod.ba(a, b)
+        assert len(w.edges) == 2
+        with pytest.raises(AssertionError, match="lock-order cycles"):
+            w.check()
+
+    def test_consistent_order_passes(self, tmp_path):
+        w = LockOrderWitness(package_prefix=str(tmp_path))
+        with w:
+            mod = self._mkmod(tmp_path, """
+                import threading
+                def make():
+                    a = threading.Lock()
+                    b = threading.Lock()
+                    return a, b
+                def ab(a, b):
+                    with a:
+                        with b: pass
+            """)
+            a, b = mod.make()
+            for _ in range(3):
+                mod.ab(a, b)
+        assert len(w.edges) == 1
+        w.check()  # no cycle
+
+    def test_foreign_locks_not_wrapped(self, tmp_path):
+        w = LockOrderWitness(package_prefix=str(tmp_path / "nowhere"))
+        with w:
+            lock = threading.Lock()  # created from test code: unwrapped
+            assert type(lock).__name__ != "_WrappedLock"
+            with lock:
+                pass
+        assert w.edges == {}
+
+    def test_condition_wait_notify_roundtrip(self, tmp_path):
+        """EvalBroker-style Condition(lock) keeps working (and stays
+        tracked) through the wrapper, including the wait/notify
+        release-save/acquire-restore path."""
+        w = LockOrderWitness(package_prefix=str(tmp_path))
+        with w:
+            mod = self._mkmod(tmp_path, """
+                import threading
+                class Q:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._cond = threading.Condition(self._lock)
+                        self.items = []
+                    def put(self, x):
+                        with self._lock:
+                            self.items.append(x)
+                            self._cond.notify_all()
+                    def get(self):
+                        with self._lock:
+                            while not self.items:
+                                self._cond.wait(2.0)
+                            return self.items.pop()
+            """)
+            q = mod.Q()
+            out = []
+            t = threading.Thread(target=lambda: out.append(q.get()))
+            t.start()
+            time.sleep(0.05)
+            q.put(42)
+            t.join(3)
+        assert out == [42]
+        w.check()
+
+    def test_real_broker_plan_queue_workload(self):
+        """Cross-check the static result on REAL code: a broker +
+        plan-queue + state-store workload under the witness observes
+        actual acquisition chains and must stay cycle-free."""
+        w = LockOrderWitness()  # defaults to the nomad_tpu package
+        with w:
+            from nomad_tpu import mock
+            from nomad_tpu.server.eval_broker import EvalBroker
+            from nomad_tpu.server.plan_queue import PlanQueue
+            from nomad_tpu.state import StateStore
+
+            broker = EvalBroker(nack_timeout=5, delivery_limit=2)
+            broker.set_enabled(True)
+            store = StateStore()
+            pq = PlanQueue()
+            pq.set_enabled(True)
+
+            for i in range(8):
+                ev = mock.eval()
+                broker.enqueue(ev)
+            done = []
+
+            def worker():
+                while True:
+                    ev, token = broker.dequeue(["service"], timeout=0.5)
+                    if ev is None:
+                        return
+                    store.upsert_evals(100 + len(done), [ev])
+                    broker.ack(ev.id, token)
+                    done.append(ev.id)
+
+            threads = [threading.Thread(target=worker) for _ in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(10)
+            broker.set_enabled(False)
+        assert len(done) == 8
+        w.check()
+        # The run actually observed package locks (the wrap works).
+        assert w.sites
+
+
+# ---------------------------------------------------------------------------
+# 3b. recompile sentinel
+# ---------------------------------------------------------------------------
+
+class TestRecompileSentinel:
+    def test_budget_trips_on_retrace_storm(self):
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x: x + 1)
+        s = RecompileSentinel(budget=3, extra={"demo": f}).install()
+        for n in range(2, 8):  # 6 distinct shapes: 6 traces
+            f(jnp.ones((n,)))
+        with pytest.raises(AssertionError, match="recompile budget"):
+            s.check()
+
+    def test_within_budget_passes(self):
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x: x * 2)
+        s = RecompileSentinel(budget=3, extra={"demo": f}).install()
+        f(jnp.ones((4,)))
+        f(jnp.ones((4,)))  # cache hit, not a trace
+        f(jnp.ones((8,)))
+        assert s.report()["demo"] == 2
+        s.check()
+
+    def test_repo_kernels_are_watchable(self):
+        """The registered kernels expose cache introspection on this jax
+        version — if this breaks on an upgrade, the sentinel silently
+        watching nothing would be worse than failing here."""
+        s = RecompileSentinel().install()
+        assert s.supported
+        assert any(k.startswith("nomad_tpu.ops.binpack")
+                   for k in s._baseline)
+        assert s.budget == DEFAULT_BUDGET
+
+
+# ---------------------------------------------------------------------------
+# 4. regression tests for the defects the analyzer surfaced (fixed in
+#    this PR — each was a real pre-existing bug)
+# ---------------------------------------------------------------------------
+
+class TestAnalyzerFoundDefects:
+    def test_fast_exiting_first_task_does_not_kill_siblings(
+            self, tmp_path, monkeypatch):
+        """bare-write AllocRunner.task_runners (run): the runner dict was
+        populated one task at a time AFTER each start — a first task
+        reporting dead before its sibling was inserted made _aggregate
+        see 1/1 dead tasks and mark the whole alloc dead."""
+        from nomad_tpu.client import alloc_runner as ar_mod
+        from nomad_tpu.client.alloc_runner import AllocRunner
+        from nomad_tpu import mock
+        from nomad_tpu.structs import Task, Resources
+
+        class InstantDeadTaskRunner:
+            """First task dies synchronously inside start()."""
+
+            def __init__(self, ctx, task, state_dir="", on_state=None):
+                self.task = task
+                self.on_state = on_state
+                self.failed = False
+
+            def restore_state(self):
+                return False
+
+            def start(self):
+                if self.task.name == "fast":
+                    self.on_state(self.task.name, "dead", "exited 0")
+
+        monkeypatch.setattr(ar_mod, "TaskRunner", InstantDeadTaskRunner)
+
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.tasks = [
+            Task(name="fast", driver="exec", resources=Resources(cpu=10)),
+            Task(name="slow", driver="exec", resources=Resources(cpu=10)),
+        ]
+        alloc = mock.alloc()
+        alloc.job = job
+        alloc.job_id = job.id
+        alloc.task_group = tg.name
+        alloc.task_resources = {}
+        runner = AllocRunner(alloc, str(tmp_path / "alloc"))
+        runner.run()
+        # Both runners were published before any started; the dead fast
+        # task must NOT have aggregated to a dead/failed alloc.
+        assert len(runner.task_runners) == 2
+        assert runner.alloc.client_status not in ("dead", "failed")
+
+    def test_task_states_snapshot_is_lock_consistent(self, tmp_path,
+                                                     monkeypatch):
+        """bare-read AllocRunner.task_states (_set_client_status): the
+        published alloc's task_states copy is taken under the lock, so a
+        status update always carries the state that produced it."""
+        from nomad_tpu.client import alloc_runner as ar_mod
+        from nomad_tpu.client.alloc_runner import AllocRunner
+        from nomad_tpu import mock
+        from nomad_tpu.structs import Task, Resources
+
+        class NoopTaskRunner:
+            def __init__(self, ctx, task, state_dir="", on_state=None):
+                self.task = task
+                self.on_state = on_state
+                self.failed = False
+
+            def restore_state(self):
+                return False
+
+            def start(self):
+                pass
+
+        monkeypatch.setattr(ar_mod, "TaskRunner", NoopTaskRunner)
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.tasks = [Task(name=f"t{i}", driver="exec",
+                         resources=Resources(cpu=10)) for i in range(4)]
+        alloc = mock.alloc()
+        alloc.job = job
+        alloc.job_id = job.id
+        alloc.task_group = tg.name
+        alloc.task_resources = {}
+        statuses = []
+        runner = AllocRunner(alloc, str(tmp_path / "alloc"),
+                             on_status=lambda a: statuses.append(a))
+        runner.run()
+
+        # Hammer state updates from 4 "runner threads" concurrently; the
+        # unlocked dict(self.task_states) copy used to race the sibling
+        # inserts (RuntimeError: dict changed size during iteration).
+        def flip(name):
+            for i in range(300):
+                state = "running" if i % 2 else "pending"
+                runner._on_task_state(name, state, "")
+
+        threads = [threading.Thread(target=flip, args=(f"t{i}",))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(15)
+        # Every published status carries an internally consistent copy.
+        for a in statuses:
+            assert isinstance(a.task_states, dict)
+
+    def test_stale_aggregate_cannot_overwrite_newer_status(
+            self, tmp_path, monkeypatch):
+        """Publication sequencing: a status computed from an older
+        task-state snapshot must not land after (and overwrite) a newer
+        one when thread scheduling reorders the publishers
+        (code-review regression)."""
+        from nomad_tpu.client import alloc_runner as ar_mod
+        from nomad_tpu.client.alloc_runner import AllocRunner
+        from nomad_tpu import mock
+
+        alloc = mock.alloc()
+        alloc.task_resources = {}
+        runner = AllocRunner(alloc, str(tmp_path / "alloc"))
+        # Seq 2 ("dead") publishes first; the late seq-1 ("running")
+        # aggregate must be dropped, not win by arriving last.
+        runner._set_client_status("dead", "all tasks completed",
+                                  {"t": {"state": "dead"}}, seq=2)
+        runner._set_client_status("running", "",
+                                  {"t": {"state": "running"}}, seq=1)
+        assert runner.alloc.client_status == "dead"
+        assert runner.alloc.task_states == {"t": {"state": "dead"}}
+
+    def test_concurrent_applies_snapshot_exactly_once(self, tmp_path):
+        """bare-read InmemRaft.snapshots/_entries_since_snap
+        (_maybe_snapshot): the threshold check ran outside the lock, so
+        concurrent appliers could both pass it and double-compact."""
+        from nomad_tpu.server.raft import InmemRaft, SnapshotStore
+
+        class CountingStore(SnapshotStore):
+            saves = 0
+
+            def save(self, index, blob):
+                type(self).saves += 1
+                return super().save(index, blob)
+
+        class NullFSM:
+            def apply(self, index, entry):
+                return None
+
+            def snapshot(self):
+                time.sleep(0.01)  # widen the check-then-act window
+                return b"{}"
+
+            def restore(self, blob):
+                pass
+
+        store = CountingStore(str(tmp_path / "snaps"))
+        raft = InmemRaft(NullFSM(), snapshots=store, snapshot_threshold=8)
+        threads = [threading.Thread(
+            target=lambda: [raft.apply(b"e") for _ in range(4)])
+            for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        # 8 applies, threshold 8: exactly one snapshot.
+        assert CountingStore.saves == 1

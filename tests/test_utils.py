@@ -53,7 +53,39 @@ def test_parse_constraint_rejects_unparseable_versions():
     assert got == [(">=", "1.0"), ("<", "2.0")]
 
 
+def test_gc_pause_overlapping_threads():
+    """Refcounted pause: one thread's exit must NOT re-enable gc while
+    another thread's burst is still inside (pre-fix, the per-caller
+    save/restore did exactly that — and an interleaved save could then
+    leave gc off for the rest of the process)."""
+    import threading
+
+    gc.enable()
+    entered = threading.Event()
+    release = threading.Event()
+
+    def hold():
+        with gc_pause():
+            entered.set()
+            release.wait(5)
+
+    t = threading.Thread(target=hold)
+    t.start()
+    assert entered.wait(5)
+    with gc_pause():
+        assert not gc.isenabled()
+    # Inner pause exited, outer thread still bursting: stays disabled.
+    assert not gc.isenabled()
+    release.set()
+    t.join(5)
+    assert gc.isenabled()
+
+
 def test_gc_pause_nesting_restores_state():
+    # Own the precondition: an abandoned burst thread elsewhere in the
+    # suite may have left gc off — this test is about restore semantics,
+    # not suite-global hygiene.
+    gc.enable()
     assert gc.isenabled()
     with gc_pause():
         assert not gc.isenabled()
