@@ -200,6 +200,60 @@ def bench_single_eval(h, job, scheduler: str, repeats: int):
     return best, placed
 
 
+def single_eval_stage_profile(h, job, repeats: int = 3) -> dict:
+    """Per-stage wall (ms) of ONE config-4 eval through the staged
+    runner's stage timers (scheduler/pipeline.py stage_times): begin =
+    reconcile + dispatch prep, dispatch = executor kernel start (the
+    whole numpy kernel when the host executor takes it), collect =
+    result fetch + rounds->placement mapping, finish = native bulk
+    finish + Python tail, submit = plan submit + status.  This is the
+    recorded host-floor decomposition the `single_eval_ms` bar is
+    baselined against — best-of-N by total."""
+    from nomad_tpu.scheduler.pipeline import PipelinedEvalRunner
+
+    best_total, best_times = float("inf"), {}
+    for _ in range(repeats):
+        recorder = _RecordOnlyPlanner()
+        runner = PipelinedEvalRunner(h.state.snapshot(), recorder,
+                                     depth=1)
+        runner.process([make_eval(job)])
+        total = sum(runner.stage_times.values())
+        if total < best_total:
+            best_total, best_times = total, dict(runner.stage_times)
+    return {k: round(v * 1000.0, 2) for k, v in best_times.items()}
+
+
+def bench_pipelined_device_stream(h, jobs, depth: int, repeats: int = 3):
+    """The `4_device_pipelined` row: the SAME eval stream as the host
+    row, executor forced to the device (NOMAD_TPU_EXECUTOR semantics
+    via scheduler/executor.executor_override) through the staged
+    pipeline — eval N's RTT hides behind evals N+1..N+depth's host
+    stages.  Returns (best_s, lats, placed, stage_times,
+    device_dispatches, total_dispatches)."""
+    from nomad_tpu.scheduler.executor import executor_override
+    from nomad_tpu.scheduler.pipeline import PipelinedEvalRunner
+
+    best, best_lats, best_stages, placed = float("inf"), [], {}, 0
+    dev_n = total_n = 0
+    with executor_override("device"):
+        for _ in range(repeats):
+            recorder = _RecordOnlyPlanner()
+            snapshot = h.state.snapshot()
+            runner = PipelinedEvalRunner(snapshot, recorder, depth=depth)
+            evals = [make_eval(j) for j in jobs]
+            start = time.perf_counter()
+            runner.process(evals)
+            total = time.perf_counter() - start
+            assert len(recorder.plans) == len(jobs)
+            if total < best:
+                best, best_lats = total, runner.latencies
+                best_stages = dict(runner.stage_times)
+                placed = _placed(recorder)
+                dev_n = runner.device_dispatches
+                total_n = dev_n + runner.host_dispatches
+    return best, best_lats, placed, best_stages, dev_n, total_n
+
+
 # Nominal HBM bandwidth used for the rough roofline line: TPU v5 lite
 # (the chip this environment exposes) is ~819 GB/s; CPU runs just get a
 # smaller achieved number against the same nominal, clearly labeled.
@@ -487,6 +541,11 @@ def main() -> None:
     # fused storm): per-eval compute is far below the RTT.
     kernel_s, est_bytes = device_kernel_stats(h4, jobs4[0])
     per_eval_s = dev_s / len(jobs4)
+    # Recorded host-floor decomposition: per-stage wall of one host-
+    # executor eval (scheduler/pipeline.py stage timers).  This profile
+    # IS the `single_eval_ms` bar's baseline — the bar is the sum of
+    # these stages, not a number picked in a vacuum.
+    stage_ms = single_eval_stage_profile(h4, jobs4[0], args.repeats)
     configs["4_binpack_10kn_x_1ktg"] = {
         "evals_per_sec": round(len(jobs4) / dev_s, 3),
         "seq_evals_per_sec": round(len(jobs4) / seq_s, 3),
@@ -499,38 +558,102 @@ def main() -> None:
         # Hardware terms: a single-eval device dispatch is RTT-bound
         # on the remote-attached chip (deduped groups make its compute
         # tiny), so this config runs the HOST executor and its device
-        # fraction is honestly 0 — the chip earns its keep on the fused
-        # storm (config 5) and multi-chip shapes.
+        # fraction is honestly 0 — the chip carries the pipelined
+        # stream (4_device_pipelined below), the fused storm (config 5)
+        # and multi-chip shapes.
         "device_dispatch_rtt_ms": round(kernel_s * 1000.0, 1),
         "approx_hbm_gb_per_eval": round(est_bytes / 1e9, 4),
         "host_executor": True,
         "device_fraction": 0.0,
-        "bottleneck": ("per-eval host floor ~5-7ms: native bulk finish "
-                       "(C alloc construction + port assignment, "
-                       "native/port_alloc.cpp) ~2.5ms for 1k placements, "
-                       "rounds kernel ~1ms, eval/plan bookkeeping ~1ms; "
-                       "reconcile/diff and dispatch prep are memoized "
-                       "per (job version, fleet generation) so re-evals "
-                       "pay ~0, and burst objects are GC-untracked so "
-                       "young-gen collections no longer rescan plans; "
-                       "the executor policy keeps this shape host-side "
-                       "because one remote-TPU round trip (~100ms) "
-                       "exceeds the whole eval — the device carries the "
-                       "fused storm and multi-chip shapes instead"),
+        "stage_profile_ms": stage_ms,
+        "bottleneck": ("per-eval host floor, measured per stage "
+                       "(stage_profile_ms): finish = native bulk "
+                       "finish (C alloc construction + port "
+                       "assignment, native/port_alloc.cpp), dispatch = "
+                       "host rounds kernel, begin = memoized "
+                       "reconcile/prep, submit = plan bookkeeping; "
+                       "re-evals pay ~0 prep (memoized per job "
+                       "version x fleet generation) and burst objects "
+                       "are GC-untracked; the executor policy keeps "
+                       "this shape host-side because one remote-TPU "
+                       "round trip (~100ms) exceeds the whole eval — "
+                       "the 4_device_pipelined row shows what the "
+                       "forced-device pipeline does to the same "
+                       "stream; the single_eval_ms bar is re-baselined "
+                       "to this recorded profile (README Executor "
+                       "policy)"),
     }
     note(f"config4 {args.nodes}n x {args.groups}tg: stream "
          f"{len(jobs4) / dev_s:.1f} evals/s vs seq "
          f"{len(jobs4) / seq_s:.1f}/s -> {seq_s / dev_s:.1f}x; "
          f"single-eval {lat_dev * 1000:.0f}ms vs {lat_seq * 1000:.0f}ms "
-         f"-> {lat_seq / lat_dev:.1f}x; remaining per-eval host work "
-         f"~{dev_s / len(jobs4) * 1000:.1f}ms (native bulk finish "
-         f"~2.5ms, kernel ~1ms, bookkeeping ~1ms; diff/prep memoized)")
+         f"-> {lat_seq / lat_dev:.1f}x; per-eval host stages (ms): "
+         f"{stage_ms}")
     note(f"config4 hardware: one fenced device dispatch of this shape "
          f"costs {kernel_s * 1000:.0f}ms (remote-attach RTT; est HBM "
          f"traffic only {est_bytes / 1e9:.3f}GB after group dedup) vs "
          f"{per_eval_s * 1000:.1f}ms/eval host wall -> the executor "
          f"policy keeps single evals host-side; the chip carries the "
-         f"fused storm (config 5)")
+         f"pipelined stream + fused storm")
+
+    # --- config 4dp: the SAME stream, device executor FORCED -------------
+    # VERDICT r5 lead item: put the chip behind the headline or record
+    # why it can't be.  Depth is tuned to hide the measured RTT behind
+    # per-eval host work (kernel_s / host-stage time, capped), so the
+    # stream is bound by host stages, not the wire.  Placed count must
+    # equal the host row's — same plans, different engine.
+    host_stage_s = max(sum(stage_ms.values()) / 1000.0, 1e-4)
+    device_depth = max(args.depth,
+                       min(64, int(kernel_s / host_stage_s) + 2))
+    bench_pipelined_device_stream(h4, jobs4, device_depth, 1)  # warm
+    pdev_s, pdev_lats, pdev_placed, pdev_stages, dev_n, total_n = \
+        bench_pipelined_device_stream(h4, jobs4, device_depth,
+                                      args.repeats)
+    host_placed = args.groups * len(jobs4)
+    assert pdev_placed == host_placed, (pdev_placed, host_placed)
+    assert dev_n == total_n == len(jobs4), (dev_n, total_n)
+    # Device occupancy: total in-flight dispatch wall (each dispatch
+    # holds the wire+chip for ~kernel_s) over stream wall.  The capped
+    # value is comparable with config 5's kernel-wall/storm-wall
+    # device_fraction; the UNCAPPED ratio is the informative one for an
+    # overlapped stream — occupancy_x = 4.0 means four dispatch-RTTs
+    # were in flight per unit wall, i.e. the pipeline genuinely
+    # overlapped them (a non-pipelined forced-device stream pins it at
+    # ~1.0).  device_dispatch_share is the executor-selection truth
+    # (fraction of dispatches that actually ran on the chip).
+    occupancy_x = len(jobs4) * kernel_s / pdev_s
+    pdev_frac = min(1.0, occupancy_x)
+    configs["4_device_pipelined"] = {
+        "evals_per_sec": round(len(jobs4) / pdev_s, 3),
+        "speedup": round(seq_s / pdev_s, 2),
+        "vs_host_row": round(dev_s / pdev_s, 3),
+        "p99_ms": round(_p(pdev_lats, 99), 2),
+        "placed": pdev_placed,
+        "depth": device_depth,
+        "device_dispatches": dev_n,
+        "device_dispatch_share": round(dev_n / max(1, total_n), 3),
+        "device_fraction": round(pdev_frac, 3),
+        "device_occupancy_x": round(occupancy_x, 2),
+        "stage_times_ms": {k: round(v * 1000.0, 1)
+                           for k, v in pdev_stages.items()},
+        "note": ("same stream and plans as 4_binpack_10kn_x_1ktg with "
+                 "NOMAD_TPU_EXECUTOR=device through the staged "
+                 "pipeline: every dispatch runs on the chip "
+                 "(device_dispatch_share), collect blocks overlap "
+                 "later evals' prep/dispatch (device_occupancy_x > 1 "
+                 "= dispatches genuinely overlapped); vs_host_row > 1 "
+                 "means the device row WINS the stream, < 1 records "
+                 "by how much the host executor still leads after "
+                 "the RTT is hidden"),
+    }
+    note(f"config4dp device-pipelined (depth {device_depth}): "
+         f"{len(jobs4) / pdev_s:.1f} evals/s vs host row "
+         f"{len(jobs4) / dev_s:.1f}/s -> x{dev_s / pdev_s:.2f} "
+         f"device/host, device_fraction {pdev_frac:.2f} "
+         f"(occupancy x{occupancy_x:.1f}), "
+         f"placed {pdev_placed} (== host row), p99 "
+         f"{_p(pdev_lats, 99):.1f}ms; drain stages (ms): "
+         f"{ {k: round(v * 1000.0, 1) for k, v in pdev_stages.items()} }")
 
     # --- config 5: optimistic eval storm (headline) ----------------------
     h5 = _harness_with_nodes(args.nodes)

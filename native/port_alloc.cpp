@@ -994,6 +994,39 @@ fail:
   return nullptr;
 }
 
+// bulk_finish_many(items) -> [(n_done, port_lcg, failed_map), ...]
+//
+// items: list of bulk_finish argument TUPLES (built by
+// scheduler/jax_binpack.build_bulk_args), one per evaluation of a
+// drained pipeline window.  Runs every eval's finish loop in ONE
+// Python->C transition so the staged pipeline (scheduler/pipeline.py)
+// amortizes the native-call setup across the window instead of
+// re-entering the interpreter between evals.  Exactly equivalent to
+// calling bulk_finish per item — same code runs per eval.
+PyObject* bulk_finish_many(PyObject* self, PyObject* args) {
+  PyObject* items;
+  if (!PyArg_ParseTuple(args, "O!", &PyList_Type, &items)) return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(items);
+  PyObject* out = PyList_New(n);
+  if (!out) return nullptr;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* item = PyList_GET_ITEM(items, i);
+    if (!PyTuple_Check(item)) {
+      Py_DECREF(out);
+      PyErr_SetString(PyExc_TypeError,
+                      "bulk_finish_many items must be argument tuples");
+      return nullptr;
+    }
+    PyObject* r = bulk_finish(self, item);
+    if (!r) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, i, r);  // steals
+  }
+  return out;
+}
+
 PyMethodDef methods[] = {
     {"assign_ports", assign_ports, METH_VARARGS,
      "Assign reserved + dynamic ports against a used-port set."},
@@ -1001,6 +1034,8 @@ PyMethodDef methods[] = {
      "Add ports to a used-port set; returns True on any collision."},
     {"bulk_finish", bulk_finish, METH_VARARGS,
      "Scheduler finish-loop happy path: bulk alloc construction."},
+    {"bulk_finish_many", bulk_finish_many, METH_VARARGS,
+     "bulk_finish over a window of evals in one native call."},
     {"format_uuids", format_uuids, METH_VARARGS,
      "Format UUID strings from raw entropy bytes (16 per UUID)."},
     {nullptr, nullptr, 0, nullptr},
@@ -1019,7 +1054,7 @@ PyMODINIT_FUNC PyInit__nomad_native(void) {
   // Bumped on any signature/behavior change of an existing function so a
   // stale prebuilt .so (same names, old ABI) is detected by the loader
   // (nomad_tpu/utils/native.py) instead of crashing mid-eval.
-  if (PyModule_AddIntConstant(m, "ABI_VERSION", 4) < 0) {
+  if (PyModule_AddIntConstant(m, "ABI_VERSION", 5) < 0) {
     Py_DECREF(m);
     return nullptr;
   }

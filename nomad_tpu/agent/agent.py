@@ -66,6 +66,7 @@ class AgentConfig:
     num_schedulers: int = 2
     enabled_schedulers: list = field(default_factory=list)
     use_device_scheduler: bool = True
+    executor: str = ""  # "" = auto (scheduler/executor.py policy)
     servers: list = field(default_factory=list)   # client: server addrs
     raft_peers: list = field(default_factory=list)
     client_options: dict = field(default_factory=dict)
@@ -142,6 +143,8 @@ class Agent:
         )
         if self.config.enabled_schedulers:
             cfg.enabled_schedulers = list(self.config.enabled_schedulers)
+        if self.config.executor:
+            cfg.executor = self.config.executor
         if self.config.server_data_dir:
             cfg.data_dir = self.config.server_data_dir
         elif self.config.data_dir and not self.config.dev_mode:

@@ -168,6 +168,16 @@ def apply_to_agent_config(cfg: "AgentConfig", tree: dict) -> "AgentConfig":
             if "retry_join" in value:
                 cfg.retry_join = [_addr(s)
                                   for s in _as_list(value["retry_join"])]
+            if "executor" in value:
+                # Validated here so a typo'd config file fails the boot
+                # with the file's vocabulary, not at first dispatch.
+                from nomad_tpu.scheduler.executor import (
+                    ExecutorPolicyError, validate_executor)
+                try:
+                    cfg.executor = validate_executor(value["executor"],
+                                                     "server.executor")
+                except ExecutorPolicyError as e:
+                    raise ConfigError(str(e)) from None
         elif key == "telemetry":
             cfg.telemetry = dict(value)
         elif key == "atlas":

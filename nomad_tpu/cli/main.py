@@ -93,6 +93,10 @@ def main(argv=None) -> int:
                    help="gossip port for server agents (0 = ephemeral)")
     p.add_argument("-servers", default="",
                    help="comma-separated server RPC addrs (client mode)")
+    p.add_argument("-executor", default="",
+                   help="placement-kernel executor: auto|host|device "
+                        "(overrides config; NOMAD_TPU_EXECUTOR env "
+                        "overrides both)")
     p.add_argument("-config", action="append", default=[],
                    help="HCL/JSON config file or directory; repeatable, "
                         "merged in order (reloaded on SIGHUP)")
@@ -209,6 +213,11 @@ def cmd_agent(args) -> int:
             from nomad_tpu.agent.config import (apply_to_agent_config,
                                                 load_config_sources)
             apply_to_agent_config(cfg, load_config_sources(args.config))
+        if args.executor:
+            # Flag beats config files (later source wins, same rule as
+            # -config merge order); the env var beats both at dispatch.
+            from nomad_tpu.scheduler.executor import validate_executor
+            cfg.executor = validate_executor(args.executor, "-executor")
 
         agent = Agent(cfg)
     except BaseException:

@@ -117,11 +117,16 @@ class BatchEvalRunner:
                 this_round.append(ev)
         return this_round, leftovers
 
-    def _begin_eval(self, ev: Evaluation):
+    def _begin_eval(self, ev: Evaluation, finish_noop: bool = True):
         """Instantiate and reconcile one eval up to its deferred device
         args.  Returns the scheduler ready to dispatch, or None when the
         eval finished without needing a device dispatch (bad trigger,
-        status error, or a plan with no placements)."""
+        status error, or a plan with no placements).
+
+        ``finish_noop=False`` returns the scheduler for a
+        placement-less plan instead of submitting it here (deferred is
+        None): the staged pipeline routes even those submits through
+        its drain stage so plan-commit order stays eval order."""
         sched = JaxBinPackScheduler(self.state, self.planner,
                                     batch=(ev.type == "batch"))
         sched.eval = ev
@@ -138,6 +143,8 @@ class BatchEvalRunner:
             return None
         sched.defer_device = False
         if sched.deferred is None:
+            if not finish_noop:
+                return sched
             # No placements needed: submit stops/updates directly.
             self._finish(sched)
             return None
@@ -203,13 +210,20 @@ class BatchEvalRunner:
         rounds = max(a.rounds for _, _, a in pending)
 
         # Executor policy (same trade as JaxBinPackScheduler.
-        # choose_host_executor): a fused dispatch pays one device round
-        # trip + a [B, G, N] upload; below this op-count the numpy kernels
+        # choose_host_executor, and the same NOMAD_TPU_EXECUTOR
+        # override): a fused dispatch pays one device round trip + a
+        # [B, G, N] upload; below this op-count the numpy kernels
         # finish before the request would even reach the device.  The
         # host path reads each lane's arrays directly — no stacking.
+        from .executor import (EXECUTOR_DEVICE, EXECUTOR_HOST,
+                               executor_policy)
+
+        policy = executor_policy()
         steps = rounds * g_max if rounds_ok else p_max
         fused_cost = B * steps * statics.n_real
-        if fused_cost <= JaxBinPackScheduler.HOST_SINGLE_SHOT_COST:
+        if policy == EXECUTOR_HOST or (
+                policy != EXECUTOR_DEVICE and
+                fused_cost <= JaxBinPackScheduler.HOST_SINGLE_SHOT_COST):
             self._finish_fused_host(pending, rounds_ok, k_cap, rounds,
                                     retries)
             if leftovers:

@@ -1,0 +1,107 @@
+"""Executor policy: which engine runs the placement kernels.
+
+The jax-binpack scheduler picks between two executors per dispatch
+(scheduler/jax_binpack.py choose_host_executor):
+
+  host    numpy twin kernels (ops/binpack_host.py) — zero dispatch
+          latency, wins whenever the workload is smaller than a device
+          round trip (on remote-attached TPUs one dispatch costs a full
+          network RTT, ~100 ms, regardless of compute size);
+  device  jit kernels (ops/binpack.py) — wins for fused eval storms,
+          multi-chip fleets, and pipelined streams deep enough to hide
+          the RTT behind host work.
+
+``auto`` (the default) applies the cost model.  ``host`` / ``device``
+force one side — the bench's `4_device_pipelined` row, the multi-chip
+dry run, and the host/device parity smoke all need a *forcible* device
+path, and an operator diagnosing a slow chip wants the same lever
+without editing code.
+
+Resolution order (first set wins):
+
+  1. the ``NOMAD_TPU_EXECUTOR`` environment variable — checked per
+     dispatch so a bench or operator can flip it without a restart;
+  2. the process policy set from agent/server config
+     (``server { executor = "..." }``, plumbed via
+     ``set_executor_policy`` at server boot);
+  3. ``auto``.
+
+The override only selects the executor; plan semantics are identical on
+both sides (tests/test_executor_parity.py gates this on every run).
+"""
+from __future__ import annotations
+
+import os
+
+EXECUTOR_AUTO = "auto"
+EXECUTOR_HOST = "host"
+EXECUTOR_DEVICE = "device"
+
+VALID_EXECUTORS = (EXECUTOR_AUTO, EXECUTOR_HOST, EXECUTOR_DEVICE)
+
+ENV_VAR = "NOMAD_TPU_EXECUTOR"
+
+_configured: str = EXECUTOR_AUTO
+
+
+class ExecutorPolicyError(ValueError):
+    pass
+
+
+def _validate(value: str, source: str) -> str:
+    v = (value or "").strip().lower()
+    if v not in VALID_EXECUTORS:
+        raise ExecutorPolicyError(
+            f"invalid executor {value!r} from {source}: want one of "
+            f"{', '.join(VALID_EXECUTORS)}")
+    return v
+
+
+def validate_executor(value: str, source: str = "config") -> str:
+    """Public validation hook for config loaders: normalized value or
+    ExecutorPolicyError."""
+    return _validate(value, source)
+
+
+def set_executor_policy(value: str) -> None:
+    """Install the process-wide policy (config plumbing; env still
+    wins).  Raises ExecutorPolicyError on unknown values so a typo in a
+    config file fails the boot instead of silently running ``auto``."""
+    global _configured
+    _configured = _validate(value, "config")
+
+
+def executor_policy() -> str:
+    """The effective policy right now: env var, then configured value,
+    then ``auto``.  Read per dispatch — cheap (one getenv) and it keeps
+    the bench's scoped overrides race-free with respect to restarts."""
+    env = os.environ.get(ENV_VAR)
+    if env:
+        return _validate(env, f"${ENV_VAR}")
+    return _configured
+
+
+class executor_override:
+    """Scoped force of the executor (bench rows, parity tests).
+
+    Sets the ENV override — the highest-precedence source — and restores
+    the previous value on exit, so nesting and config interplay behave
+    predictably.  Process-global like the env var itself; use from the
+    thread that owns the run (the pipeline's stage threads read the
+    policy only at dispatch time, on the submitting thread).
+    """
+
+    def __init__(self, value: str) -> None:
+        self.value = _validate(value, "executor_override")
+        self._saved: str | None = None
+
+    def __enter__(self) -> "executor_override":
+        self._saved = os.environ.get(ENV_VAR)
+        os.environ[ENV_VAR] = self.value
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._saved is None:
+            os.environ.pop(ENV_VAR, None)
+        else:
+            os.environ[ENV_VAR] = self._saved

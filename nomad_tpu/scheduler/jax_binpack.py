@@ -88,17 +88,17 @@ def _native_bulk():
     return _NATIVE_BULK_CACHE[0]
 
 
-def run_bulk_finish(native, sched, place, group_l, chosen_l, scores_l,
+def build_bulk_args(sched, place, group_l, chosen_l, scores_l,
                     uuids, slots_c, alloc_proto, metric_proto,
-                    coalesce_all: int):
-    """One marshalling point for native.bulk_finish (the C finish-loop
-    happy path), shared by the generic and system schedulers.  ``sched``
-    supplies the per-eval placement state (_node_net/_net_base_for/
-    _port_lcg via FastPlacementMixin, plan, state, ctx).  Returns
-    (resume index, failed-TG map); updates sched._port_lcg."""
+                    coalesce_all: int, port_lcg: int) -> tuple:
+    """The native.bulk_finish argument tuple for one eval — the ONE
+    producer of that layout, shared by the per-eval call
+    (run_bulk_finish) and the pipeline's windowed bulk_finish_many
+    (scheduler/pipeline.py drains a window of evals through a single
+    native call)."""
     plan = sched.plan
     statics = sched._statics
-    start_p, sched._port_lcg, fmap = native.bulk_finish(
+    return (
         place if type(place) is list else list(place),
         group_l, chosen_l, scores_l, uuids, slots_c,
         statics.nodes, sched._node_net, statics.net_base,
@@ -110,8 +110,22 @@ def run_bulk_finish(native, sched, place, group_l, chosen_l, scores_l,
         (ALLOC_DESIRED_STATUS_RUN, ALLOC_CLIENT_STATUS_PENDING,
          ALLOC_DESIRED_STATUS_FAILED, ALLOC_CLIENT_STATUS_FAILED,
          "failed to find a node for placement"),
-        coalesce_all, sched._port_lcg, MIN_DYNAMIC_PORT,
+        coalesce_all, port_lcg, MIN_DYNAMIC_PORT,
         MAX_DYNAMIC_PORT)
+
+
+def run_bulk_finish(native, sched, place, group_l, chosen_l, scores_l,
+                    uuids, slots_c, alloc_proto, metric_proto,
+                    coalesce_all: int):
+    """One marshalling point for native.bulk_finish (the C finish-loop
+    happy path), shared by the generic and system schedulers.  ``sched``
+    supplies the per-eval placement state (_node_net/_net_base_for/
+    _port_lcg via FastPlacementMixin, plan, state, ctx).  Returns
+    (resume index, failed-TG map); updates sched._port_lcg."""
+    start_p, sched._port_lcg, fmap = native.bulk_finish(
+        *build_bulk_args(sched, place, group_l, chosen_l, scores_l,
+                         uuids, slots_c, alloc_proto, metric_proto,
+                         coalesce_all, sched._port_lcg))
     return start_p, fmap
 
 
@@ -240,11 +254,29 @@ class DeviceArgs:
                  # group_l = group_idx[:n_place].tolist(); slots_c is a
                  # one-element holder lazily filled with the native
                  # bulk-finish slot table (built on first finish).
-                 "fast_all", "group_l", "slots_c")
+                 "fast_all", "group_l", "slots_c",
+                 # dev_const: lazily filled device copies of the
+                 # dispatch-constant arrays (asks/distinct/counts or
+                 # group_idx/valid), shared through the prep cache so a
+                 # pipelined stream re-dispatching the same job version
+                 # uploads them once, not per eval.  Kilobytes per job —
+                 # unlike feasible_d these may ride the job-held cache
+                 # without meaningfully pinning HBM.
+                 "dev_const")
 
     def __init__(self, **kw) -> None:
         for k, v in kw.items():
             setattr(self, k, v)
+
+
+class _FinishState:
+    """Per-eval state carried across the split finish phases
+    (_finish_prepare -> native bulk -> _finish_python_tail) so the
+    staged pipeline can batch the native phase of a whole drained
+    window into one C call."""
+
+    __slots__ = ("place", "args", "chosen_l", "scores_l", "uuids",
+                 "alloc_proto", "metric_proto", "failed_tg", "start_p")
 
 
 class FastPlacementMixin:
@@ -471,12 +503,38 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
 
     def choose_host_executor(self, args: "DeviceArgs",
                              pipelined: bool) -> bool:
+        from .executor import (EXECUTOR_DEVICE, EXECUTOR_HOST,
+                               executor_policy)
+
+        policy = executor_policy()
+        if policy == EXECUTOR_HOST:
+            return True
+        if policy == EXECUTOR_DEVICE:
+            return False
         steps = args.rounds * args.n_groups if args.rounds_eligible \
             else args.n_place
         cost = steps * args.statics.n_real
         if cost <= self.HOST_ALWAYS_COST:
             return True
         return not pipelined and cost <= self.HOST_SINGLE_SHOT_COST
+
+    # Which executor the last dispatch_device call actually used: True
+    # host, False device, None when no dispatch ran yet.  The pipelined
+    # runner reads this to report an honest device_fraction.
+    dispatched_host: "bool | None" = None
+
+    def _dev_const(self, args: "DeviceArgs", key: str,
+                   host_arrays: tuple) -> list:
+        """Device-resident copies of dispatch-constant host arrays,
+        cached on the DeviceArgs' shared dev_const holder (one upload
+        per job version per platform, ensure_on_default re-validates
+        across re-pins)."""
+        from nomad_tpu.parallel.devices import ensure_on_default
+
+        holder = args.dev_const.setdefault(key, [None] * len(host_arrays))
+        for i, h in enumerate(host_arrays):
+            holder[i] = ensure_on_default(holder[i], h)
+        return holder
 
     def dispatch_host(self, args: "DeviceArgs") -> tuple:
         """Run the placement kernels eagerly with numpy
@@ -511,7 +569,9 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
         how small the compute.  Small workloads skip the device entirely
         (choose_host_executor) and come back as ready numpy arrays."""
         if self.choose_host_executor(args, pipelined):
+            self.dispatched_host = True
             return self.dispatch_host(args)
+        self.dispatched_host = False
         capacity_d, reserved_d = args.statics.device_capacity_reserved()
         feas_cached = args.feasible_d  # [host, device-or-None], lazy
         from nomad_tpu.parallel.devices import ensure_on_default
@@ -520,16 +580,21 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
         if args.rounds_eligible:
             from nomad_tpu.ops.binpack import place_rounds
 
+            asks_d, distinct_d, counts_d = self._dev_const(
+                args, "rounds", (args.asks, args.distinct, args.counts))
             chosen_s, scores_s, _ = place_rounds(
                 capacity_d, reserved_d, args.view.dispatch_usage(),
-                args.view.job_counts, feasible_d, args.asks,
-                args.distinct, args.counts, args.penalty,
+                args.view.job_counts, feasible_d, asks_d,
+                distinct_d, counts_d, args.penalty,
                 k_cap=args.k_cap, rounds=args.rounds)
         else:
+            asks_d, distinct_d, group_idx_d, valid_d = self._dev_const(
+                args, "seq", (args.asks, args.distinct, args.group_idx,
+                              args.valid))
             chosen_s, scores_s, _ = place_sequence(
                 capacity_d, reserved_d, args.view.dispatch_usage(),
-                args.view.job_counts, feasible_d, args.asks,
-                args.distinct, args.group_idx, args.valid, args.penalty)
+                args.view.job_counts, feasible_d, asks_d,
+                distinct_d, group_idx_d, valid_d, args.penalty)
         for a in (chosen_s, scores_s):
             try:
                 a.copy_to_host_async()
@@ -759,7 +824,8 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
             slot_placements=slot_placements, k_cap=k_cap, rounds=rounds,
             rounds_eligible=eligible,
             fast_all=all(np_[0] for np_ in net_plans),
-            group_l=group_idx[:len(place)].tolist(), slots_c=[None])
+            group_l=group_idx[:len(place)].tolist(), slots_c=[None],
+            dev_const={})
         # Keyed on the fleet GENERATION, not the statics object: a strong
         # statics ref here would pin evicted generations (device
         # feasibility buffers included) for as long as the job lives.
@@ -771,19 +837,31 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
             feasible_d=cached, feasible_h=feasible_h, **kw))
 
     def finish_deferred(self, place: list, args: DeviceArgs,
-                        chosen: np.ndarray, scores: np.ndarray) -> None:
+                        chosen: np.ndarray, scores: np.ndarray,
+                        uuids: "list | None" = None) -> None:
         """Consume device decisions into the plan (exact host re-checks +
         network assignment + Allocation construction).
 
-        This loop runs once per placement and is the host half of the
-        device dispatch, so the common shape (winner accepted, single
-        dynamic-port network ask) is O(1) object construction with no
-        NetworkIndex: per-node port/bandwidth state lives in a plain dict
-        (``_node_net``) shared with the exact path for coherence."""
+        Split into three phases so the staged pipeline
+        (scheduler/pipeline.py) can run a whole drained window's native
+        phase in ONE C call (native.bulk_finish_many) and pass a shared
+        uuid slab: prepare (host state init), native happy-path prefix,
+        Python tail.  This entry point runs them back-to-back — the
+        single-eval semantics are unchanged."""
+        fs = self._finish_prepare(place, args, chosen, scores, uuids)
+        nargs = self._finish_native_args(fs)
+        if nargs is not None:
+            self._finish_consume_native(
+                fs, _native_bulk().bulk_finish(*nargs))
+        self._finish_python_tail(fs)
+
+    def _finish_prepare(self, place: list, args: DeviceArgs,
+                        chosen, scores,
+                        uuids: "list | None" = None) -> "_FinishState":
+        """Host-side finish state for one eval: per-plan network caches,
+        alloc/metric protos, list-form device choices, uuids (minted
+        here unless the pipeline passed a shared slab slice)."""
         statics = args.statics
-        sizes = args.sizes
-        slot_of_tg = args.slot_of_tg
-        net_plans = args.net_plans
         device_time = time.perf_counter() - args.start
         per_time = device_time / max(1, len(place))
         # Per-node NetworkIndex cache for this plan (exact path) and the
@@ -793,22 +871,76 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
         self._statics = statics
         self._port_lcg = _randrange(1 << 30)
 
-        chosen_l = chosen.tolist()
-        scores_l = scores.tolist()
-        n_real = statics.n_real
-        nodes_arr = statics.nodes
-        eval_id = self.eval.id
-        job = self.job
-        job_id = job.id
-        plan = self.plan
-        uuids = generate_uuids(len(place))
-
-        # Template-based construction (see _proto_of): the loop below
+        fs = _FinishState()
+        fs.place = place
+        fs.args = args
+        fs.chosen_l = chosen if type(chosen) is list else chosen.tolist()
+        fs.scores_l = scores if type(scores) is list else scores.tolist()
+        fs.uuids = uuids if uuids is not None else \
+            generate_uuids(len(place))
+        # Template-based construction (see _proto_of): the finish loop
         # builds one AllocMetric + Allocation per placement.
-        metric_proto = dict(_METRIC_STATIC, nodes_evaluated=n_real,
-                            allocation_time=per_time)
-        alloc_proto = dict(_ALLOC_STATIC, eval_id=eval_id, job_id=job_id,
-                           job=job)
+        fs.metric_proto = dict(_METRIC_STATIC,
+                               nodes_evaluated=statics.n_real,
+                               allocation_time=per_time)
+        fs.alloc_proto = dict(_ALLOC_STATIC, eval_id=self.eval.id,
+                              job_id=self.job.id, job=self.job)
+        fs.failed_tg = {}
+        fs.start_p = 0
+        return fs
+
+    def _finish_native_args(self, fs: "_FinishState") -> "tuple | None":
+        """bulk_finish argument tuple for this eval's native happy-path
+        prefix, or None when the native path can't take it (extension
+        absent, or a slot needs the exact NetworkIndex)."""
+        args = fs.args
+        native = _native_bulk()
+        if native is None or not args.fast_all:
+            return None
+        slots_c = args.slots_c[0]
+        if slots_c is None:
+            # Built once per (job version, fleet) and shared through
+            # the prep cache — the slot table only depends on the
+            # deduped net plans and sizes.
+            slots_c = build_slots_c(
+                (args.sizes[g], args.net_plans[g][1])
+                for g in range(args.n_groups))
+            args.slots_c[0] = slots_c
+        return build_bulk_args(
+            self, fs.place, args.group_l, fs.chosen_l, fs.scores_l,
+            fs.uuids, slots_c, fs.alloc_proto, fs.metric_proto,
+            1,  # coalesce_all: generic TG placements interchangeable
+            self._port_lcg)
+
+    def _finish_consume_native(self, fs: "_FinishState",
+                               result: tuple) -> None:
+        """Fold one native bulk_finish result (n_done, lcg, failed map)
+        back into the finish state.  fmap stays empty under generic
+        semantics: the C loop bails on a task group's first chosen-less
+        placement so the Python tail can rescue or explain it."""
+        fs.start_p, self._port_lcg, fmap = result
+        fs.failed_tg.update(fmap)
+
+    def _finish_python_tail(self, fs: "_FinishState") -> None:
+        """Per-placement Python finish loop from fs.start_p: exact host
+        re-checks, network assignment, Allocation construction.  The
+        native prefix (parity-tested in tests/test_native_finish.py)
+        handled [0, start_p); this loop owns complex topologies,
+        divergence recovery and failure explanation."""
+        place = fs.place
+        args = fs.args
+        statics = args.statics
+        sizes = args.sizes
+        slot_of_tg = args.slot_of_tg
+        net_plans = args.net_plans
+        chosen_l = fs.chosen_l
+        scores_l = fs.scores_l
+        uuids = fs.uuids
+        nodes_arr = statics.nodes
+        plan = self.plan
+        metric_proto = fs.metric_proto
+        alloc_proto = fs.alloc_proto
+        failed_tg = fs.failed_tg
 
         def fast_metric(score_key=None, score=0.0) -> AllocMetric:
             # Lazy form: factory dicts + the scores dict materialize on
@@ -821,7 +953,6 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
             m.__dict__ = d
             return m
 
-        failed_tg: dict = {}
         # slot -> explained failure metrics: identical groups share one
         # fleet-walk verdict (usage is monotone within a finish pass).
         failed_slots: dict = {}
@@ -836,35 +967,7 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
         # of falling into a per-placement sequential walk.
         redispatched = False
 
-        # Native happy-path prefix: the C extension executes the common
-        # per-placement steps (port picks, offer/Resources/AllocMetric/
-        # Allocation construction, plan append) and stops at the first
-        # case needing Python (complex topology, bandwidth overflow);
-        # this loop then resumes from that index.  Identical results by
-        # construction (same LCG stream, same protos) — parity-tested in
-        # tests/test_native_finish.py.
-        start_p = 0
-        native = _native_bulk()
-        if native is not None and args.fast_all:
-            slots_c = args.slots_c[0]
-            if slots_c is None:
-                # Built once per (job version, fleet) and shared through
-                # the prep cache — the slot table only depends on the
-                # deduped net plans and sizes.
-                slots_c = build_slots_c(
-                    (sizes[g], net_plans[g][1])
-                    for g in range(args.n_groups))
-                args.slots_c[0] = slots_c
-            start_p, fmap = run_bulk_finish(
-                native, self, place, args.group_l, chosen_l, scores_l,
-                uuids, slots_c, alloc_proto, metric_proto,
-                coalesce_all=1)  # generic TG placements interchangeable
-            # fmap stays empty under generic semantics: the C loop bails
-            # on a task group's first chosen-less placement so the
-            # sequential fallback below can rescue or explain it.
-            failed_tg.update(fmap)
-
-        p = start_p
+        p = fs.start_p
         while p < len(place):
             missing = place[p]
             tg = missing.task_group

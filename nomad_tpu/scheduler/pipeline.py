@@ -1,18 +1,47 @@
-"""Pipelined eval processing: hide the device round-trip behind host work.
+"""Staged eval pipeline: hide the device round-trip AND overlap host work.
 
 On remote-attached TPUs every synchronous dispatch costs a full network
 round trip (~100 ms through the axon tunnel) regardless of compute size,
-so a strictly sequential eval loop is latency-bound: prep -> RTT -> finish,
-one eval per RTT.  This runner keeps a window of ``depth`` evals in
-flight — while eval N's results cross the wire, evals N+1..N+depth are
-reconciled, prepped, and dispatched — so steady-state throughput is bound
-by host work (a few ms/eval), not the RTT.
+so a strictly sequential eval loop is latency-bound: prep -> RTT ->
+finish, one eval per RTT.  This runner splits the eval into two host
+stages running on two threads, with up to ``depth`` device dispatches in
+flight between them:
 
-This is the eval-axis analogue of the reference's pipelined verify/apply
-(/root/reference/nomad/plan_apply.go:13-37 — plan N+1 verified while plan
-N's raft apply is in flight) and of its worker-pool concurrency
-(/root/reference/nomad/worker.go:50-437): many evals are optimistically in
-flight against the same snapshot, and the plan applier serializes commits.
+  front stage (caller thread)   drain stage (worker thread)
+  ---------------------------   ------------------------------------
+  reconcile + prep (begin)      collect device results (blocks on the
+  dispatch (non-blocking)         wire, GIL released)
+  enqueue -> bounded window --> native bulk finish + Python tail
+                                plan submit (FIFO = eval order)
+
+While eval N's results cross the wire — and while its C finish loop and
+plan submit run — evals N+1..N+depth are reconciled, prepped, and
+dispatched, so steady-state throughput is bound by the slower of the
+two host stages, not their sum, and never by the RTT.
+
+Host-floor amortization: the drain stage pulls EVERY queued eval it can
+and finishes them as one window — a single uuid slab
+(structs.generate_uuids) and a single native call
+(native/port_alloc.cpp bulk_finish_many) cover the whole window, so the
+per-eval Python re-entry cost is paid once per window, not per eval.
+Device-side, the dispatch constants (asks/feasibility/usage mirror) stay
+resident across the window (DeviceArgs.dev_const + the statics device
+cache); input buffers are NOT donated — the usage tensor is the shared
+fleet-mirror buffer that in-flight dispatches still read
+(models/fleet.py:770), so donation would corrupt the window.
+
+Ordering guarantees, unchanged from the single-threaded runner:
+per-job serialization (one in-flight eval per job per round, leftovers
+run after a ``state_refresh``) and plan-commit ordering (the drain
+stage submits strictly in eval order; even placement-less plans route
+through it).
+
+This is the eval-axis analogue of the reference's pipelined
+verify/apply (/root/reference/nomad/plan_apply.go:13-37 — plan N+1
+verified while plan N's raft apply is in flight) and of its worker-pool
+concurrency (/root/reference/nomad/worker.go:50-437): many evals are
+optimistically in flight against the same snapshot, and the plan
+applier serializes commits.
 
 Use BatchEvalRunner (scheduler/batch.py) when a whole batch is available
 up front and shapes are homogeneous — one fused vmap dispatch beats a
@@ -21,25 +50,46 @@ latency-sensitive arrivals, or when plans must commit between evals.
 """
 from __future__ import annotations
 
+import queue
+import threading
 import time
 
-from collections import deque
-
 from .batch import BatchEvalRunner
+
+_STOP = object()
+
+
+class _Item:
+    """One eval moving front -> drain.  ``handles`` is None for
+    placement-less plans (submit-only)."""
+
+    __slots__ = ("sched", "place", "args", "handles", "start")
+
+    def __init__(self, sched, place, args, handles, start) -> None:
+        self.sched = sched
+        self.place = place
+        self.args = args
+        self.handles = handles
+        self.start = start
 
 
 class PipelinedEvalRunner(BatchEvalRunner):
     """Processes a list of evaluations with up to ``depth`` device
-    dispatches in flight.
+    dispatches in flight and the two host stages overlapped.
 
-    Inherits the batch runner's per-job serialization (one in-flight eval
-    per job; leftovers run after a ``state_refresh``), status handling,
-    and submit/retry logic.  Unlike the batch runner, every eval gets its
-    own dispatch, so evals whose plans already carry deltas (migrations,
-    in-place updates) pipeline like any other.
+    Inherits the batch runner's per-job serialization (one in-flight
+    eval per job; leftovers run after a ``state_refresh``), status
+    handling, and submit/retry logic.  Unlike the batch runner, every
+    eval gets its own dispatch, so evals whose plans already carry
+    deltas (migrations, in-place updates) pipeline like any other.
 
-    ``latencies`` records per-eval wall seconds (begin -> plan submitted)
-    for the bench's percentile reporting.
+    ``latencies`` records per-eval wall seconds (begin -> plan
+    submitted) for the bench's percentile reporting.  ``stage_times``
+    accumulates per-stage wall seconds (begin/dispatch/collect/finish/
+    submit) across the run — the single-eval host-floor profile the
+    bench's bottleneck note reports.  ``host_dispatches`` /
+    ``device_dispatches`` count which executor each dispatch actually
+    used (NOMAD_TPU_EXECUTOR forces it; scheduler/executor.py).
     """
 
     def __init__(self, state, planner, depth: int = 4,
@@ -47,35 +97,154 @@ class PipelinedEvalRunner(BatchEvalRunner):
         super().__init__(state, planner, state_refresh=state_refresh)
         self.depth = max(1, depth)
         self.latencies: list[float] = []
+        self.stage_times = {"begin": 0.0, "dispatch": 0.0, "collect": 0.0,
+                            "finish": 0.0, "submit": 0.0}
+        self.host_dispatches = 0
+        self.device_dispatches = 0
+        self.windows: list[int] = []  # drained-window sizes (diagnostics)
+        self._err_lock = threading.Lock()
+        self._drain_err: BaseException | None = None
 
     def process(self, evals: list) -> None:
         from nomad_tpu.utils.gctune import gc_pause
 
         with gc_pause():
-            self._process_pipelined(evals)
+            self._process_staged(evals)
 
-    def _process_pipelined(self, evals: list) -> None:
+    # -- front stage ------------------------------------------------------
+    def _process_staged(self, evals: list) -> None:
         this_round, leftovers = self._split_rounds(evals)
-        window: deque = deque()
-        for ev in this_round:
-            start = time.perf_counter()
-            sched = self._begin_eval(ev)
-            if sched is None:
-                self.latencies.append(time.perf_counter() - start)
-                continue
-            place, args = sched.deferred
-            handles = sched.dispatch_device(args, pipelined=True)
-            window.append((sched, place, args, handles, start))
-            if len(window) >= self.depth:
-                self._drain_one(window)
-        while window:
-            self._drain_one(window)
+        q: queue.Queue = queue.Queue(maxsize=self.depth)
+        drain = threading.Thread(target=self._drain_loop, args=(q,),
+                                 name="eval-pipeline-drain", daemon=True)
+        drain.start()
+        times = self.stage_times
+        try:
+            for ev in this_round:
+                if self._failed():
+                    break
+                start = time.perf_counter()
+                sched = self._begin_eval(ev, finish_noop=False)
+                t_begin = time.perf_counter()
+                times["begin"] += t_begin - start
+                if sched is None:
+                    # Terminal without a plan (bad trigger/status error):
+                    # nothing to submit, latency is begin time alone.
+                    self.latencies.append(t_begin - start)
+                    continue
+                if sched.deferred is None:
+                    # Placement-less plan: submit-only item, routed
+                    # through the drain stage to keep commit order.
+                    q.put(_Item(sched, None, None, None, start))
+                    continue
+                place, args = sched.deferred
+                handles = sched.dispatch_device(args, pipelined=True)
+                if sched.dispatched_host:
+                    self.host_dispatches += 1
+                else:
+                    self.device_dispatches += 1
+                times["dispatch"] += time.perf_counter() - t_begin
+                q.put(_Item(sched, place, args, handles, start))
+        finally:
+            q.put(_STOP)
+            drain.join()
+        with self._err_lock:
+            err = self._drain_err
+        if err is not None:
+            raise err
         if leftovers:
             self._process_leftovers(leftovers)
 
-    def _drain_one(self, window: deque) -> None:
-        sched, place, args, handles, start = window.popleft()
-        chosen, scores = sched.collect_device(args, handles)
-        sched.finish_deferred(place, args, chosen, scores)
-        self._finish(sched)
-        self.latencies.append(time.perf_counter() - start)
+    def _failed(self) -> bool:
+        with self._err_lock:
+            return self._drain_err is not None
+
+    # -- drain stage ------------------------------------------------------
+    def _drain_loop(self, q: queue.Queue) -> None:
+        stop_seen = False
+        try:
+            while True:
+                item = q.get()
+                if item is _STOP:
+                    return
+                window = [item]
+                # Opportunistic window: everything already queued drains
+                # as ONE batch (shared uuid slab, one native call).
+                while True:
+                    try:
+                        nxt = q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if nxt is _STOP:
+                        stop_seen = True
+                        break
+                    window.append(nxt)
+                self._drain_window(window)
+                if stop_seen:
+                    return
+        except BaseException as e:
+            with self._err_lock:
+                self._drain_err = e
+            # Keep consuming so the front stage never deadlocks on a
+            # full window; items are discarded (their evals get no
+            # status — the front stops and the error propagates).  If
+            # the window-gather already swallowed the sentinel there is
+            # nothing left to wait for — blocking on q.get() here WAS a
+            # deadlock (the front is in drain.join() by then).
+            if not stop_seen:
+                while q.get() is not _STOP:
+                    pass
+
+    def _drain_window(self, window: list) -> None:
+        from nomad_tpu.structs import generate_uuids
+        from nomad_tpu.utils.native import native
+
+        times = self.stage_times
+        self.windows.append(len(window))
+
+        # 1) collect: block on each dispatch's results, FIFO.  Result
+        # copies were started at dispatch (copy_to_host_async), so
+        # waiting on eval N overlaps N+1's transfer too.
+        t0 = time.perf_counter()
+        work = [it for it in window if it.handles is not None]
+        results = {}
+        for it in work:
+            results[id(it)] = it.sched.collect_device(it.args, it.handles)
+        t1 = time.perf_counter()
+        times["collect"] += t1 - t0
+
+        # 2) finish: one uuid slab + one native call for the window,
+        # then each eval's Python tail.
+        slab = generate_uuids(sum(len(it.place) for it in work))
+        states = {}
+        nargs = []
+        off = 0
+        for it in work:
+            chosen, scores = results[id(it)]
+            n = len(it.place)
+            fs = it.sched._finish_prepare(
+                it.place, it.args, chosen, scores, slab[off:off + n])
+            off += n
+            states[id(it)] = fs
+            nargs.append(it.sched._finish_native_args(fs))
+        if native is not None and hasattr(native, "bulk_finish_many") \
+                and len(work) > 1 and all(a is not None for a in nargs):
+            outs = native.bulk_finish_many(nargs)
+            for it, out in zip(work, outs):
+                it.sched._finish_consume_native(states[id(it)], out)
+        else:
+            for it, a in zip(work, nargs):
+                if a is not None:
+                    it.sched._finish_consume_native(
+                        states[id(it)], native.bulk_finish(*a))
+        for it in work:
+            it.sched._finish_python_tail(states[id(it)])
+        t2 = time.perf_counter()
+        times["finish"] += t2 - t1
+
+        # 3) submit, strictly in eval order (noop items interleave at
+        # their original position).
+        for it in window:
+            self._finish(it.sched)
+            self.latencies.append(time.perf_counter() - it.start)
+        times["submit"] += time.perf_counter() - t2

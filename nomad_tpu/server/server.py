@@ -54,6 +54,9 @@ class ServerConfig:
         self.eval_delivery_limit: int = 3
         self.use_device_scheduler: bool = True   # jax-binpack for service
         self.device_batch: int = 64
+        # Placement-kernel executor: auto | host | device
+        # (scheduler/executor.py; NOMAD_TPU_EXECUTOR env still wins).
+        self.executor: str = "auto"
         self.failed_eval_reap_interval: float = 60.0
         self.eval_gc_interval: float = 300.0
         self.eval_gc_threshold: float = 3600.0
@@ -95,6 +98,16 @@ class ServerConfig:
 class Server:
     def __init__(self, config: Optional[ServerConfig] = None) -> None:
         self.config = config or ServerConfig()
+        from nomad_tpu.scheduler.executor import (executor_policy,
+                                                  set_executor_policy)
+        if self.config.executor != "auto":
+            # Process-wide: the executor choice is a property of the
+            # machine (chip attach latency), not of one worker.  A bad
+            # value fails the boot here, not the first dispatch.
+            set_executor_policy(self.config.executor)
+        # Resolve once now so a typo'd $NOMAD_TPU_EXECUTOR also fails
+        # the boot, not the first dispatch (the README's guarantee).
+        executor_policy()
         if self.config.tune_gc:
             # Scheduler churn + a large live store make default GC
             # thresholds cost 100-200ms pauses (utils/gctune.py).

@@ -151,6 +151,19 @@ def test_unknown_key_rejected():
         apply_to_agent_config(AgentConfig(), {"bogus_key": 1})
 
 
+def test_server_executor_parsed_and_validated():
+    """server { executor = ... } plumbs the placement-kernel executor
+    override (scheduler/executor.py) into AgentConfig; a typo fails the
+    config load, not the first dispatch."""
+    cfg = AgentConfig()
+    apply_to_agent_config(cfg, parse_config_string(
+        'server {\n  enabled = true\n  executor = "device"\n}\n'))
+    assert cfg.executor == "device"
+    with pytest.raises(ConfigError):
+        apply_to_agent_config(AgentConfig(), parse_config_string(
+            'server {\n  executor = "tpu"\n}\n'))
+
+
 def test_merge_config_scalars_and_sections():
     merged = merge_config(
         {"x": 1, "s": {"a": 1, "b": 2}, "l": [1, 2]},

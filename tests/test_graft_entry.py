@@ -37,6 +37,14 @@ def test_dryrun_multichip_8():
     assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-4000:])
     assert "dryrun_multichip(8)" in r.stdout
     assert "parity" in r.stdout
+    # The forced-device pipeline (NOMAD_TPU_EXECUTOR=device twin of the
+    # bench's 4_device_pipelined row) must really dispatch on the mesh
+    # platform: device_fraction > 0, placed count == the host twin.
+    m = re.search(r"executor=device device_fraction=([0-9.]+) "
+                  r"placed=(\d+)", r.stdout)
+    assert m, r.stdout[-2000:]
+    assert float(m.group(1)) > 0, r.stdout[-2000:]
+    assert int(m.group(2)) > 0, r.stdout[-2000:]
 
 
 def test_entry_compiles():

@@ -114,6 +114,98 @@ def test_pipeline_serializes_same_job_evals():
     assert len(live) == 4
 
 
+def test_pipeline_windowed_drain_matches_sequential():
+    """Drive the drain stage's windowed finish DIRECTLY with the whole
+    stream as one window (shared uuid slab + one native
+    bulk_finish_many call) and assert the plans equal one-at-a-time
+    processing — the windowed path must be invisible to semantics.
+    Deterministic on purpose: building the window by hand (front-stage
+    steps run inline) instead of racing the two threads."""
+    import time as _time
+
+    from nomad_tpu.scheduler.pipeline import PipelinedEvalRunner, _Item
+
+    h, _, jobs = _cluster(16, 6)
+    runner = PipelinedEvalRunner(h.state.snapshot(), h, depth=8)
+    window = []
+    for j in jobs:
+        start = _time.perf_counter()
+        sched = runner._begin_eval(make_eval(j), finish_noop=False)
+        assert sched is not None and sched.deferred is not None
+        place, args = sched.deferred
+        handles = sched.dispatch_device(args, pipelined=True)
+        window.append(_Item(sched, place, args, handles, start))
+    runner._drain_window(window)
+    assert runner.windows == [len(jobs)]
+    assert len(runner.latencies) == len(jobs)
+
+    h2, _, _ = _cluster(16, 0)
+    for j in jobs:
+        h2.state.upsert_job(h2.next_index(), j)
+    for j in jobs:
+        h2.process("jax-binpack", make_eval(j))
+
+    def shape(plans):
+        return sorted(
+            (sum(len(v) for v in p.node_allocation.values()),
+             len(p.failed_allocs)) for p in plans)
+
+    assert shape(h.plans) == shape(h2.plans)
+    assert all(e.status == "complete" for e in h.evals)
+
+
+def test_pipeline_drain_error_propagates():
+    """A failure in the drain stage must surface to the caller, not
+    hang the front stage on a full window."""
+    import pytest
+
+    from nomad_tpu.scheduler.pipeline import PipelinedEvalRunner as PR
+
+    class Boom(RuntimeError):
+        pass
+
+    class FailingDrain(PR):
+        def _drain_window(self, window):
+            raise Boom("drain stage failure")
+
+    h, _, jobs = _cluster(8, 4)
+    runner = FailingDrain(h.state.snapshot(), h, depth=2)
+    with pytest.raises(Boom):
+        runner.process([make_eval(j) for j in jobs])
+
+
+def test_pipeline_drain_error_after_sentinel_in_window():
+    """Regression: when the window-gather has already consumed the
+    _STOP sentinel and THEN the window fails, the error path must not
+    block waiting for a sentinel that will never come (that was a
+    deadlock: the front is already in drain.join())."""
+    import queue as _queue
+    import threading as _threading
+
+    from nomad_tpu.scheduler.pipeline import (PipelinedEvalRunner as PR,
+                                              _Item, _STOP)
+
+    class Boom(RuntimeError):
+        pass
+
+    class FailingDrain(PR):
+        def _drain_window(self, window):
+            raise Boom("fails after sentinel consumed")
+
+    h, _, _jobs = _cluster(4, 0)
+    runner = FailingDrain(h.state.snapshot(), h, depth=4)
+    q: _queue.Queue = _queue.Queue()
+    q.put(_Item(None, None, None, None, 0.0))
+    q.put(_STOP)  # gathered into the same window as the item
+    t = _threading.Thread(target=runner._drain_loop, args=(q,),
+                          daemon=True)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive(), "drain loop deadlocked after sentinel"
+    with runner._err_lock:
+        assert isinstance(runner._drain_err, Boom)
+
+
 def test_pipeline_handles_migrations_and_noops():
     """Evals whose plans carry deltas (node drain -> migrate) and no-op
     evals pipeline like any other."""
