@@ -11,13 +11,14 @@ import logging
 import os
 import tempfile
 import threading
-import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from nomad_tpu import faultinject
 from nomad_tpu.client import Client, ClientConfig
 from nomad_tpu.server import Server, ServerConfig
 from nomad_tpu.server.endpoints import Endpoints
+from nomad_tpu.utils.retry import Backoff
 
 logger = logging.getLogger("nomad_tpu.agent")
 
@@ -43,6 +44,10 @@ class InprocRPC:
         self._methods = reg.table
 
     def call(self, method: str, args: dict, timeout=None):
+        if faultinject.ACTIVE:
+            # Same chokepoint ConnPool.call instruments for networked
+            # clients: a colocated client's "sends" are these calls.
+            faultinject.fire_rpc("rpc.send", method, args)
         fn = self._methods.get(method)
         if fn is None:
             raise ValueError(f"unknown method {method!r}")
@@ -181,6 +186,7 @@ class Agent:
         if gossip is None:
             return
         targets = [tuple(t) for t in self.config.retry_join]
+        backoff = Backoff(base=1.0, max_delay=15.0, jitter=0.5)
         while not self.server._shutdown.is_set():
             for target in targets:
                 try:
@@ -192,7 +198,8 @@ class Agent:
                 logger.info("retry-join succeeded (%d members)",
                             len(gossip.members()))
                 return
-            time.sleep(1.0)
+            if backoff.sleep(self.server._shutdown):
+                return
 
     def _setup_client(self) -> None:
         from nomad_tpu.structs import Node

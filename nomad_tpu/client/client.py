@@ -15,7 +15,6 @@ from __future__ import annotations
 import logging
 import os
 import threading
-import time
 from typing import Optional
 
 from nomad_tpu.structs import (
@@ -25,6 +24,7 @@ from nomad_tpu.structs import (
     Node,
     generate_uuid,
 )
+from nomad_tpu.utils.retry import Backoff, RetryPolicy
 
 from .alloc_runner import AllocRunner
 from .config import ClientConfig
@@ -33,8 +33,22 @@ from .fingerprint import fingerprint_node
 
 logger = logging.getLogger("nomad_tpu.client")
 
-REGISTER_RETRY_INTERVAL = 1.0
+REGISTER_RETRY_INTERVAL = 1.0   # registration backoff base
+REGISTER_RETRY_MAX = 30.0       # registration backoff cap
 STATE_SNAPSHOT_INTERVAL = 60.0
+
+# Node.UpdateAlloc sync: a short bounded burst with a per-attempt
+# transport timeout AND a total deadline well under the ~20s
+# server-side TTL+grace window (a hung server must not pin the status
+# outbox — or delay the next heartbeat — long enough to expire the
+# node); anything that still fails stays queued for the next heartbeat
+# (never dropped).  Breadth of `Exception` is deliberate —
+# RPCError("no leader") is as transient here as a dead socket.
+UPDATE_ALLOC_POLICY = RetryPolicy(
+    base=0.2, max_delay=2.0, max_attempts=3, attempt_timeout=3.0,
+    deadline=5.0,
+    retryable=lambda e: isinstance(e, Exception),
+    name="client.update_alloc")
 
 
 class NetRPCHandler:
@@ -75,6 +89,16 @@ class Client:
 
         self.alloc_runners: dict = {}
         self._alloc_lock = threading.Lock()
+        # Client-authoritative alloc updates awaiting delivery
+        # (alloc id -> update dict, newest wins); flushed inline and
+        # re-flushed after each successful heartbeat.
+        self._pending_updates: dict = {}
+        self._update_lock = threading.Lock()
+        # Serializes whole flush bursts (heartbeat thread vs inline
+        # sync): two interleaved flushes could otherwise deliver a
+        # stale snapshot AFTER a newer one, regressing a terminal
+        # client_status on the server.
+        self._flush_lock = threading.Lock()
         self._shutdown = threading.Event()
         self._heartbeat_ttl = 10.0
         self._alloc_index = 0
@@ -207,18 +231,35 @@ class Client:
     def _register(self) -> None:
         node = self.node.copy()
         node.status = NODE_STATUS_READY
+        backoff = Backoff(base=REGISTER_RETRY_INTERVAL,
+                          max_delay=REGISTER_RETRY_MAX, jitter=0.5)
         while not self._shutdown.is_set():
             try:
                 resp = self.rpc.call("Node.Register",
                                      {"node": node.to_dict()})
-                self.node = node
-                if resp.get("heartbeat_ttl"):
-                    self._heartbeat_ttl = resp["heartbeat_ttl"]
-                logger.info("client: registered node %s", node.id)
-                return
-            except Exception:
-                logger.exception("client: registration failed; retrying")
-                self._shutdown.wait(REGISTER_RETRY_INTERVAL)
+            except Exception as e:
+                # First failure carries the traceback; the rest are
+                # one-line WARNs — an unreachable server is expected
+                # during bring-up and must not fill the log.
+                first = backoff.failures == 0
+                delay = backoff.next()
+                if first:
+                    logger.warning(
+                        "client: registration failed; retrying with "
+                        "capped backoff (next in %.1fs)", delay,
+                        exc_info=True)
+                else:
+                    logger.warning(
+                        "client: registration still failing after %d "
+                        "attempts (next in %.1fs): %s",
+                        backoff.failures, delay, e)
+                self._shutdown.wait(delay)
+                continue
+            self.node = node
+            if resp.get("heartbeat_ttl"):
+                self._heartbeat_ttl = resp["heartbeat_ttl"]
+            logger.info("client: registered node %s", node.id)
+            return
 
     def _heartbeat_loop(self) -> None:
         while not self._shutdown.is_set():
@@ -234,6 +275,19 @@ class Client:
             except Exception:
                 logger.warning("client: heartbeat failed; re-registering")
                 self._register()
+            else:
+                # The server is reachable: deliver any alloc updates a
+                # failed sync left queued.  Non-blocking — the
+                # heartbeat cadence must never wait out a flush burst
+                # (a stalled burst outlasting the TTL would expire this
+                # node and duplicate its allocations elsewhere).
+                with self._update_lock:
+                    dirty = bool(self._pending_updates)
+                if dirty:
+                    threading.Thread(
+                        target=self._flush_alloc_updates,
+                        kwargs={"block": False}, daemon=True,
+                        name="client-alloc-flush").start()
 
     # -- alloc watching ------------------------------------------------------
     def _watch_allocations(self) -> None:
@@ -300,7 +354,11 @@ class Client:
                     runner.update(alloc)
 
     def _sync_alloc_status(self, alloc: Allocation) -> None:
-        """Dirty-sync client-authoritative fields to the server."""
+        """Dirty-sync client-authoritative fields to the server.  The
+        update is queued first, so a server outage longer than the
+        retry burst leaves it pending for the next heartbeat instead of
+        dropping it (the seed's silent-loss mode: a terminal status the
+        server never heard about pins the alloc live forever)."""
         update = {
             "id": alloc.id,
             "client_status": alloc.client_status,
@@ -308,12 +366,58 @@ class Client:
             "task_states": alloc.task_states,
             "node_id": alloc.node_id,
         }
-        for attempt in range(3):
-            try:
-                self.rpc.call("Node.UpdateAlloc", {"alloc": [update]})
+        with self._update_lock:
+            self._pending_updates[alloc.id] = update
+        self._flush_alloc_updates()
+
+    def _flush_alloc_updates(self, block: bool = True) -> None:
+        """Push every queued alloc update in one call (jittered bounded
+        retries); failures leave the queue intact — newest update per
+        alloc wins, delivery retries on the next heartbeat.
+
+        One burst at a time (`_flush_lock`), and each retry attempt
+        re-snapshots the queue, so a retry never re-sends a payload
+        that a newer update has superseded mid-burst.  ``block=False``
+        (the heartbeat path) bails out when a burst is already in
+        flight — its later attempts re-snapshot and pick our update
+        up, or the next heartbeat retries."""
+        if not self._flush_lock.acquire(blocking=block):
+            return
+        try:
+            self._flush_alloc_updates_locked()
+        finally:
+            self._flush_lock.release()
+
+    def _flush_alloc_updates_locked(self) -> None:
+        delivered: dict = {}
+
+        def attempt(timeout=None) -> None:
+            with self._update_lock:
+                snapshot = dict(self._pending_updates)
+            if not snapshot:
+                delivered.clear()
                 return
-            except Exception:
-                if attempt == 2:
-                    logger.exception("client: alloc %s status sync failed",
-                                     alloc.id)
-                time.sleep(0.2 * (attempt + 1))
+            self.rpc.call("Node.UpdateAlloc",
+                          {"alloc": list(snapshot.values())},
+                          timeout=timeout)
+            delivered.clear()
+            delivered.update(snapshot)
+
+        with self._update_lock:
+            if not self._pending_updates:
+                return
+        try:
+            UPDATE_ALLOC_POLICY.call(attempt, stop=self._shutdown)
+        except Exception as e:
+            with self._update_lock:
+                queued = len(self._pending_updates)
+            logger.warning(
+                "client: alloc status sync failed; %d update(s) "
+                "queued for next heartbeat: %s", queued, e)
+            return
+        with self._update_lock:
+            for alloc_id, update in delivered.items():
+                # Drop only what we actually delivered: a runner
+                # may have queued a newer update mid-flight.
+                if self._pending_updates.get(alloc_id) is update:
+                    del self._pending_updates[alloc_id]

@@ -14,6 +14,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from nomad_tpu import faultinject
 from nomad_tpu.structs import Task
 
 from .driver import new_driver
@@ -88,6 +89,9 @@ class TaskRunner:
     def run(self) -> None:
         if self.handle is None:
             try:
+                if faultinject.ACTIVE:
+                    faultinject.fire("driver.start",
+                                     method=self.task.driver)
                 driver = new_driver(self.task.driver, self.ctx)
                 self.handle = driver.start(self.task)
             except Exception as e:

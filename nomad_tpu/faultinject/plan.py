@@ -1,0 +1,323 @@
+"""Fault plans: named-site rules with deterministic, seedable firing.
+
+A ``FaultPlan`` is a set of rules keyed by *site* — a chokepoint the
+runtime consults (``faultinject.fire``) on every pass through it.  Each
+rule carries an action, an optional probability, a fire budget, and
+match predicates, so a test (or an operator reproducing an incident)
+can say precisely "drop the 3rd..5th Node.UpdateAlloc frames" or "hang
+one device collect" and get the same failure sequence on every run:
+the plan owns a ``random.Random(seed)``, so probabilistic rules are a
+deterministic function of (seed, consultation order).
+
+Spec grammar (``NOMAD_TPU_FAULTS`` or ``FaultPlan.parse``)::
+
+    spec    := clause (';' clause)*
+    clause  := 'seed' '=' INT
+             | site '=' action [ '(' param (',' param)* ')' ]
+    action  := 'error' | 'drop' | 'delay' | 'hang'
+    param   := 'p' '=' FLOAT          probability per consultation (1.0)
+             | 'count' '=' INT        total fires allowed (unlimited)
+             | 'after' '=' INT        matches skipped before arming (0)
+             | 'secs' '=' FLOAT       delay/hang duration
+             | 'method' '=' NAME      RPC method predicate ('*' suffix ok)
+             | 'node' '=' ID          node-id predicate ('*' suffix ok)
+
+Example::
+
+    NOMAD_TPU_FAULTS='seed=7;rpc.send=drop(p=0.5,count=3,method=Node.*);device.collect=hang(secs=2)'
+
+Actions:
+
+``error``
+    raise ``FaultInjected`` — the generic "this step failed" fault.
+``drop``
+    raise ``FaultDropped`` (a ``ConnectionError``) — a lost frame.  The
+    RPC receive plane special-cases it: the request is swallowed with
+    no reply, so the caller sees only its own timeout, exactly like a
+    frame lost on the wire.
+``delay``
+    sleep ``secs`` (default 0.05) and continue — added latency.
+``hang``
+    sleep ``secs`` (default 300) and continue — a stall long enough
+    that any deadline-bounded caller gives up first.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+# The named chokepoints.  Instrumented call sites pass one of these to
+# ``fire``; ``parse``/``FaultPlan.add`` reject anything else so a typo
+# in a spec fails loudly instead of silently injecting nothing.
+SITES = (
+    "rpc.send",           # client/conn-pool about to send a request
+    "rpc.recv",           # server received a request, pre-dispatch
+    "raft.apply",         # an entry entering the replicated log
+    "heartbeat.deliver",  # a node heartbeat reaching the leader
+    "device.dispatch",    # a device placement dispatch starting
+    "device.collect",     # blocking on a device dispatch's results
+    "driver.start",       # a task driver starting a task
+)
+
+# Which match-predicate context each site's instrumentation supplies.
+# A rule whose predicate a site can never satisfy would silently never
+# fire — the worst chaos-run outcome — so add()/parse() reject it.
+# (driver.start passes the driver name as ``method``.)
+SITE_CONTEXT = {
+    "rpc.send": ("method", "node"),
+    "rpc.recv": ("method", "node"),
+    "raft.apply": (),
+    "heartbeat.deliver": ("node",),
+    "device.dispatch": (),
+    "device.collect": (),
+    "driver.start": ("method",),
+}
+
+ACTIONS = ("error", "drop", "delay", "hang")
+
+DELAY_DEFAULT_SECS = 0.05
+HANG_DEFAULT_SECS = 300.0
+
+
+class FaultInjected(Exception):
+    """An injected generic failure."""
+
+
+class FaultDropped(ConnectionError):
+    """An injected lost frame (transport-shaped, hence retryable)."""
+
+
+class FaultSpecError(ValueError):
+    """A NOMAD_TPU_FAULTS spec (or add() call) that doesn't parse."""
+
+
+def _match(pattern: Optional[str], value: Optional[str]) -> bool:
+    """Predicate match: None matches everything; a trailing '*' is a
+    prefix match; otherwise exact."""
+    if pattern is None:
+        return True
+    if value is None:
+        return False
+    if pattern.endswith("*"):
+        return value.startswith(pattern[:-1])
+    return value == pattern
+
+
+class FaultRule:
+    """One (site, action) rule.  Mutable counters are guarded by the
+    owning plan's lock."""
+
+    __slots__ = ("site", "action", "p", "count", "after", "secs",
+                 "method", "node", "fired", "skipped")
+
+    def __init__(self, site: str, action: str, p: float = 1.0,
+                 count: Optional[int] = None, after: int = 0,
+                 secs: Optional[float] = None,
+                 method: Optional[str] = None,
+                 node: Optional[str] = None) -> None:
+        if site not in SITES:
+            raise FaultSpecError(
+                f"unknown fault site {site!r}; want one of {', '.join(SITES)}")
+        if action not in ACTIONS:
+            raise FaultSpecError(
+                f"unknown fault action {action!r}; want one of "
+                f"{', '.join(ACTIONS)}")
+        if not 0.0 <= p <= 1.0:
+            raise FaultSpecError(f"probability {p!r} outside [0, 1]")
+        supplied = SITE_CONTEXT[site]
+        for key, value in (("method", method), ("node", node)):
+            if value is not None and key not in supplied:
+                raise FaultSpecError(
+                    f"site {site!r} supplies no {key!r} context: a "
+                    f"{key}= predicate there would silently never fire")
+        self.site = site
+        self.action = action
+        self.p = p
+        self.count = count
+        self.after = after
+        self.secs = secs
+        self.method = method
+        self.node = node
+        self.fired = 0     # guarded by plan._lock
+        self.skipped = 0   # guarded by plan._lock
+
+    def matches(self, method: Optional[str], node: Optional[str]) -> bool:
+        return _match(self.method, method) and _match(self.node, node)
+
+    def __repr__(self) -> str:  # debugging/spec round-trip aid
+        parts = []
+        if self.p != 1.0:
+            parts.append(f"p={self.p}")
+        if self.count is not None:
+            parts.append(f"count={self.count}")
+        if self.after:
+            parts.append(f"after={self.after}")
+        if self.secs is not None:
+            parts.append(f"secs={self.secs}")
+        if self.method:
+            parts.append(f"method={self.method}")
+        if self.node:
+            parts.append(f"node={self.node}")
+        args = f"({','.join(parts)})" if parts else ""
+        return f"{self.site}={self.action}{args}"
+
+
+class FaultPlan:
+    """A seeded set of fault rules, consulted via :meth:`fire`.
+
+    Thread-safe: many runtime threads consult one plan; rule counters
+    and the RNG are guarded by one lock.  ``fires`` records every
+    injection performed — (site, action, method, node) — so tests can
+    assert exactly what was injected.
+    """
+
+    FIRES_CAP = 4096  # the record is diagnostic, never unbounded
+
+    def __init__(self, seed: Optional[int] = None) -> None:
+        import random
+
+        self._lock = threading.Lock()
+        self._rng = random.Random(seed)    # guarded by _lock
+        self.seed = seed
+        self._rules: dict = {}             # site -> [FaultRule]; guarded
+        self.fires: list = []              # injections done; guarded
+
+    def add(self, site: str, action: str, **kw) -> "FaultPlan":
+        rule = FaultRule(site, action, **kw)
+        with self._lock:
+            self._rules.setdefault(site, []).append(rule)
+        return self  # chainable: FaultPlan(seed=1).add(...).add(...)
+
+    def rules(self, site: Optional[str] = None) -> list:
+        with self._lock:
+            if site is not None:
+                return list(self._rules.get(site, ()))
+            return [r for rules in self._rules.values() for r in rules]
+
+    def fire_count(self, site: Optional[str] = None) -> int:
+        with self._lock:
+            return sum(1 for f in self.fires
+                       if site is None or f[0] == site)
+
+    def exhausted(self) -> bool:
+        """Every counted rule has spent its budget (uncounted rules are
+        never exhausted)."""
+        with self._lock:
+            rules = [r for rs in self._rules.values() for r in rs]
+            return all(r.count is not None and r.fired >= r.count
+                       for r in rules) if rules else True
+
+    # -- consultation ------------------------------------------------------
+    def fire(self, site: str, method: Optional[str] = None,
+             node: Optional[str] = None) -> None:
+        """Consult the plan at ``site``.  Sleeps and/or raises per the
+        first armed matching rule; returns silently when nothing fires.
+        Decision + bookkeeping happen under the lock; the sleep itself
+        does not (a delay/hang must not serialize unrelated threads).
+        """
+        sleep_secs = 0.0
+        exc: Optional[Exception] = None
+        with self._lock:
+            for rule in self._rules.get(site, ()):
+                if not rule.matches(method, node):
+                    continue
+                if rule.count is not None and rule.fired >= rule.count:
+                    continue
+                if rule.skipped < rule.after:
+                    rule.skipped += 1
+                    continue
+                if rule.p < 1.0 and self._rng.random() >= rule.p:
+                    continue
+                rule.fired += 1
+                if len(self.fires) < self.FIRES_CAP:
+                    self.fires.append((site, rule.action, method, node))
+                if rule.action == "error":
+                    exc = FaultInjected(
+                        f"injected error at {site}"
+                        + (f" ({method})" if method else ""))
+                elif rule.action == "drop":
+                    exc = FaultDropped(
+                        f"injected drop at {site}"
+                        + (f" ({method})" if method else ""))
+                elif rule.action == "delay":
+                    sleep_secs = rule.secs if rule.secs is not None \
+                        else DELAY_DEFAULT_SECS
+                else:  # hang
+                    sleep_secs = rule.secs if rule.secs is not None \
+                        else HANG_DEFAULT_SECS
+                break  # first armed matching rule wins
+        if sleep_secs > 0.0:
+            time.sleep(sleep_secs)
+        if exc is not None:
+            raise exc
+
+    # -- spec parsing ------------------------------------------------------
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """Build a plan from the NOMAD_TPU_FAULTS grammar (module
+        docstring).  Raises FaultSpecError on anything malformed."""
+        seed: Optional[int] = None
+        clauses = []
+        for raw in spec.split(";"):
+            clause = raw.strip()
+            if not clause:
+                continue
+            if "=" not in clause:
+                raise FaultSpecError(
+                    f"fault clause {clause!r} is missing '='")
+            key, _, rest = clause.partition("=")
+            key = key.strip()
+            if key == "seed":
+                try:
+                    seed = int(rest.strip())
+                except ValueError:
+                    raise FaultSpecError(
+                        f"seed {rest.strip()!r} is not an integer") from None
+                continue
+            clauses.append((key, rest.strip()))
+
+        plan = cls(seed=seed)
+        for site, rest in clauses:
+            action, _, paren = rest.partition("(")
+            action = action.strip()
+            kw: dict = {}
+            if paren:
+                if not paren.endswith(")"):
+                    raise FaultSpecError(
+                        f"unterminated parameter list in {site}={rest!r}")
+                for param in paren[:-1].split(","):
+                    param = param.strip()
+                    if not param:
+                        continue
+                    if "=" not in param:
+                        raise FaultSpecError(
+                            f"parameter {param!r} is missing '='")
+                    pk, _, pv = param.partition("=")
+                    pk, pv = pk.strip(), pv.strip()
+                    if pk == "p":
+                        kw["p"] = _parse_num(pk, pv, float)
+                    elif pk == "count":
+                        kw["count"] = _parse_num(pk, pv, int)
+                    elif pk == "after":
+                        kw["after"] = _parse_num(pk, pv, int)
+                    elif pk == "secs":
+                        kw["secs"] = _parse_num(pk, pv, float)
+                    elif pk == "method":
+                        kw["method"] = pv
+                    elif pk == "node":
+                        kw["node"] = pv
+                    else:
+                        raise FaultSpecError(
+                            f"unknown fault parameter {pk!r}")
+            plan.add(site, action, **kw)
+        return plan
+
+
+def _parse_num(key: str, value: str, kind):
+    try:
+        return kind(value)
+    except ValueError:
+        raise FaultSpecError(
+            f"fault parameter {key}={value!r} is not a "
+            f"{kind.__name__}") from None

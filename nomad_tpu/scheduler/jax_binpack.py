@@ -559,7 +559,8 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
         return chosen, scores
 
     def dispatch_device(self, args: "DeviceArgs",
-                        pipelined: bool = False) -> tuple:
+                        pipelined: bool = False,
+                        force: bool = False) -> tuple:
         """Start the device dispatch for prepared args WITHOUT blocking:
         the computation and its device->host result copies are left in
         flight, so a pipelined caller (scheduler/pipeline.py) can prep
@@ -567,8 +568,13 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
         on remote-attached TPUs a synchronous dispatch costs a full
         network round trip (~100 ms through the axon tunnel) no matter
         how small the compute.  Small workloads skip the device entirely
-        (choose_host_executor) and come back as ready numpy arrays."""
-        if self.choose_host_executor(args, pipelined):
+        (choose_host_executor) and come back as ready numpy arrays.
+
+        ``force=True`` skips the executor check: the caller already
+        decided (the pipelined runner's breaker admission must not be
+        re-litigated here — a mid-flight policy flip would otherwise
+        run host under an in-flight device probe and orphan it)."""
+        if not force and self.choose_host_executor(args, pipelined):
             self.dispatched_host = True
             return self.dispatch_host(args)
         self.dispatched_host = False

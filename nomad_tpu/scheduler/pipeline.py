@@ -50,27 +50,66 @@ latency-sensitive arrivals, or when plans must commit between evals.
 """
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
 
+from nomad_tpu import faultinject
+
 from .batch import BatchEvalRunner
+from .breaker import ADMIT_HOST, ADMIT_PROBE, GLOBAL_BREAKER
+
+logger = logging.getLogger("nomad_tpu.scheduler.pipeline")
 
 _STOP = object()
 
 
+class _CollectWorker:
+    """Long-lived watchdog worker for deadline-bounded device collects.
+
+    The drain stage feeds it one callable at a time via ``inq`` and
+    waits on ``outq`` with the deadline; a ``None`` on ``inq`` exits
+    the thread.  The runner replaces the worker after a timeout — a
+    hung device call cannot be interrupted, so the old worker keeps its
+    references only until that call returns, then sees the sentinel
+    and dies (no unbounded thread accumulation under a fault burst).
+    """
+
+    def __init__(self) -> None:
+        self.inq: queue.Queue = queue.Queue()
+        self.outq: queue.Queue = queue.Queue()
+        self.thread = threading.Thread(target=self._run, daemon=True,
+                                       name="device-collect")
+        self.thread.start()
+
+    def _run(self) -> None:
+        while True:
+            fn = self.inq.get()
+            if fn is None:
+                return
+            try:
+                self.outq.put((True, fn()))
+            except BaseException as e:
+                self.outq.put((False, e))
+
+
 class _Item:
     """One eval moving front -> drain.  ``handles`` is None for
-    placement-less plans (submit-only)."""
+    placement-less plans (submit-only).  ``probe`` marks the breaker's
+    half-open probe: the drain stage re-runs it on the host twin and
+    asserts parity before closing the breaker."""
 
-    __slots__ = ("sched", "place", "args", "handles", "start")
+    __slots__ = ("sched", "place", "args", "handles", "start", "probe")
 
-    def __init__(self, sched, place, args, handles, start) -> None:
+    def __init__(self, sched, place, args, handles, start,
+                 probe: bool = False) -> None:
         self.sched = sched
         self.place = place
         self.args = args
         self.handles = handles
         self.start = start
+        self.probe = probe
 
 
 class PipelinedEvalRunner(BatchEvalRunner):
@@ -93,7 +132,8 @@ class PipelinedEvalRunner(BatchEvalRunner):
     """
 
     def __init__(self, state, planner, depth: int = 4,
-                 state_refresh=None) -> None:
+                 state_refresh=None, breaker=None,
+                 device_deadline: "float | None" = None) -> None:
         super().__init__(state, planner, state_refresh=state_refresh)
         self.depth = max(1, depth)
         self.latencies: list[float] = []
@@ -102,6 +142,25 @@ class PipelinedEvalRunner(BatchEvalRunner):
         self.host_dispatches = 0
         self.device_dispatches = 0
         self.windows: list[int] = []  # drained-window sizes (diagnostics)
+        # Device-executor circuit breaker (scheduler/breaker.py): failed
+        # or deadline-blown device dispatches re-run on the host twin
+        # and trip the breaker, which then holds the executor on host
+        # with periodic half-open re-probes.  Shared process-wide by
+        # default — device health is a machine property, not a runner's.
+        self.breaker = breaker if breaker is not None else GLOBAL_BREAKER
+        # Optional per-collect watchdog (seconds): None = no watchdog
+        # thread (zero overhead; only raised errors trip the breaker).
+        self.device_deadline = device_deadline
+        # Evals re-run on host after a device failure: incremented from
+        # BOTH stages (front on dispatch faults, drain on collect
+        # faults), so the += goes through _count_lock.
+        self.breaker_reruns = 0
+        self._count_lock = threading.Lock()
+        self.parity_checks = 0    # probe evals parity-asserted host/dev
+        # Lazy long-lived watchdog worker for deadline-bounded collects
+        # (drain thread only; replaced after a timeout, see
+        # _collect_device_bounded).
+        self._collect_worker: "_CollectWorker | None" = None
         self._err_lock = threading.Lock()
         self._drain_err: BaseException | None = None
 
@@ -138,16 +197,18 @@ class PipelinedEvalRunner(BatchEvalRunner):
                     q.put(_Item(sched, None, None, None, start))
                     continue
                 place, args = sched.deferred
-                handles = sched.dispatch_device(args, pipelined=True)
+                handles, probe = self._dispatch(sched, args)
                 if sched.dispatched_host:
                     self.host_dispatches += 1
                 else:
                     self.device_dispatches += 1
                 times["dispatch"] += time.perf_counter() - t_begin
-                q.put(_Item(sched, place, args, handles, start))
+                q.put(_Item(sched, place, args, handles, start,
+                            probe=probe))
         finally:
             q.put(_STOP)
             drain.join()
+            self._stop_collect_worker()
         with self._err_lock:
             err = self._drain_err
         if err is not None:
@@ -158,6 +219,38 @@ class PipelinedEvalRunner(BatchEvalRunner):
     def _failed(self) -> bool:
         with self._err_lock:
             return self._drain_err is not None
+
+    def _dispatch(self, sched, args) -> tuple:
+        """Route one eval's dispatch through the executor policy AND the
+        circuit breaker.  Returns (handles, probe): evals the breaker
+        holds run the host twin (identical plans by construction); a
+        half-open probe runs the device and is parity-checked in the
+        drain stage; a dispatch that raises trips the breaker and falls
+        back to host immediately."""
+        if sched.choose_host_executor(args, pipelined=True):
+            sched.dispatched_host = True
+            return sched.dispatch_host(args), False
+        admit = self.breaker.admit()
+        if admit == ADMIT_HOST:
+            sched.dispatched_host = True
+            return sched.dispatch_host(args), False
+        probe = admit == ADMIT_PROBE
+        try:
+            if faultinject.ACTIVE:
+                faultinject.fire("device.dispatch")
+            # force=True: the executor decision was made above (policy
+            # + breaker); re-evaluating it inside dispatch_device could
+            # route a half-open probe to the host twin and orphan it.
+            return sched.dispatch_device(args, pipelined=True,
+                                         force=True), probe
+        except Exception:
+            logger.warning("device dispatch failed; re-running eval on "
+                           "the host twin", exc_info=True)
+            self.breaker.record_failure(probe=probe)
+            with self._count_lock:
+                self.breaker_reruns += 1
+            sched.dispatched_host = True
+            return sched.dispatch_host(args), False
 
     # -- drain stage ------------------------------------------------------
     def _drain_loop(self, q: queue.Queue) -> None:
@@ -204,12 +297,14 @@ class PipelinedEvalRunner(BatchEvalRunner):
 
         # 1) collect: block on each dispatch's results, FIFO.  Result
         # copies were started at dispatch (copy_to_host_async), so
-        # waiting on eval N overlaps N+1's transfer too.
+        # waiting on eval N overlaps N+1's transfer too.  A device
+        # collect that fails or blows the deadline re-runs on the host
+        # twin and trips the breaker (the window keeps draining).
         t0 = time.perf_counter()
         work = [it for it in window if it.handles is not None]
         results = {}
         for it in work:
-            results[id(it)] = it.sched.collect_device(it.args, it.handles)
+            results[id(it)] = self._collect_item(it)
         t1 = time.perf_counter()
         times["collect"] += t1 - t0
 
@@ -248,3 +343,88 @@ class PipelinedEvalRunner(BatchEvalRunner):
             self._finish(it.sched)
             self.latencies.append(time.perf_counter() - it.start)
         times["submit"] += time.perf_counter() - t2
+
+    # -- device failure handling (breaker) ---------------------------------
+    def _collect_item(self, it: _Item) -> tuple:
+        """Collect one item's results, routing device outcomes through
+        the circuit breaker.  Probe items additionally run the host
+        twin and assert parity before the breaker closes."""
+        import numpy as np
+
+        sched = it.sched
+        if sched.dispatched_host:
+            return sched.collect_device(it.args, it.handles)
+        try:
+            res = self._collect_device_bounded(it)
+        except Exception as e:
+            logger.warning("device collect failed (%s); re-running eval "
+                           "on the host twin", e)
+            self.breaker.record_failure(probe=it.probe)
+            with self._count_lock:
+                self.breaker_reruns += 1
+            return self._host_rerun(it)
+        if it.probe:
+            host = self._host_rerun(it)
+            chosen_d, scores_d = res
+            chosen_h, scores_h = host
+            # Identical by construction (tests/test_executor_parity.py
+            # gates it); a mismatch here means the device path is
+            # corrupting plans and MUST fail loudly, not degrade —
+            # an explicit raise (not an assert, which -O would strip)
+            # so the probe can never close the breaker unverified.
+            if not (np.array_equal(np.asarray(chosen_d),
+                                   np.asarray(chosen_h)) and
+                    np.allclose(np.asarray(scores_d, dtype=np.float64),
+                                np.asarray(scores_h, dtype=np.float64))):
+                self.breaker.record_failure(probe=it.probe)
+                raise RuntimeError(
+                    "device/host parity violation on breaker probe")
+            self.parity_checks += 1
+            self.breaker.record_success(probe=True)
+            return host
+        self.breaker.record_success()
+        return res
+
+    def _collect_device_bounded(self, it: _Item) -> tuple:
+        """Device collect with the optional watchdog deadline: a hung
+        collect raises TimeoutError.  One long-lived worker is reused
+        across collects (no thread churn on the drain hot path) and
+        replaced only after a timeout — the abandoned worker drains its
+        hung call whenever the device returns, then exits via the
+        sentinel so it never lingers past that."""
+        def _collect():
+            if faultinject.ACTIVE:
+                faultinject.fire("device.collect")
+            return it.sched.collect_device(it.args, it.handles)
+
+        if self.device_deadline is None:
+            return _collect()
+        worker = self._collect_worker
+        if worker is None:
+            worker = self._collect_worker = _CollectWorker()
+        worker.inq.put(_collect)
+        try:
+            ok, val = worker.outq.get(timeout=self.device_deadline)
+        except queue.Empty:
+            # Hung: abandon this worker (its queues go with it, so the
+            # stale result can never be mistaken for a later eval's)
+            # and tell it to exit once the device call finally returns.
+            self._collect_worker = None
+            worker.inq.put(None)
+            raise TimeoutError(
+                f"device collect exceeded deadline "
+                f"({self.device_deadline}s)") from None
+        if not ok:
+            raise val
+        return val
+
+    def _stop_collect_worker(self) -> None:
+        worker = self._collect_worker
+        if worker is not None:
+            self._collect_worker = None
+            worker.inq.put(None)
+
+    def _host_rerun(self, it: _Item) -> tuple:
+        """Re-run one eval's placement on the host twin kernels."""
+        handles = it.sched.dispatch_host(it.args)
+        return it.sched.collect_device(it.args, handles)

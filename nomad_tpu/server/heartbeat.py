@@ -13,8 +13,9 @@ from __future__ import annotations
 import logging
 import random
 import threading
-from typing import Optional
+from typing import Callable, Optional
 
+from nomad_tpu import faultinject
 from nomad_tpu.structs import NODE_STATUS_DOWN
 
 logger = logging.getLogger("nomad_tpu.server.heartbeat")
@@ -25,19 +26,30 @@ HEARTBEAT_GRACE = 10.0
 FAILOVER_HEARTBEAT_TTL = 300.0
 
 
+def _real_timer(ttl: float, fn: Callable, args: list):
+    timer = threading.Timer(ttl, fn, args)
+    timer.daemon = True
+    return timer
+
+
 class HeartbeatManager:
     def __init__(self, server,
                  min_ttl: float = MIN_HEARTBEAT_TTL,
                  max_rate: float = MAX_HEARTBEATS_PER_SECOND,
                  grace: float = HEARTBEAT_GRACE,
-                 failover_ttl: float = FAILOVER_HEARTBEAT_TTL) -> None:
+                 failover_ttl: float = FAILOVER_HEARTBEAT_TTL,
+                 timer_factory: Optional[Callable] = None) -> None:
         self.server = server
         self.min_ttl = min_ttl
         self.max_rate = max_rate
         self.grace = grace
         self.failover_ttl = failover_ttl
+        # Seam for fake clocks: tests pass a factory returning inert
+        # timer objects (.start()/.cancel()) and fire expiries by hand
+        # instead of waiting out real threading.Timer TTLs.
+        self._timer_factory = timer_factory or _real_timer
         self._lock = threading.Lock()
-        self._timers: dict = {}  # node id -> threading.Timer
+        self._timers: dict = {}  # node id -> timer (factory-made)
 
     def initialize(self) -> None:
         """On leadership gain: re-arm every known node at the failover TTL
@@ -60,6 +72,11 @@ class HeartbeatManager:
     def reset_heartbeat_timer(self, node_id: str) -> float:
         """Reset a node's TTL; returns the TTL the client should wait
         (heartbeat.go:37-72)."""
+        if faultinject.ACTIVE:
+            # A dropped delivery = the heartbeat never reached the
+            # leader: the TTL timer keeps running toward expiry and the
+            # client sees a transport error on its call.
+            faultinject.fire("heartbeat.deliver", node=node_id)
         with self._lock:
             n = max(len(self._timers), 1)
             ttl = max(n / self.max_rate, self.min_ttl)
@@ -72,8 +89,7 @@ class HeartbeatManager:
             old = self._timers.get(node_id)
             if old is not None:
                 old.cancel()
-            timer = threading.Timer(ttl, self._invalidate, [node_id])
-            timer.daemon = True
+            timer = self._timer_factory(ttl, self._invalidate, [node_id])
             self._timers[node_id] = timer
             timer.start()
 

@@ -19,6 +19,8 @@ from typing import Optional
 
 import msgpack
 
+from nomad_tpu import faultinject
+
 logger = logging.getLogger("nomad_tpu.server.raft")
 
 
@@ -265,6 +267,10 @@ class InmemRaft:
             return self._applied
 
     def apply(self, entry: bytes) -> ApplyFuture:
+        if faultinject.ACTIVE:
+            # Before any state moves: an injected failure here is an
+            # entry that never entered the log (callers retry/raise).
+            faultinject.fire("raft.apply")
         future = ApplyFuture()
         with self._lock:
             index = self._applied + 1

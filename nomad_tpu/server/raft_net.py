@@ -33,6 +33,7 @@ from typing import Callable, Optional
 
 import msgpack
 
+from nomad_tpu import faultinject
 from nomad_tpu.structs import codec
 
 from .raft import (
@@ -55,7 +56,13 @@ NOOP_ENTRY = codec.encode(codec.IGNORE_UNKNOWN_TYPE_FLAG | 127, {})
 
 
 class _PeerReplicator:
-    """One long-lived thread replicating the leader's log to one peer."""
+    """One long-lived thread replicating the leader's log to one peer.
+
+    A reachable peer is driven at the heartbeat interval; a dead one is
+    backed off (jittered exponential, capped well under the failover
+    TTL) so a partitioned follower doesn't cost the leader a hot
+    dial-fail loop per heartbeat tick.  Any successful exchange — or a
+    fresh ``wake`` from an apply — snaps the cadence back."""
 
     def __init__(self, raft: "NetRaft", peer: tuple) -> None:
         self.raft = raft
@@ -68,18 +75,31 @@ class _PeerReplicator:
         self.thread.start()
 
     def run(self) -> None:
+        from nomad_tpu.utils.retry import Backoff
+
+        backoff = Backoff(base=self.raft.heartbeat_interval,
+                          max_delay=2.0, jitter=0.5)
+        wait = self.raft.heartbeat_interval
         while not self.stop.is_set():
-            self.wake.wait(self.raft.heartbeat_interval)
+            self.wake.wait(wait)
             self.wake.clear()
             if self.stop.is_set():
                 return
             if not self.raft.is_leader():
+                backoff.reset()
+                wait = self.raft.heartbeat_interval
                 continue
+            ok = False
             try:
-                self.raft._append_to_peer(self.peer)
+                ok = self.raft._append_to_peer(self.peer)
             except Exception:
                 logger.debug("replication to %s failed", self.peer,
                              exc_info=True)
+            if ok:
+                backoff.reset()
+                wait = self.raft.heartbeat_interval
+            else:
+                wait = backoff.next()
 
 
 class NetRaft:
@@ -273,6 +293,8 @@ class NetRaft:
             self._log_store.close()
 
     def apply(self, entry: bytes) -> ApplyFuture:
+        if faultinject.ACTIVE:
+            faultinject.fire("raft.apply")
         future = ApplyFuture()
         with self._lock:
             if self._state != LEADER:
@@ -449,10 +471,14 @@ class NetRaft:
             self._futures.clear()
 
     # -- replication (called from one _PeerReplicator thread per peer) -----
-    def _append_to_peer(self, peer: tuple) -> None:
+    def _append_to_peer(self, peer: tuple) -> bool:
+        """One replication exchange.  Returns False only when the peer
+        could not be reached (its replicator backs off); bookkeeping
+        outcomes — stepped down, stale term, rejected append — still
+        count as contact."""
         with self._lock:
             if self._state != LEADER:
-                return
+                return True
             term = self._term
             next_idx = self._next_index.get(peer, self._last_index() + 1)
             if next_idx <= self._log_base_index:
@@ -471,7 +497,7 @@ class NetRaft:
                 prev_index = next_idx - 1
                 prev_term = self._term_at(prev_index)
                 if prev_term is None:
-                    return
+                    return True
                 entries = [e for e in self._log if e["index"] >= next_idx]
                 args = {"term": term, "leader": list(self.address),
                         "prev_log_index": prev_index,
@@ -485,18 +511,18 @@ class NetRaft:
                 "Raft.AppendEntries"
             resp = self.pool.call(peer, method, args, timeout=1.0)
         except Exception:
-            return
+            return False
 
         with self._lock:
             if resp["term"] > self._term:
                 self._step_down(resp["term"])
-                return
+                return True
             if self._state != LEADER or self._term != term:
-                return
+                return True
             if install:
                 self._next_index[peer] = args["last_included_index"] + 1
                 self._match_index[peer] = args["last_included_index"]
-                return
+                return True
             if resp.get("success"):
                 if args["entries"]:
                     last = args["entries"][-1]["index"]
@@ -507,6 +533,7 @@ class NetRaft:
                 hint = resp.get("conflict_index")
                 self._next_index[peer] = max(
                     1, hint if hint else self._next_index.get(peer, 2) - 1)
+        return True
 
     def _advance_commit(self) -> None:
         # Caller holds the lock.

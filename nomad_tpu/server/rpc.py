@@ -30,6 +30,8 @@ from typing import Callable, Optional
 
 import msgpack
 
+from nomad_tpu import faultinject
+
 logger = logging.getLogger("nomad_tpu.server.rpc")
 
 RPC_NOMAD = 0x01
@@ -235,6 +237,18 @@ class RPCServer:
                 return
             seq = req.get("seq", 0)
             method = req.get("method", "")
+            if faultinject.ACTIVE:
+                try:
+                    faultinject.fire_rpc("rpc.recv", method,
+                                         req.get("args") or {})
+                except faultinject.FaultDropped:
+                    # Injected lost frame: no reply at all — the caller
+                    # sees only its own timeout, like wire loss.
+                    continue
+                except Exception as e:
+                    send_frame(sock, {"seq": seq, "error": str(e),
+                                      "result": None})
+                    continue
             handler = self._handlers.get(method)
             if handler is None:
                 send_frame(sock, {"seq": seq,
@@ -265,6 +279,22 @@ class RPCServer:
             try:
                 seq = req.get("seq", 0)
                 method = req.get("method", "")
+                if faultinject.ACTIVE:
+                    try:
+                        faultinject.fire_rpc("rpc.recv", method,
+                                             req.get("args") or {})
+                    except faultinject.FaultDropped:
+                        return  # injected lost frame: no reply (finally
+                        # still releases the in-flight gate)
+                    except Exception as e:
+                        resp = {"seq": seq, "error": str(e),
+                                "result": None}
+                        try:
+                            with wlock:
+                                send_frame(sock, resp)
+                        except (ConnectionError, OSError):
+                            pass
+                        return
                 handler = self._handlers.get(method)
                 if handler is None:
                     resp = {"seq": seq,
@@ -492,6 +522,11 @@ class ConnPool:
 
     def call(self, address: tuple, method: str, args: dict,
              timeout: Optional[float] = None):
+        if faultinject.ACTIVE:
+            # The send chokepoint: an injected drop/error here is a
+            # request that never leaves this host — transport-shaped,
+            # so callers' retry policies treat it like a dead socket.
+            faultinject.fire_rpc("rpc.send", method, args)
         address = (address[0], address[1])
         if self.multiplex:
             return self._call_mux(address, method, args, timeout)

@@ -21,13 +21,14 @@ from typing import Optional
 
 from nomad_tpu.scheduler import new_scheduler
 from nomad_tpu.utils.metrics import metrics
+from nomad_tpu.utils.retry import Backoff
 from nomad_tpu.structs import Evaluation, Plan, PlanResult, codec
 
 logger = logging.getLogger("nomad_tpu.server.worker")
 
 RAFT_SYNC_LIMIT = 5.0  # reference worker.go:34-37
 BACKOFF_BASE = 0.05
-BACKOFF_LIMIT = 3.0
+BACKOFF_LIMIT = 1.0    # dequeue supervision cap: stay leadership-responsive
 PLAN_WAIT_POLL = 2.0   # liveness probe interval while awaiting a plan
 
 
@@ -71,6 +72,11 @@ class Worker:
 
     # -- main loop --------------------------------------------------------
     def run(self) -> None:
+        # Jittered growth while the broker is disabled (follower /
+        # leadership transition) so N workers don't poll in lockstep;
+        # reset the moment a dequeue succeeds (utils/retry.py).
+        backoff = Backoff(base=BACKOFF_BASE, max_delay=BACKOFF_LIMIT,
+                          jitter=0.5)
         while not self._stop.is_set():
             self._check_paused()
             queues = self.queues or self.server.enabled_schedulers()
@@ -78,8 +84,10 @@ class Worker:
                 ev, token = self.server.eval_broker.dequeue(
                     queues, timeout=0.25)
             except RuntimeError:
-                time.sleep(BACKOFF_BASE)
+                if backoff.sleep(self._stop):
+                    return
                 continue
+            backoff.reset()
             if ev is None:
                 continue
             self.eval_token = token
@@ -169,6 +177,8 @@ class BatchWorker(Worker):
     def run(self) -> None:
         from nomad_tpu.scheduler.batch import BatchEvalRunner
 
+        backoff = Backoff(base=BACKOFF_BASE, max_delay=BACKOFF_LIMIT,
+                          jitter=0.5)
         while not self._stop.is_set():
             self._check_paused()
             queues = [q for q in self.server.enabled_schedulers()
@@ -178,8 +188,10 @@ class BatchWorker(Worker):
                     queues, self.max_batch,
                     timeout=0.25)
             except RuntimeError:
-                time.sleep(BACKOFF_BASE)
+                if backoff.sleep(self._stop):
+                    return
                 continue
+            backoff.reset()
             if not batch:
                 continue
             max_index = max(ev.modify_index for ev, _ in batch)

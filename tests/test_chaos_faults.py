@@ -1,0 +1,273 @@
+"""Chaos soak under a seeded FaultPlan (slow tier).
+
+One seeded plan drives three failure families at once and the system
+must converge anyway:
+
+  - heartbeat loss: three victim nodes' heartbeats are dropped at the
+    delivery site, so the REAL TTL-expiry path marks them down and the
+    resulting node-update evals reschedule their work;
+  - RPC drops: Job.Register frames are dropped on both the send and
+    receive planes mid-storm; submission rides the unified retry
+    policy, exactly as a production client would;
+  - device faults: the pipelined runner takes dispatch errors and a
+    hung collect, re-runs the affected evals on the host twin, and the
+    circuit breaker must record full open -> half-open(probe, parity
+    asserted) -> closed cycles.
+
+Convergence bar (ISSUE acceptance): every submitted job fully placed
+exactly once on live capacity, no eval left non-terminal, breaker
+cycled at least once with host/device parity asserted on probe re-runs.
+"""
+from __future__ import annotations
+
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu import faultinject
+from nomad_tpu.faultinject import FaultDropped, FaultPlan
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.server.rpc import ConnPool
+from nomad_tpu.structs import (
+    NODE_STATUS_DOWN,
+    NODE_STATUS_READY,
+    Task,
+    TaskGroup,
+    Resources,
+    allocs_fit,
+)
+from nomad_tpu.utils.retry import RetryPolicy
+
+pytestmark = pytest.mark.slow
+
+TERMINAL = ("complete", "failed", "canceled")
+
+SUBMIT_POLICY = RetryPolicy(
+    base=0.2, max_delay=1.0, max_attempts=8,
+    retryable=lambda e: isinstance(e, Exception),
+    name="chaos.submit")
+
+
+def _job(n_groups: int, count: int):
+    job = mock.job()
+    job.task_groups = [
+        TaskGroup(name=f"tg-{g}", count=count,
+                  tasks=[Task(name="web", driver="exec",
+                              resources=Resources(cpu=200,
+                                                  memory_mb=64))])
+        for g in range(n_groups)]
+    return job
+
+
+def test_chaos_soak_with_seeded_fault_plan():
+    plan = FaultPlan.parse(
+        "seed=2026;"
+        # Lost job-submission frames, both planes, mid-storm.
+        "rpc.send=drop(p=0.5,count=4,method=Job.Register);"
+        "rpc.recv=drop(p=0.5,count=4,method=Job.Register);"
+        # Raft latency chaos (never fails, just jitters commit timing).
+        "raft.apply=delay(secs=0.005,p=0.2,count=40);"
+        # Victim nodes lose every heartbeat delivery after the three
+        # registration-time arms (registration precedes the heartbeat
+        # loop, so the skip budget lands deterministically).
+        "heartbeat.deliver=drop(node=chaos-victim-*,after=3);"
+        # Device faults for the pipelined-runner phase.
+        "device.dispatch=error(count=1);"
+        "device.collect=hang(secs=1.0,count=1)")
+
+    with faultinject.injected(plan):
+        _server_phase(plan)
+        _device_phase(plan)
+
+
+def _server_phase(plan: FaultPlan) -> None:
+    """Job storm over real RPC with lost frames + heartbeat-loss-driven
+    reschedules; must converge to exactly-once placement."""
+    srv = Server(ServerConfig(num_schedulers=4, enable_rpc=True))
+    srv.heartbeats.min_ttl = 0.5
+    srv.heartbeats.grace = 0.3
+    srv.establish_leadership()
+    pool = ConnPool()
+    try:
+        addr = srv.rpc_address()
+
+        n_nodes, n_victims = 24, 3
+        victims, survivors = [], []
+        for i in range(n_nodes):
+            node = mock.node(i)
+            if i < n_victims:
+                # The heartbeat.deliver rule matches this id prefix.
+                node.id = f"chaos-victim-{node.id}"
+            out = SUBMIT_POLICY.call(
+                lambda n=node: pool.call(addr, "Node.Register",
+                                         {"node": n.to_dict()}))
+            assert out["heartbeat_ttl"] > 0
+            (victims if i < n_victims else survivors).append(node.id)
+
+        # Background heartbeater for the WHOLE phase: survivors stay
+        # alive through the multi-second submission stalls the RPC
+        # drops cause; victims' deliveries are dropped by the plan, so
+        # their TTLs expire for real while everything else churns.
+        import threading
+
+        stop_beat = threading.Event()
+
+        def _beater() -> None:
+            while not stop_beat.is_set():
+                for nid in survivors + victims:
+                    try:
+                        pool.call(addr, "Node.Heartbeat",
+                                  {"node_id": nid}, timeout=2.0)
+                    except Exception:
+                        pass  # victims: delivery dropped — the point
+                stop_beat.wait(0.15)
+
+        beater = threading.Thread(target=_beater, daemon=True,
+                                  name="chaos-heartbeater")
+        beater.start()
+
+        jobs = []
+        for _ in range(10):
+            job = _job(n_groups=6, count=2)
+            # The retry policy carries the submission through injected
+            # send/recv drops; a duplicate register (timeout after the
+            # server processed it) is converged by the scheduler.
+            SUBMIT_POLICY.call(
+                lambda j=job: pool.call(addr, "Job.Register",
+                                        {"job": j.to_dict()},
+                                        timeout=2.0))
+            jobs.append(job)
+        assert plan.fire_count("rpc.send") + \
+            plan.fire_count("rpc.recv") > 0, "no RPC chaos was injected"
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            state = srv.fsm.state
+            evals = state.evals()
+            victims_down = all(
+                state.node_by_id(nid).status == NODE_STATUS_DOWN
+                for nid in victims)
+            if evals and victims_down and \
+                    all(e.status in TERMINAL for e in evals) and \
+                    len(evals) >= len(jobs):
+                # Quiesced once; re-check after a beat in case expiry
+                # evals were still being written.
+                time.sleep(0.3)
+                evals = srv.fsm.state.evals()
+                if all(e.status in TERMINAL for e in evals):
+                    break
+            time.sleep(0.1)
+
+        stop_beat.set()
+        beater.join(5.0)
+        state = srv.fsm.state
+
+        # 1) No eval left non-terminal.
+        stuck = [(e.id, e.status) for e in state.evals()
+                 if e.status not in TERMINAL]
+        assert not stuck, f"non-terminal evals after soak: {stuck[:5]}"
+
+        # 2) Victims expired through the real TTL path; survivors ready.
+        for nid in victims:
+            assert state.node_by_id(nid).status == NODE_STATUS_DOWN, nid
+        for nid in survivors:
+            assert state.node_by_id(nid).status == NODE_STATUS_READY, nid
+        assert plan.fire_count("heartbeat.deliver") >= n_victims
+
+        # 3) Every job fully placed exactly once, on live nodes only.
+        victim_set = set(victims)
+        for job in jobs:
+            live = [a for a in state.allocs_by_job(job.id)
+                    if not a.terminal_status()]
+            want = sum(tg.count for tg in job.task_groups)
+            assert len(live) == want, \
+                f"job {job.id}: {len(live)} live allocs, want {want}"
+            by_group: dict = {}
+            for a in live:
+                by_group[a.task_group] = by_group.get(a.task_group, 0) + 1
+                assert a.node_id not in victim_set, \
+                    "placement left on a down node"
+            assert all(by_group[tg.name] == tg.count
+                       for tg in job.task_groups), "duplicate placement"
+
+        # 4) No oversubscription anywhere.
+        for nid in survivors:
+            node = state.node_by_id(nid)
+            live = [a for a in state.allocs_by_node(nid)
+                    if not a.terminal_status()]
+            fit, dim, _ = allocs_fit(node, live)
+            assert fit, f"node {nid} oversubscribed on {dim}"
+    finally:
+        pool.shutdown()
+        srv.shutdown()
+
+
+def _device_phase(plan: FaultPlan) -> None:
+    """Pipelined-runner stream under device faults: the breaker must
+    complete open -> half-open -> closed cycles with parity asserted,
+    and every eval must still complete."""
+    from nomad_tpu.scheduler import Harness
+    from nomad_tpu.scheduler.breaker import (CLOSED, OPEN,
+                                             DeviceCircuitBreaker)
+    from nomad_tpu.scheduler.executor import executor_override
+    from nomad_tpu.scheduler.pipeline import PipelinedEvalRunner
+    from nomad_tpu.structs import (EVAL_TRIGGER_JOB_REGISTER, Evaluation,
+                                   generate_uuid)
+
+    h = Harness()
+    for i in range(12):
+        h.state.upsert_node(h.next_index(), mock.node(100 + i))
+    jobs = []
+    for _ in range(6):
+        j = mock.job()
+        h.state.upsert_job(h.next_index(), j)
+        jobs.append(j)
+
+    def ev(job):
+        return Evaluation(id=generate_uuid(), priority=job.priority,
+                          type=job.type,
+                          triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+                          job_id=job.id)
+
+    breaker = DeviceCircuitBreaker(failure_threshold=1, cooldown=0.05)
+    reruns = parity = 0
+    with executor_override("device"):
+        # One eval per runner call so each breaker transition is
+        # observable; the breaker itself persists across runners.
+        for i, job in enumerate(jobs):
+            runner = PipelinedEvalRunner(
+                h.state.snapshot(), h, depth=2, breaker=breaker,
+                device_deadline=0.25,
+                state_refresh=lambda: h.state.snapshot())
+            runner.process([ev(job)])
+            reruns += runner.breaker_reruns
+            parity += runner.parity_checks
+            if breaker.state == OPEN:
+                time.sleep(0.06)  # let the cooldown elapse -> probe next
+
+    stats = breaker.stats()
+    # Both fault families tripped it (the hung collect landed on the
+    # first probe itself, re-opening it), and at least one full
+    # open -> half-open -> closed cycle completed with parity asserted
+    # on the probe re-run.
+    assert stats["opens"] >= 2, stats
+    assert stats["probes"] >= 2, stats
+    assert stats["closes"] >= 1, stats
+    assert breaker.state == CLOSED, stats
+    assert reruns >= 2
+    assert parity >= 1
+    assert plan.fire_count("device.dispatch") == 1
+    assert plan.fire_count("device.collect") == 1
+
+    # Every eval completed and the resulting placements are sane.
+    assert all(e.status == "complete" for e in h.evals)
+    assert len(h.plans) == len(jobs)
+    nodes = {n.id: n for n in h.state.nodes()}
+    for p in h.plans:
+        for node_id, allocs in p.node_allocation.items():
+            fit, dim, _ = allocs_fit(nodes[node_id], allocs)
+            assert fit, dim
+    total = sum(len(v) for p in h.plans
+                for v in p.node_allocation.values())
+    assert total == sum(tg.count for j in jobs for tg in j.task_groups)

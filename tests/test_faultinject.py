@@ -1,0 +1,713 @@
+"""Fault-injection subsystem: spec grammar, registry lifecycle, and one
+fast unit test per instrumented site (rpc.send, rpc.recv, raft.apply,
+heartbeat.deliver, device.dispatch, device.collect, driver.start), plus
+the device-executor circuit breaker's state machine and the client
+retry regressions the subsystem was built to catch.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu import faultinject
+from nomad_tpu.faultinject import (
+    FaultDropped,
+    FaultInjected,
+    FaultPlan,
+    FaultSpecError,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    """Every test here starts and ends with no active plan."""
+    faultinject.clear_plan()
+    yield
+    faultinject.clear_plan()
+
+
+# ---------------------------------------------------------------------------
+# spec grammar + registry lifecycle
+# ---------------------------------------------------------------------------
+
+class TestSpecAndRegistry:
+    def test_trivial_plan_injects_and_clears(self):
+        """Tier-1 smoke: install -> fire -> clear is airtight."""
+        assert not faultinject.ACTIVE
+        faultinject.fire("raft.apply")  # no plan: no-op
+        plan = FaultPlan().add("raft.apply", "error", count=1)
+        faultinject.install_plan(plan)
+        assert faultinject.ACTIVE
+        with pytest.raises(FaultInjected):
+            faultinject.fire("raft.apply")
+        faultinject.fire("raft.apply")  # budget spent: no-op
+        assert plan.exhausted()
+        faultinject.clear_plan()
+        assert not faultinject.ACTIVE
+        assert faultinject.active_plan() is None
+        faultinject.fire("raft.apply")  # cleared: no-op again
+        assert plan.fire_count() == 1
+
+    def test_injected_context_restores_previous(self):
+        outer = FaultPlan()
+        faultinject.install_plan(outer)
+        with faultinject.injected(FaultPlan()) as inner:
+            assert faultinject.active_plan() is inner
+        assert faultinject.active_plan() is outer
+
+    def test_injected_context_clears_on_exception(self):
+        with pytest.raises(RuntimeError):
+            with faultinject.injected(FaultPlan()):
+                raise RuntimeError("test failure mid-soak")
+        assert not faultinject.ACTIVE
+
+    def test_parse_full_grammar(self):
+        plan = FaultPlan.parse(
+            "seed=7;"
+            "rpc.send=drop(p=0.5,count=3,method=Node.*);"
+            "heartbeat.deliver=drop(node=n-1);"
+            "device.collect=hang(secs=0.01);"
+            "raft.apply=delay(secs=0.02,after=2)")
+        assert plan.seed == 7
+        rules = {r.site: r for r in plan.rules()}
+        assert rules["rpc.send"].action == "drop"
+        assert rules["rpc.send"].p == 0.5
+        assert rules["rpc.send"].count == 3
+        assert rules["rpc.send"].method == "Node.*"
+        assert rules["heartbeat.deliver"].node == "n-1"
+        assert rules["device.collect"].secs == 0.01
+        assert rules["raft.apply"].after == 2
+
+    @pytest.mark.parametrize("bad", [
+        "nope.site=error",               # unknown site
+        "rpc.send=explode",              # unknown action
+        "rpc.send=error(p=oops)",        # bad float
+        "rpc.send=error(count=1.5)",     # bad int
+        "rpc.send=error(zap=1)",         # unknown param
+        "rpc.send",                      # missing '='
+        "seed=abc",                      # bad seed
+        "rpc.send=error(p=0.5",          # unterminated params
+        "rpc.send=error(p=2)",           # probability out of range
+        "raft.apply=error(method=X)",    # site supplies no method ctx
+        "device.collect=error(node=n)",  # site supplies no node ctx
+        "heartbeat.deliver=drop(method=Node.Heartbeat)",  # node-only site
+    ])
+    def test_parse_rejects_malformed(self, bad):
+        with pytest.raises(FaultSpecError):
+            FaultPlan.parse(bad)
+
+    def test_node_predicate_matches_alloc_update_payload(self):
+        """fire_rpc digs the node id out of Node.UpdateAlloc's nested
+        update dicts, so node-targeted rules cover that traffic too
+        (a predicate that can never fire is rejected at parse; one
+        that CAN fire must actually see the id)."""
+        plan = FaultPlan().add("rpc.send", "error", node="n-7")
+        with faultinject.injected(plan):
+            faultinject.fire_rpc("rpc.send", "Node.UpdateAlloc",
+                                 {"alloc": [{"id": "a", "node_id": "x"}]})
+            with pytest.raises(FaultInjected):
+                faultinject.fire_rpc(
+                    "rpc.send", "Node.UpdateAlloc",
+                    {"alloc": [{"id": "a", "node_id": "n-7"}]})
+
+    def test_seeded_probability_is_deterministic(self):
+        def run():
+            out = []
+            with faultinject.injected(
+                    FaultPlan.parse("seed=11;rpc.send=drop(p=0.5)")):
+                for _ in range(32):
+                    try:
+                        faultinject.fire("rpc.send")
+                        out.append(0)
+                    except FaultDropped:
+                        out.append(1)
+            return out
+
+        first = run()
+        assert first == run()
+        assert 0 < sum(first) < 32  # actually probabilistic
+
+    def test_match_predicates_and_after(self):
+        plan = FaultPlan()
+        plan.add("rpc.send", "error", method="Node.Register",
+                 node="n-*", after=1)
+        with faultinject.injected(plan):
+            # Wrong method / wrong node / first match skipped.
+            faultinject.fire("rpc.send", method="Job.Register", node="n-1")
+            faultinject.fire("rpc.send", method="Node.Register", node="x")
+            faultinject.fire("rpc.send", method="Node.Register", node="n-1")
+            with pytest.raises(FaultInjected):
+                faultinject.fire("rpc.send", method="Node.Register",
+                                 node="n-2")
+
+
+# ---------------------------------------------------------------------------
+# per-site units
+# ---------------------------------------------------------------------------
+
+class TestSites:
+    def test_rpc_send_site(self):
+        """ConnPool.call consults rpc.send before anything touches the
+        wire — no server needed to prove the drop."""
+        from nomad_tpu.server.rpc import ConnPool
+
+        pool = ConnPool()
+        plan = FaultPlan().add("rpc.send", "drop", count=1,
+                               method="Status.Ping")
+        with faultinject.injected(plan):
+            with pytest.raises(FaultDropped):
+                pool.call(("127.0.0.1", 1), "Status.Ping", {})
+        assert plan.fire_count("rpc.send") == 1
+        pool.shutdown()
+
+    def test_rpc_recv_drop_and_error(self):
+        """Server-side receive faults: ``drop`` swallows the request
+        (caller sees only its own timeout), ``error`` surfaces as an
+        RPC error reply."""
+        from nomad_tpu.server.rpc import ConnPool, RPCError, RPCServer
+
+        srv = RPCServer()
+        srv.register("Echo.Hello", lambda args: {"hi": 1})
+        srv.start()
+        pool = ConnPool()
+        try:
+            plan = FaultPlan()
+            plan.add("rpc.recv", "drop", count=1)
+            plan.add("rpc.recv", "error", count=1)
+            with faultinject.injected(plan):
+                with pytest.raises(TimeoutError):
+                    pool.call(srv.address, "Echo.Hello", {}, timeout=0.4)
+                with pytest.raises(RPCError, match="injected"):
+                    pool.call(srv.address, "Echo.Hello", {})
+                # Budget spent: the plane is healthy again.
+                assert pool.call(srv.address, "Echo.Hello", {}) == \
+                    {"hi": 1}
+        finally:
+            pool.shutdown()
+            srv.shutdown()
+
+    def test_rpc_recv_drop_on_plain_plane(self):
+        """The non-mux (0x01) plane swallows dropped frames too."""
+        from nomad_tpu.server.rpc import ConnPool, RPCServer
+
+        srv = RPCServer()
+        srv.register("Echo.Hello", lambda args: {"hi": 1})
+        srv.start()
+        pool = ConnPool(multiplex=False)
+        try:
+            with faultinject.injected(
+                    FaultPlan().add("rpc.recv", "drop", count=1)):
+                with pytest.raises((TimeoutError, OSError)):
+                    pool.call(srv.address, "Echo.Hello", {}, timeout=0.4)
+            assert pool.call(srv.address, "Echo.Hello", {}) == {"hi": 1}
+        finally:
+            pool.shutdown()
+            srv.shutdown()
+
+    def test_raft_apply_site(self):
+        from nomad_tpu.server.raft import InmemRaft
+
+        class _FSM:
+            def apply(self, index, entry):
+                return None
+
+        raft = InmemRaft(_FSM())
+        with faultinject.injected(
+                FaultPlan().add("raft.apply", "error", count=1)):
+            with pytest.raises(FaultInjected):
+                raft.apply(b"entry")
+            # Budget spent: the log moves again.
+            raft.apply(b"entry").wait(1.0)
+        assert raft.applied_index() == 1
+
+    def test_heartbeat_deliver_site(self):
+        """A dropped delivery leaves the TTL timer un-reset: the node
+        is on the path to expiry while the client sees an error."""
+        from nomad_tpu.server.heartbeat import HeartbeatManager
+
+        hb = HeartbeatManager(server=None, timer_factory=_FakeTimer)
+        try:
+            plan = FaultPlan().add("heartbeat.deliver", "drop",
+                                   node="n-victim")
+            with faultinject.injected(plan):
+                assert hb.reset_heartbeat_timer("n-ok") > 0
+                with pytest.raises(FaultDropped):
+                    hb.reset_heartbeat_timer("n-victim")
+            with hb._lock:
+                assert "n-ok" in hb._timers
+                assert "n-victim" not in hb._timers
+        finally:
+            hb.clear()
+
+    def test_driver_start_site(self, tmp_path):
+        from nomad_tpu.client.allocdir import AllocDir
+        from nomad_tpu.client.driver.base import ExecContext
+        from nomad_tpu.client.task_runner import TaskRunner
+        from nomad_tpu.structs import Resources, Task
+
+        task = Task(name="echo", driver="raw_exec",
+                    config={"command": "/bin/sh",
+                            "args": "-c 'echo hi'"},
+                    resources=Resources(cpu=100, memory_mb=64))
+        ad = AllocDir(str(tmp_path / "alloc"))
+        ad.build([task])
+        states = []
+        tr = TaskRunner(ExecContext(ad, "a"), task,
+                        on_state=lambda n, s, d: states.append((s, d)))
+        with faultinject.injected(
+                FaultPlan().add("driver.start", "error",
+                                method="raw_exec")):
+            tr.run()  # inline: deterministic, no thread needed
+        assert tr.failed
+        assert tr.state == "dead"
+        assert any("injected" in d for _s, d in states)
+
+
+def _FakeTimer(ttl, fn, args):
+    """Inert timer for fake-clock heartbeat tests."""
+    class _T:
+        def __init__(self):
+            self.ttl = ttl
+            self.fn = fn
+            self.args = args
+            self.cancelled = False
+
+        def start(self):
+            pass
+
+        def cancel(self):
+            self.cancelled = True
+
+        def fire(self):
+            self.fn(*self.args)
+    return _T()
+
+
+# ---------------------------------------------------------------------------
+# device sites + circuit breaker through the pipeline
+# ---------------------------------------------------------------------------
+
+def _pipeline_cluster(n_nodes: int, n_jobs: int):
+    from nomad_tpu.scheduler import Harness
+
+    h = Harness()
+    for i in range(n_nodes):
+        h.state.upsert_node(h.next_index(), mock.node(i))
+    jobs = []
+    for _ in range(n_jobs):
+        j = mock.job()
+        h.state.upsert_job(h.next_index(), j)
+        jobs.append(j)
+    return h, jobs
+
+
+def _make_eval(job):
+    from nomad_tpu.structs import (EVAL_TRIGGER_JOB_REGISTER, Evaluation,
+                                   generate_uuid)
+
+    return Evaluation(id=generate_uuid(), priority=job.priority,
+                      type=job.type,
+                      triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+                      job_id=job.id)
+
+
+class TestDeviceBreaker:
+    def test_dispatch_fault_trips_breaker_then_probe_closes(self):
+        """device.dispatch fault: the eval re-runs on the host twin
+        (still completes), the breaker opens, holds subsequent evals on
+        host, then a half-open probe parity-checks and closes."""
+        from nomad_tpu.scheduler.breaker import (CLOSED, OPEN,
+                                                 DeviceCircuitBreaker)
+        from nomad_tpu.scheduler.executor import executor_override
+        from nomad_tpu.scheduler.pipeline import PipelinedEvalRunner
+
+        h, jobs = _pipeline_cluster(8, 3)
+        breaker = DeviceCircuitBreaker(failure_threshold=1, cooldown=30.0)
+        plan = FaultPlan().add("device.dispatch", "error", count=1)
+        with faultinject.injected(plan), executor_override("device"):
+            # Round 1: first dispatch faults -> open; the window's
+            # remaining evals are held on host.
+            r1 = PipelinedEvalRunner(h.state.snapshot(), h, depth=2,
+                                     breaker=breaker)
+            r1.process([_make_eval(j) for j in jobs[:2]])
+            assert breaker.state == OPEN
+            assert r1.breaker_reruns == 1
+            assert breaker.stats()["opens"] == 1
+            assert breaker.stats()["host_holds"] >= 1
+
+            # Round 2: cooldown elapsed (fake it) -> probe -> parity
+            # asserted -> closed.
+            with breaker._lock:
+                breaker._opened_at = -1e9
+            r2 = PipelinedEvalRunner(h.state.snapshot(), h, depth=2,
+                                     breaker=breaker,
+                                     state_refresh=lambda:
+                                     h.state.snapshot())
+            r2.process([_make_eval(jobs[2])])
+            assert breaker.state == CLOSED
+            assert breaker.stats()["probes"] == 1
+            assert breaker.stats()["closes"] == 1
+            assert r2.parity_checks == 1
+        assert all(e.status == "complete" for e in h.evals)
+        assert len(h.plans) == 3
+
+    def test_collect_fault_reruns_on_host(self):
+        """device.collect fault mid-window: drain re-runs that eval on
+        the host twin; plans still land, breaker records the failure."""
+        import time as _time
+
+        from nomad_tpu.scheduler.breaker import DeviceCircuitBreaker
+        from nomad_tpu.scheduler.executor import executor_override
+        from nomad_tpu.scheduler.pipeline import PipelinedEvalRunner, _Item
+
+        h, jobs = _pipeline_cluster(8, 3)
+        breaker = DeviceCircuitBreaker(failure_threshold=2, cooldown=30.0)
+        runner = PipelinedEvalRunner(h.state.snapshot(), h, depth=8,
+                                     breaker=breaker)
+        plan = FaultPlan().add("device.collect", "error", count=1)
+        with faultinject.injected(plan), executor_override("device"):
+            window = []
+            for j in jobs:
+                start = _time.perf_counter()
+                sched = runner._begin_eval(_make_eval(j),
+                                           finish_noop=False)
+                place, args = sched.deferred
+                handles, probe = runner._dispatch(sched, args)
+                window.append(_Item(sched, place, args, handles, start,
+                                    probe=probe))
+            runner._drain_window(window)
+        assert runner.breaker_reruns == 1
+        assert breaker.stats()["failures"] == 1
+        assert breaker.state == "closed"  # threshold=2, one failure
+        assert all(e.status == "complete" for e in h.evals)
+        assert len(h.plans) == 3
+
+    def test_collect_deadline_breaks_hang(self):
+        """A hung device collect (injected hang) is cut off by the
+        watchdog deadline and re-run on host."""
+        import time as _time
+
+        from nomad_tpu.scheduler.breaker import OPEN, DeviceCircuitBreaker
+        from nomad_tpu.scheduler.executor import executor_override
+        from nomad_tpu.scheduler.pipeline import PipelinedEvalRunner, _Item
+
+        h, jobs = _pipeline_cluster(8, 1)
+        breaker = DeviceCircuitBreaker(failure_threshold=1, cooldown=30.0)
+        runner = PipelinedEvalRunner(h.state.snapshot(), h, depth=2,
+                                     breaker=breaker,
+                                     device_deadline=0.2)
+        plan = FaultPlan().add("device.collect", "hang", secs=1.5,
+                               count=1)
+        t0 = _time.monotonic()
+        with faultinject.injected(plan), executor_override("device"):
+            sched = runner._begin_eval(_make_eval(jobs[0]),
+                                       finish_noop=False)
+            place, args = sched.deferred
+            handles, probe = runner._dispatch(sched, args)
+            runner._drain_window([_Item(sched, place, args, handles,
+                                        _time.perf_counter(),
+                                        probe=probe)])
+        # The watchdog cut the hang off well before its 1.5s.
+        assert _time.monotonic() - t0 < 1.2
+        assert runner.breaker_reruns == 1
+        assert breaker.state == OPEN
+        assert all(e.status == "complete" for e in h.evals)
+
+    def test_breaker_state_machine_with_fake_clock(self):
+        from nomad_tpu.scheduler.breaker import (ADMIT_DEVICE, ADMIT_HOST,
+                                                 ADMIT_PROBE, CLOSED,
+                                                 HALF_OPEN, OPEN,
+                                                 DeviceCircuitBreaker)
+
+        now = [0.0]
+        b = DeviceCircuitBreaker(failure_threshold=2, cooldown=10.0,
+                                 clock=lambda: now[0])
+        assert b.admit() == ADMIT_DEVICE
+        b.record_failure()
+        assert b.state == CLOSED          # below threshold
+        b.record_success()                # success resets the streak
+        b.record_failure()
+        b.record_failure()
+        assert b.state == OPEN            # threshold consecutive
+        assert b.admit() == ADMIT_HOST    # held during cooldown
+        now[0] += 10.0
+        assert b.admit() == ADMIT_PROBE   # cooldown elapsed
+        assert b.state == HALF_OPEN
+        assert b.admit() == ADMIT_HOST    # one probe in flight at a time
+        b.record_failure(probe=True)      # probe failed: re-open
+        assert b.state == OPEN
+        now[0] += 10.0
+        assert b.admit() == ADMIT_PROBE
+        b.record_success(probe=True)
+        assert b.state == CLOSED
+        stats = b.stats()
+        assert stats["opens"] == 2 and stats["closes"] == 1
+        assert stats["probes"] == 2 and stats["host_holds"] == 2
+
+    def test_lost_probe_outcome_reprobes_after_timeout(self):
+        """Review regression: a probe whose outcome is never recorded
+        (its window was discarded by an unrelated drain error) must not
+        pin the breaker half-open-on-host forever — past probe_timeout
+        a fresh probe is issued."""
+        from nomad_tpu.scheduler.breaker import (ADMIT_HOST, ADMIT_PROBE,
+                                                 CLOSED,
+                                                 DeviceCircuitBreaker)
+
+        now = [0.0]
+        b = DeviceCircuitBreaker(failure_threshold=1, cooldown=1.0,
+                                 probe_timeout=5.0,
+                                 clock=lambda: now[0])
+        b.record_failure()           # open
+        now[0] += 1.0
+        assert b.admit() == ADMIT_PROBE
+        # ... the probe item is lost: no outcome ever recorded ...
+        now[0] += 4.0
+        assert b.admit() == ADMIT_HOST    # not yet presumed lost
+        now[0] += 1.5
+        assert b.admit() == ADMIT_PROBE   # presumed lost: re-probe
+        b.record_success(probe=True)
+        assert b.state == CLOSED
+
+    def test_probe_parity_mismatch_fails_loudly_and_reopens(self):
+        """Review regression: a probe whose device result disagrees
+        with the host twin must raise (not silently close the breaker)
+        and re-open it."""
+        import numpy as np
+
+        from nomad_tpu.scheduler.breaker import OPEN, DeviceCircuitBreaker
+        from nomad_tpu.scheduler.executor import executor_override
+        from nomad_tpu.scheduler.pipeline import PipelinedEvalRunner
+
+        h, jobs = _pipeline_cluster(8, 1)
+        breaker = DeviceCircuitBreaker(failure_threshold=1, cooldown=0.0)
+        breaker.record_failure()  # open; next admission is a probe
+
+        class _CorruptHostTwin(PipelinedEvalRunner):
+            def _host_rerun(self, it):
+                chosen, scores = super()._host_rerun(it)
+                return np.asarray(chosen) + 1, scores  # disagree
+
+        runner = _CorruptHostTwin(h.state.snapshot(), h, depth=2,
+                                  breaker=breaker)
+        with executor_override("device"):
+            with pytest.raises(RuntimeError, match="parity violation"):
+                runner.process([_make_eval(jobs[0])])
+        assert breaker.state == OPEN  # probe failure re-opened it
+        assert runner.parity_checks == 0
+
+    def test_pipeline_unaffected_without_faults(self):
+        """No plan, forced device: the breaker stays closed and counts
+        stay clean (the parity suite guards semantics; this guards the
+        new plumbing's no-fault path)."""
+        from nomad_tpu.scheduler.breaker import DeviceCircuitBreaker
+        from nomad_tpu.scheduler.executor import executor_override
+        from nomad_tpu.scheduler.pipeline import PipelinedEvalRunner
+
+        h, jobs = _pipeline_cluster(8, 3)
+        breaker = DeviceCircuitBreaker()
+        runner = PipelinedEvalRunner(h.state.snapshot(), h, depth=2,
+                                     breaker=breaker)
+        with executor_override("device"):
+            runner.process([_make_eval(j) for j in jobs])
+        assert breaker.state == "closed"
+        assert breaker.stats() == {"opens": 0, "closes": 0, "probes": 0,
+                                   "host_holds": 0, "failures": 0,
+                                   "state": "closed"}
+        assert runner.breaker_reruns == 0
+        assert runner.device_dispatches == len(jobs)
+        assert runner.host_dispatches == 0
+        assert all(e.status == "complete" for e in h.evals)
+
+
+# ---------------------------------------------------------------------------
+# client retry regressions (the satellites)
+# ---------------------------------------------------------------------------
+
+class _ScriptedRPC:
+    """In-proc rpc_handler whose UpdateAlloc failures are scripted."""
+
+    def __init__(self, fail_updates: int = 0) -> None:
+        self.fail_updates = fail_updates
+        self.update_payloads: list = []
+        self.lock = threading.Lock()
+
+    def call(self, method: str, args: dict, timeout=None):
+        if method == "Node.UpdateAlloc":
+            with self.lock:
+                if self.fail_updates > 0:
+                    self.fail_updates -= 1
+                    raise ConnectionError("scripted outage")
+                self.update_payloads.append(args["alloc"])
+            return {}
+        return {"heartbeat_ttl": 10.0}
+
+
+def _make_client(rpc_handler):
+    from nomad_tpu.client import Client, ClientConfig
+
+    return Client(ClientConfig(
+        rpc_handler=rpc_handler,
+        options={"fingerprint.skip_accel": "1"}))
+
+
+def _alloc_update(alloc_id: str, status: str):
+    from nomad_tpu.structs import Allocation
+
+    return Allocation(id=alloc_id, client_status=status,
+                      node_id="n-1", task_states={})
+
+
+class TestClientRetries:
+    def test_update_alloc_failure_queues_for_heartbeat(self, monkeypatch):
+        """Satellite: a Node.UpdateAlloc that exhausts its retry burst
+        is queued, not dropped, and the next heartbeat delivers it."""
+        import nomad_tpu.client.client as client_mod
+        from nomad_tpu.utils.retry import RetryPolicy
+
+        monkeypatch.setattr(
+            client_mod, "UPDATE_ALLOC_POLICY",
+            RetryPolicy(base=0.01, max_delay=0.02, max_attempts=2,
+                        retryable=lambda e: isinstance(e, Exception),
+                        name="test.update_alloc"))
+        rpc = _ScriptedRPC(fail_updates=5)  # outlasts one burst
+        client = _make_client(rpc)
+        try:
+            client._sync_alloc_status(_alloc_update("a-1", "failed"))
+            with client._update_lock:
+                assert "a-1" in client._pending_updates  # queued, not lost
+            # Newer status for the same alloc supersedes the queued one.
+            client._sync_alloc_status(_alloc_update("a-1", "complete"))
+
+            rpc.fail_updates = 0  # server back: heartbeat flushes
+            client._flush_alloc_updates()
+            with client._update_lock:
+                assert not client._pending_updates
+            assert len(rpc.update_payloads) == 1
+            (delivered,) = rpc.update_payloads[0]
+            assert delivered["id"] == "a-1"
+            assert delivered["client_status"] == "complete"
+        finally:
+            client.shutdown()
+
+    def test_flush_retry_resnapshots_queue(self, monkeypatch):
+        """Review regression: a retry attempt must re-snapshot the
+        queue, never re-send a payload a newer update superseded
+        mid-burst (the stale re-send would regress a terminal status
+        on the server)."""
+        import nomad_tpu.client.client as client_mod
+        from nomad_tpu.utils.retry import RetryPolicy
+
+        monkeypatch.setattr(
+            client_mod, "UPDATE_ALLOC_POLICY",
+            RetryPolicy(base=0.01, max_delay=0.02, max_attempts=3,
+                        retryable=lambda e: isinstance(e, Exception),
+                        name="test.update_alloc"))
+
+        client = _make_client(None)  # handler installed below
+
+        class _FailOnceThenRecord:
+            def __init__(self):
+                self.payloads = []
+                self.failed = False
+
+            def call(self, method, args, timeout=None):
+                if method != "Node.UpdateAlloc":
+                    return {"heartbeat_ttl": 10.0}
+                if not self.failed:
+                    self.failed = True
+                    # Simulate a runner queueing a NEWER status while
+                    # this attempt is failing.
+                    with client._update_lock:
+                        client._pending_updates["a-1"] = {
+                            "id": "a-1", "client_status": "complete",
+                            "client_description": "",
+                            "task_states": {}, "node_id": "n-1"}
+                    raise ConnectionError("first attempt lost")
+                self.payloads.append(args["alloc"])
+                return {}
+
+        rpc = _FailOnceThenRecord()
+        client.rpc = rpc
+        try:
+            client._sync_alloc_status(_alloc_update("a-1", "running"))
+            assert len(rpc.payloads) == 1
+            (delivered,) = rpc.payloads[0]
+            assert delivered["client_status"] == "complete"  # not stale
+            with client._update_lock:
+                assert not client._pending_updates
+        finally:
+            client.shutdown()
+
+    def test_update_alloc_success_path_unqueued(self):
+        rpc = _ScriptedRPC()
+        client = _make_client(rpc)
+        try:
+            client._sync_alloc_status(_alloc_update("a-2", "running"))
+            with client._update_lock:
+                assert not client._pending_updates
+            assert len(rpc.update_payloads) == 1
+        finally:
+            client.shutdown()
+
+    def test_register_backoff_with_injected_fault(self, monkeypatch,
+                                                  caplog):
+        """Satellite: registration under an injected rpc.send fault
+        retries with capped backoff and logs one traceback then
+        one-line WARNs — and eventually registers."""
+        import nomad_tpu.client.client as client_mod
+        from nomad_tpu.server import Server, ServerConfig
+
+        monkeypatch.setattr(client_mod, "REGISTER_RETRY_INTERVAL", 0.02)
+        monkeypatch.setattr(client_mod, "REGISTER_RETRY_MAX", 0.05)
+        srv = Server(ServerConfig(enable_rpc=True, num_schedulers=0))
+        srv.establish_leadership()
+        client = None
+        try:
+            from nomad_tpu.client import Client, ClientConfig
+
+            client = Client(ClientConfig(
+                servers=[srv.rpc_address()],
+                options={"fingerprint.skip_accel": "1"}))
+            plan = FaultPlan().add("rpc.send", "error", count=3,
+                                   method="Node.Register")
+            with caplog.at_level(logging.WARNING, logger="nomad_tpu"):
+                with faultinject.injected(plan):
+                    client._register()
+            assert srv.fsm.state.node_by_id(client.node.id) is not None
+            assert plan.fire_count("rpc.send") == 3
+            warns = [r for r in caplog.records
+                     if "registration" in r.getMessage()]
+            assert len(warns) == 3
+            assert all(r.levelno == logging.WARNING for r in warns)
+            # Traceback on the first only; the rest are one-liners.
+            assert warns[0].exc_info
+            assert not any(r.exc_info for r in warns[1:])
+        finally:
+            if client is not None:
+                client.shutdown()
+            srv.shutdown()
+
+    def test_register_gives_up_on_shutdown(self, monkeypatch):
+        """The capped backoff honors shutdown: _register returns when
+        the client stops, instead of spinning forever."""
+        import nomad_tpu.client.client as client_mod
+
+        monkeypatch.setattr(client_mod, "REGISTER_RETRY_INTERVAL", 0.02)
+        monkeypatch.setattr(client_mod, "REGISTER_RETRY_MAX", 0.05)
+
+        class _DeadRPC:
+            def call(self, method, args, timeout=None):
+                raise ConnectionError("nobody home")
+
+        client = _make_client(_DeadRPC())
+        t = threading.Thread(target=client._register, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        client._shutdown.set()
+        t.join(2.0)
+        assert not t.is_alive()
+        client.shutdown()

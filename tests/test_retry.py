@@ -1,0 +1,202 @@
+"""utils/retry.py: the unified backoff/retry policy every ad-hoc
+``time.sleep`` retry loop was replaced with."""
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import pytest
+
+from nomad_tpu.utils.retry import (
+    Backoff,
+    RetryAborted,
+    RetryPolicy,
+)
+
+
+class TestBackoff:
+    def test_exponential_growth_and_cap(self):
+        b = Backoff(base=0.1, max_delay=1.0, multiplier=2.0, jitter=0.0)
+        assert [round(b.next(), 3) for _ in range(6)] == \
+            [0.1, 0.2, 0.4, 0.8, 1.0, 1.0]
+
+    def test_reset_snaps_back(self):
+        b = Backoff(base=0.1, max_delay=5.0, jitter=0.0)
+        b.next()
+        b.next()
+        assert b.failures == 2
+        b.reset()
+        assert b.failures == 0
+        assert b.next() == pytest.approx(0.1)
+
+    def test_full_jitter_bounds(self):
+        rng = random.Random(3)
+        b = Backoff(base=1.0, max_delay=1.0, jitter=1.0, rng=rng)
+        draws = [b.next() for _ in range(100)]
+        assert all(0.0 < d <= 1.0 for d in draws)
+        assert len({round(d, 6) for d in draws}) > 50  # actually jittered
+
+    def test_partial_jitter_stays_near_nominal(self):
+        rng = random.Random(3)
+        b = Backoff(base=1.0, max_delay=1.0, jitter=0.25, rng=rng)
+        assert all(0.75 <= b.next() <= 1.0 for _ in range(50))
+
+    def test_huge_failure_count_no_overflow(self):
+        b = Backoff(base=0.1, max_delay=2.0, jitter=0.0)
+        for _ in range(200):
+            delay = b.next()
+        assert delay == 2.0
+
+    def test_sleep_returns_true_on_stop(self):
+        b = Backoff(base=5.0, max_delay=5.0, jitter=0.0)
+        stop = threading.Event()
+        stop.set()
+        t0 = time.monotonic()
+        assert b.sleep(stop) is True
+        assert time.monotonic() - t0 < 1.0
+
+    def test_bad_base_rejected(self):
+        with pytest.raises(ValueError):
+            Backoff(base=0.0)
+
+
+class TestRetryPolicy:
+    def _flaky(self, failures: int, exc=ConnectionError):
+        calls = {"n": 0}
+
+        def fn(timeout=None):  # bounded policies pass the budget in
+            calls["n"] += 1
+            if calls["n"] <= failures:
+                raise exc(f"boom {calls['n']}")
+            return calls["n"]
+        return fn, calls
+
+    def test_retries_until_success(self):
+        policy = RetryPolicy(base=0.001, max_delay=0.002, name="t")
+        fn, calls = self._flaky(3)
+        assert policy.call(fn) == 4
+        assert calls["n"] == 4
+
+    def test_non_retryable_raises_immediately(self):
+        policy = RetryPolicy(base=0.001, name="t")
+        fn, calls = self._flaky(3, exc=ValueError)
+        with pytest.raises(ValueError, match="boom 1"):
+            policy.call(fn)
+        assert calls["n"] == 1
+
+    def test_max_attempts_reraises_last(self):
+        policy = RetryPolicy(base=0.001, max_attempts=3, name="t")
+        fn, calls = self._flaky(10)
+        with pytest.raises(ConnectionError, match="boom 3"):
+            policy.call(fn)
+        assert calls["n"] == 3
+
+    def test_deadline_not_burned_asleep(self):
+        """The deadline check runs BEFORE the sleep: a policy whose
+        next delay would overrun gives up immediately."""
+        policy = RetryPolicy(base=10.0, jitter=0.0, deadline=0.5,
+                             name="t")
+        fn, calls = self._flaky(10)
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError):
+            policy.call(fn)
+        assert time.monotonic() - t0 < 0.4
+        assert calls["n"] == 1
+
+    def test_stop_event_aborts(self):
+        policy = RetryPolicy(base=30.0, jitter=0.0, name="t")
+        stop = threading.Event()
+        fn, _ = self._flaky(10)
+
+        def trip():
+            time.sleep(0.05)
+            stop.set()
+        threading.Thread(target=trip, daemon=True).start()
+        t0 = time.monotonic()
+        with pytest.raises(RetryAborted):
+            policy.call(fn, stop=stop)
+        assert time.monotonic() - t0 < 5.0
+
+    def test_on_retry_hook_sees_attempts(self):
+        policy = RetryPolicy(base=0.001, max_delay=0.002, name="t")
+        seen = []
+        fn, _ = self._flaky(2)
+        policy.call(fn, on_retry=lambda n, e, d: seen.append((n, d)))
+        assert [n for n, _ in seen] == [1, 2]
+        assert all(d > 0 for _, d in seen)
+
+    def test_callable_retryable_predicate(self):
+        policy = RetryPolicy(
+            base=0.001, name="t",
+            retryable=lambda e: "retry-me" in str(e))
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("retry-me please")
+            raise RuntimeError("fatal")
+        with pytest.raises(RuntimeError, match="fatal"):
+            policy.call(fn)
+        assert calls["n"] == 2
+
+    def test_per_attempt_timeout_clips_to_deadline(self):
+        policy = RetryPolicy(attempt_timeout=5.0, deadline=1.0)
+        start = time.monotonic()
+        t = policy.per_attempt_timeout(start)
+        assert 0 < t <= 1.0
+        assert RetryPolicy(attempt_timeout=2.0).per_attempt_timeout() \
+            == 2.0
+        assert RetryPolicy().per_attempt_timeout() is None
+
+    def test_bounded_policy_feeds_timeout_to_fn(self):
+        """A policy with attempt_timeout/deadline hands each attempt
+        its transport budget (clipped to the deadline remainder)."""
+        policy = RetryPolicy(base=0.001, max_attempts=3,
+                             attempt_timeout=5.0, deadline=60.0,
+                             name="t")
+        seen = []
+
+        def fn(timeout):
+            seen.append(timeout)
+            if len(seen) < 2:
+                raise ConnectionError("boom")
+            return "ok"
+        assert policy.call(fn) == "ok"
+        assert len(seen) == 2
+        assert all(0 < t <= 5.0 for t in seen)
+
+        # attempt_timeout alone also feeds through, un-clipped.
+        policy2 = RetryPolicy(base=0.001, attempt_timeout=2.5, name="t")
+        got = []
+        policy2.call(lambda timeout: got.append(timeout))
+        assert got == [2.5]
+
+    def test_metrics_counters(self):
+        from nomad_tpu.utils.metrics import metrics
+
+        policy = RetryPolicy(base=0.001, max_attempts=2,
+                             name="unit.metrics")
+        fn, _ = self._flaky(10)
+        with pytest.raises(ConnectionError):
+            policy.call(fn)
+        counters = metrics.inmem.snapshot()["counters"]
+        assert counters.get("nomad.retry.unit.metrics.retries", 0) >= 1
+        assert counters.get("nomad.retry.unit.metrics.gaveup", 0) >= 1
+
+    def test_policy_is_reusable_across_threads(self):
+        """One module-level policy instance serves many threads: each
+        call owns its backoff state."""
+        policy = RetryPolicy(base=0.001, max_delay=0.002, name="t")
+        results = []
+
+        def work():
+            fn, _ = self._flaky(2)
+            results.append(policy.call(fn))
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(5.0)
+        assert results == [3] * 8

@@ -210,9 +210,11 @@ class TestRegionForwarding:
 
 
 def _make_cert(tmp_path, cn="nomad-tpu-test"):
-    """Self-signed cert/key pair via the cryptography package."""
+    """Self-signed cert/key pair via the cryptography package (tests
+    calling this skip cleanly when the package is absent)."""
     import datetime
 
+    pytest.importorskip("cryptography")
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import rsa
