@@ -95,6 +95,10 @@ class HTTPServer:
     def shutdown(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        # serve_forever returns once shutdown() unblocks; reap the
+        # listener so agent teardown leaves no thread behind.
+        if self._thread is not None:
+            self._thread.join(2.0)
 
     # -- routing -----------------------------------------------------------
     def route(self, method: str, path: str, query: dict, body):

@@ -13,6 +13,13 @@ codebase lives on:
     its intra-package callees for impurity, tracer concretization and
     traced-value branching — the silent retrace/incorrectness modes that
     would erode kernel parity without ever failing a behavioral test.
+  - **Interprocedural concurrency** (`callgraph` + `blocking`): a
+    whole-program call graph drives blocking-under-lock detection
+    (a lock held across an RPC send, retry sleep, or device round-trip
+    three frames down), cross-function lock-order cycles, and
+    thread/future/event lifecycle checks; the graph's self-coverage
+    (resolved vs dynamic call sites) rides the lint's JSON output so
+    blind spots are visible instead of silent.
   - **Runtime sanitizers** (`sanitizers`): a lock-order witness
     (instrumented locks record REAL acquisition chains; observed cycles
     fail the suite) and a jit-recompile sentinel (a kernel retracing past
@@ -94,17 +101,36 @@ def load_allowlist(path: str) -> dict:
 
 
 def run_lint(package_dir: Optional[str] = None,
-             strict: bool = False) -> list:
-    """Run every static pass over a package tree; returns [Finding]."""
-    from . import jaxlint, lockcheck
+             strict: bool = False,
+             coverage_out: Optional[dict] = None) -> list:
+    """Run every static pass over a package tree; returns [Finding].
+
+    The tree is parsed once for lockcheck (``scan_package``) and once
+    for the call graph; the interprocedural passes (blocking.py) ride
+    both, AFTER lockcheck so its syntactic lock-order results are known
+    and not double-reported.  Pass a dict as ``coverage_out`` to receive
+    the call graph's self-coverage stats (functions indexed, call sites
+    resolved vs dynamic) — the analyzer's own blind spots, surfaced in
+    ``nomad-tpu lint --json`` instead of silent.
+    """
+    from . import blocking, callgraph, jaxlint, lockcheck
 
     package_dir = package_dir or default_package_root()
     if not os.path.isdir(package_dir):
         raise FileNotFoundError(package_dir)
+    scan = lockcheck.scan_package(package_dir)
+    _pkg, trees, err = scan
+    graph = callgraph.CallGraph.build(
+        package_dir, parsed=trees if err is None else None)
     findings: list = []
-    findings.extend(lockcheck.analyze_package(package_dir, strict=strict))
+    findings.extend(lockcheck.analyze_package(package_dir, strict=strict,
+                                              scan=scan))
+    findings.extend(blocking.analyze_package(package_dir, graph=graph,
+                                             scan=scan))
     findings.extend(jaxlint.analyze_package(package_dir))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    if coverage_out is not None:
+        coverage_out.update(graph.coverage())
     return findings
 
 

@@ -55,6 +55,17 @@ THREADSAFE_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
                     "local", "deque"}
 
 
+def queue_maxsize_unbounded(arg: ast.expr) -> bool:
+    """stdlib Queue semantics: any literal maxsize <= 0 (0, -1) means
+    unbounded.  Negative literals parse as UnaryOp(USub, Constant)."""
+    if isinstance(arg, ast.UnaryOp) and isinstance(arg.op, ast.USub) \
+            and isinstance(arg.operand, ast.Constant) and \
+            isinstance(arg.operand.value, (int, float)):
+        return True  # any negative literal
+    return isinstance(arg, ast.Constant) and \
+        isinstance(arg.value, (int, float)) and arg.value <= 0
+
+
 def _is_lock_ctor(node: ast.expr) -> Optional[str]:
     """threading.Lock() / Lock() / threading.Condition(x) -> kind."""
     if not isinstance(node, ast.Call):
@@ -85,11 +96,40 @@ class _ClassInfo:
         self.locks: dict = {}        # attr -> kind (Lock/RLock/Condition)
         self.lock_aliases: dict = {} # property name -> lock attr
         self.sync_safe: set = set()  # attrs holding Queue/Event/... objects
+        self.bounded_queues: set = set()  # Queue attrs with maxsize > 0
         self.attr_types: dict = {}   # attr -> ClassName (from __init__)
         self.methods: dict = {}      # name -> FunctionDef
+        # Typed concurrency annotations (nomad_tpu/utils/sync.py):
+        # Immutable attrs are bound once pre-publication (bare reads fine,
+        # ANY later write is a finding); CopySwap attrs are atomically
+        # rebound under a lock (bare reads fine, writes must stay locked).
+        self.immutable: set = set()
+        self.copy_swap: set = set()
         # attr -> [guarded_reads, guarded_writes, bare_reads, bare_writes]
         self.access: dict = {}
         self.first_access: dict = {} # (attr, kind) -> (method, line)
+
+
+def _marker_of(ann: Optional[ast.expr]) -> Optional[str]:
+    """The sync-annotation marker named by an annotation expression:
+    ``Immutable`` / ``CopySwap``, bare, subscripted (``Immutable[str]``),
+    dotted (``sync.Immutable``), or stringified by
+    ``from __future__ import annotations``."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            ann = ast.parse(ann.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(ann, ast.Subscript):
+        ann = ann.value
+    name = None
+    if isinstance(ann, ast.Attribute):
+        name = ann.attr
+    elif isinstance(ann, ast.Name):
+        name = ann.id
+    return name if name in ("Immutable", "CopySwap") else None
 
 
 def _scan_class(info: _ClassInfo) -> None:
@@ -97,12 +137,27 @@ def _scan_class(info: _ClassInfo) -> None:
     for item in info.node.body:
         if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
             info.methods[item.name] = item
+        elif isinstance(item, ast.AnnAssign) and \
+                isinstance(item.target, ast.Name):
+            # Class-body declaration: `addr: Immutable`
+            marker = _marker_of(item.annotation)
+            if marker == "Immutable":
+                info.immutable.add(item.target.id)
+            elif marker == "CopySwap":
+                info.copy_swap.add(item.target.id)
     for meth in info.methods.values():
         for node in ast.walk(meth):
             targets = value = None
             if isinstance(node, ast.Assign):
                 targets, value = node.targets, node.value
-            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            elif isinstance(node, ast.AnnAssign):
+                marker = _marker_of(node.annotation)
+                attr = _self_attr(node.target)
+                if marker and attr:
+                    (info.immutable if marker == "Immutable"
+                     else info.copy_swap).add(attr)
+                if node.value is None:
+                    continue
                 targets, value = [node.target], node.value
             if targets is None:
                 continue
@@ -121,6 +176,16 @@ def _scan_class(info: _ClassInfo) -> None:
                     info.locks[attr] = kind
                 elif ctor in THREADSAFE_CTORS:
                     info.sync_safe.add(attr)
+                    if ctor.endswith("Queue") and (
+                            value.args or any(
+                                kw.arg == "maxsize"
+                                for kw in value.keywords)):
+                        # A variable maxsize must be assumed bounded.
+                        arg = value.args[0] if value.args else next(
+                            kw.value for kw in value.keywords
+                            if kw.arg == "maxsize")
+                        if not queue_maxsize_unbounded(arg):
+                            info.bounded_queues.add(attr)
                 elif isinstance(value, ast.Call) and \
                         isinstance(value.func, ast.Name):
                     info.attr_types[attr] = value.func.id
@@ -166,15 +231,21 @@ class _MethodVisitor(ast.NodeVisitor):
         self.info = info
         self.method = method
         self.depth = 0          # with-lock nesting depth
-        self.accesses: list = []  # (attr, write, locked_here, line)
+        self.accesses: list = []  # (attr, write, locked_here, line, rebind)
         self.self_calls: list = []  # (callee, locked_here)
 
-    def _record(self, attr: str, write: bool, line: int) -> None:
+    def _record(self, attr: str, write: bool, line: int,
+                rebind: bool = True) -> None:
+        """``rebind`` distinguishes true rebinding (``self.x = ...``)
+        from receiver mutation (``self.x.append(...)``): both are writes
+        for the discipline pass, but only rebinding violates an
+        ``Immutable`` annotation."""
         info = self.info
         if attr in info.locks or attr in info.lock_aliases or \
                 attr in info.methods or attr in info.sync_safe:
             return
-        self.accesses.append((attr, write, self.depth > 0, line))
+        self.accesses.append((attr, write, self.depth > 0, line,
+                              rebind and write))
 
     # -- lock regions ------------------------------------------------------
     def visit_With(self, node: ast.With) -> None:
@@ -245,7 +316,7 @@ class _MethodVisitor(ast.NodeVisitor):
             if attr is not None:
                 if fn.attr in MUTATOR_METHODS and \
                         fn.attr not in SYNC_SAFE_METHODS:
-                    self._record(attr, True, node.lineno)
+                    self._record(attr, True, node.lineno, rebind=False)
                 else:
                     self._record(attr, False, node.lineno)
                 for arg in node.args:
@@ -401,6 +472,11 @@ class _Package:
         self.functions: dict = {}      # callee key -> _OrderVisitor
         self._by_name: dict = {}
         self.method_owners: dict = {}  # method name -> [lock-class names]
+        # Set by _order_graph: cycles/self-acquire sites this module's
+        # syntactic pass already reported, so the interprocedural pass
+        # (blocking.py) reports only what it alone can see.
+        self.cycle_sets: set = set()
+        self.self_sites: set = set()
 
     def class_by_name(self, name: str) -> Optional[_ClassInfo]:
         hits = self._by_name.get(name)
@@ -429,7 +505,11 @@ def _relpath(path: str, package_dir: str) -> str:
     return os.path.relpath(os.path.abspath(path), base)
 
 
-def analyze_package(package_dir: str, strict: bool = False) -> list:
+def scan_package(package_dir: str):
+    """Parse the tree and index locks/classes once.  Returns
+    ``(pkg, trees, error_finding)`` — shared by this module's passes and
+    the interprocedural passes in blocking.py, so the lock-site naming
+    stays identical across both."""
     pkg = _Package()
     trees = []
     for path in _iter_sources(package_dir):
@@ -437,8 +517,9 @@ def analyze_package(package_dir: str, strict: bool = False) -> list:
             try:
                 tree = ast.parse(fh.read(), filename=path)
             except SyntaxError as e:
-                return [Finding("parse-error", _relpath(path, package_dir),
-                                "<module>", str(e), e.lineno or 0)]
+                return pkg, trees, Finding(
+                    "parse-error", _relpath(path, package_dir),
+                    "<module>", str(e), e.lineno or 0)
         rel = _relpath(path, package_dir)
         # Dotted module path, not basename: the package has many
         # same-named files (__init__.py, client.py, config.py) whose
@@ -463,6 +544,14 @@ def analyze_package(package_dir: str, strict: bool = False) -> list:
                 _scan_class(info)
                 pkg.classes.append(info)
     pkg.index()
+    return pkg, trees, None
+
+
+def analyze_package(package_dir: str, strict: bool = False,
+                    scan=None) -> list:
+    pkg, trees, err = scan or scan_package(package_dir)
+    if err is not None:
+        return [err]
 
     findings: list = []
     findings.extend(_attr_discipline(pkg, strict))
@@ -523,12 +612,19 @@ def _attr_discipline(pkg: _Package, strict: bool) -> list:
             visitors[meth_name] = v
         held, ctor_only = _infer_entry_context(info, visitors)
 
+        immutable_writes: dict = {}  # attr -> (method, line)
         for meth_name, v in visitors.items():
             entry_held = meth_name in held
             pre_pub = meth_name == "__init__" or meth_name in ctor_only
-            for attr, write, locked_here, line in v.accesses:
-                slot = info.access.setdefault(attr, [0, 0, 0, 0])
+            for attr, write, locked_here, line, rebind in v.accesses:
                 guarded = locked_here or entry_held
+                if attr in info.immutable and rebind and not pre_pub:
+                    # An Immutable attr is bound once pre-publication;
+                    # ANY later write (locked or not) breaks the
+                    # annotation's contract that readers may skip the
+                    # lock.
+                    immutable_writes.setdefault(attr, (meth_name, line))
+                slot = info.access.setdefault(attr, [0, 0, 0, 0])
                 if pre_pub and not guarded:
                     continue  # no other thread can see the object yet
                 idx = (0 if guarded else 2) + (1 if write else 0)
@@ -538,9 +634,16 @@ def _attr_discipline(pkg: _Package, strict: bool) -> list:
                 info.first_access.setdefault((attr, kind),
                                              (meth_name, line))
 
+        for attr, (meth, line) in sorted(immutable_writes.items()):
+            findings.append(Finding(
+                "immutable-write", info.path, f"{info.name}.{attr}",
+                f"attribute annotated Immutable is written in {meth} "
+                "after construction", line))
         for attr, (g_r, g_w, b_r, b_w) in sorted(info.access.items()):
             if g_r + g_w == 0:
                 continue  # never guarded: plain attribute
+            if attr in info.immutable:
+                continue  # immutable-write pass owns this attr
             if b_w:
                 meth, line = info.first_access[(attr, ("bare", "write"))]
                 guard = info.first_access.get(
@@ -550,7 +653,7 @@ def _attr_discipline(pkg: _Package, strict: bool) -> list:
                     "bare-write", info.path, f"{info.name}.{attr}",
                     f"guarded attribute (locked in {guard[0]}) "
                     f"mutated outside any lock in {meth}", line))
-            if strict and b_r:
+            if strict and b_r and attr not in info.copy_swap:
                 meth, line = info.first_access[(attr, ("bare", "read"))]
                 findings.append(Finding(
                     "bare-read", info.path, f"{info.name}.{attr}",
@@ -718,6 +821,9 @@ def _order_graph(pkg: _Package, trees) -> list:
             "lock-cycle", rel, q,
             "lock-order cycle: " + " -> ".join(cycle + (cycle[0],)),
             line))
+        pkg.cycle_sets.add(frozenset(cycle))
+    pkg.self_sites.update(s for s in self_edges
+                          if kind_of.get(s) == "Lock")
     return findings
 
 

@@ -161,7 +161,12 @@ def main(argv=None) -> int:
                    help="also report advisory findings (bare reads of "
                         "guarded attributes)")
     p.add_argument("-json", dest="as_json", action="store_true",
-                   help="machine-readable output")
+                   help="machine-readable output (includes call-graph "
+                        "self-coverage)")
+    p.add_argument("-changed", metavar="REV", default="",
+                   help="only report findings in files touched since "
+                        "REV (git diff --name-only REV); the stale-"
+                        "allowlist gate is skipped in this mode")
 
     args = parser.parse_args(argv)
     if not args.command:
@@ -629,8 +634,10 @@ def cmd_lint(args) -> int:
     # Always analyze at full strictness so allowlist staleness is
     # computed against every finding; -strict only controls whether
     # unallowlisted advisory findings are *displayed*.
+    coverage: dict = {}
     try:
-        findings = run_lint(args.path or None, strict=True)
+        findings = run_lint(args.path or None, strict=True,
+                            coverage_out=coverage)
     except FileNotFoundError as e:
         print(f"Error: no such package directory: {e}", file=sys.stderr)
         return 1
@@ -638,12 +645,25 @@ def cmd_lint(args) -> int:
     advisory = [f for f in findings
                 if f.severity != "error" and f.key not in allowlist]
 
+    changed_mode = bool(getattr(args, "changed", ""))
+    if changed_mode:
+        # Findings filtered to files touched since REV (pre-push loop:
+        # "what did MY change introduce?").  Staleness needs the full
+        # finding set to be meaningful, so it is not enforced here.
+        touched = _changed_files(args.changed, args.path or None)
+        if touched is None:
+            return 1
+        gating = [f for f in gating if f.path in touched]
+        advisory = [f for f in advisory if f.path in touched]
+        stale = []
+
     if args.as_json:
         print(json.dumps({
             "gating": [f.__dict__ for f in gating],
             "advisory": [f.__dict__ for f in advisory],
             "allowlisted": len(allowed),
             "stale_allowlist": stale,
+            "coverage": coverage,
         }, indent=2))
     else:
         for f in gating:
@@ -655,8 +675,49 @@ def cmd_lint(args) -> int:
             print(f"stale allowlist entry (no matching finding): {key}",
                   file=sys.stderr)
         print(f"{len(gating)} finding(s), {len(allowed)} allowlisted, "
-              f"{len(stale)} stale allowlist entr(ies)")
+              f"{len(stale)} stale allowlist entr(ies); call-graph "
+              f"coverage {coverage.get('resolved_fraction', 0):.0%} "
+              f"({coverage.get('dynamic', 0)} dynamic call sites "
+              "skipped)")
     return 1 if gating or stale else 0
+
+
+def _changed_files(rev: str, package_path) -> "set | None":
+    """Repo-relative paths touched since ``rev`` (committed AND working
+    tree), resolved against the repo holding the analyzed package."""
+    import subprocess
+
+    from nomad_tpu.analysis import default_package_root
+
+    root = os.path.dirname(os.path.abspath(
+        package_path or default_package_root()))
+    # --relative keys the diff paths to ``root`` (the package parent),
+    # matching the analyzer's finding paths even when the package lives
+    # below the git toplevel; untracked files are merged in via
+    # ls-files — a brand-new module's findings must not be filtered to
+    # a false clean.
+    try:
+        diff = subprocess.run(
+            ["git", "-C", root, "diff", "--name-only", "--relative",
+             rev],
+            capture_output=True, text=True, check=True, timeout=30)
+        untracked = subprocess.run(
+            ["git", "-C", root, "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True, check=True, timeout=30)
+    except FileNotFoundError:
+        print("Error: -changed requires git on PATH", file=sys.stderr)
+        return None
+    except subprocess.CalledProcessError as e:
+        print(f"Error: git diff/ls-files against {rev} failed: "
+              f"{e.stderr.strip()}", file=sys.stderr)
+        return None
+    except subprocess.TimeoutExpired:
+        print("Error: git diff timed out", file=sys.stderr)
+        return None
+    return {line.strip()
+            for out in (diff.stdout, untracked.stdout)
+            for line in out.splitlines() if line.strip()}
 
 
 COMMANDS = {

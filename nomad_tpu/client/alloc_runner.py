@@ -24,6 +24,8 @@ from nomad_tpu.structs import (
     Allocation,
 )
 
+from nomad_tpu.utils.sync import CopySwap
+
 from .allocdir import AllocDir
 from .driver.base import ExecContext
 from .task_runner import TASK_STATE_DEAD, TASK_STATE_RUNNING, TaskRunner
@@ -36,7 +38,10 @@ class AllocRunner:
                  state_dir: str = "",
                  on_status: Optional[Callable] = None,
                  options: Optional[dict] = None) -> None:
-        self.alloc = alloc
+        # Rebound atomically (copy-swap) by publishers holding
+        # _publish_lock; readers see the previous or new immutable
+        # alloc, never a torn one.
+        self.alloc: CopySwap = alloc
         self.alloc_root = alloc_root
         self.state_dir = state_dir
         self.on_status = on_status or (lambda alloc: None)

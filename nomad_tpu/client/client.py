@@ -25,6 +25,7 @@ from nomad_tpu.structs import (
     generate_uuid,
 )
 from nomad_tpu.utils.retry import Backoff, RetryPolicy
+from nomad_tpu.utils.sync import Immutable
 
 from .alloc_runner import AllocRunner
 from .config import ClientConfig
@@ -79,7 +80,9 @@ class NetRPCHandler:
 
 class Client:
     def __init__(self, config: ClientConfig) -> None:
-        self.config = config
+        # The config OBJECT is never rebound (set_servers mutates its
+        # server list in place, atomically).
+        self.config: Immutable = config
         self.rpc = config.rpc_handler or NetRPCHandler(config.servers)
 
         self.node = config.node or Node()

@@ -93,6 +93,12 @@ class _CollectWorker:
             except BaseException as e:
                 self.outq.put((False, e))
 
+    def join(self, timeout: "float | None" = None) -> None:
+        """Reap after the exit sentinel.  Only the clean-shutdown path
+        may join — an abandoned (hung-collect) worker is deliberately
+        left to die on its own when the device call returns."""
+        self.thread.join(timeout)
+
 
 class _Item:
     """One eval moving front -> drain.  ``handles`` is None for
@@ -423,6 +429,7 @@ class PipelinedEvalRunner(BatchEvalRunner):
         if worker is not None:
             self._collect_worker = None
             worker.inq.put(None)
+            worker.join(2.0)
 
     def _host_rerun(self, it: _Item) -> tuple:
         """Re-run one eval's placement on the host twin kernels."""

@@ -26,6 +26,8 @@ from typing import Callable, Optional
 
 import msgpack
 
+from nomad_tpu.utils.sync import Immutable
+
 logger = logging.getLogger("nomad_tpu.server.gossip")
 
 ALIVE = "alive"
@@ -61,7 +63,7 @@ class Gossip:
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self.sock.bind((bind, port))
         self.sock.settimeout(0.2)
-        self.addr = self.sock.getsockname()
+        self.addr: Immutable = self.sock.getsockname()
         self.tags = tags
         self.probe_interval = probe_interval
         self.probe_timeout = probe_timeout
@@ -132,6 +134,14 @@ class Gossip:
             self.sock.close()
         except OSError:
             pass
+        # Reap both loops: the rx loop pops out on the closed socket /
+        # its 0.2s recv timeout, the probe loop on its next stop check.
+        # Leaving them running leaked two threads per torn-down server
+        # (analyzer: thread-leak).
+        if self._rx is not threading.current_thread():
+            self._rx.join(3.0)
+        if self._probe is not threading.current_thread():
+            self._probe.join(3.0)
 
     # -- wire ---------------------------------------------------------------
     def _next_seq(self) -> int:

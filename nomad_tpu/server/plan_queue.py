@@ -31,6 +31,12 @@ class PlanFuture:
         self._error = error
         self._event.set()
 
+    def done(self) -> bool:
+        """True once responded — lets pollers distinguish their own
+        wait timeout from a RESPONDED error that happens to be a
+        TimeoutError (worker._wait_plan would otherwise spin on it)."""
+        return self._event.is_set()
+
     def wait(self, timeout: Optional[float] = None) -> PlanResult:
         if not self._event.wait(timeout):
             raise TimeoutError("timed out waiting for plan result")

@@ -20,6 +20,7 @@ from typing import Optional
 import msgpack
 
 from nomad_tpu import faultinject
+from nomad_tpu.utils.sync import Immutable
 
 logger = logging.getLogger("nomad_tpu.server.raft")
 
@@ -59,7 +60,7 @@ class FileLogStore:
     """
 
     def __init__(self, path: str) -> None:
-        self.path = path
+        self.path: Immutable = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._fh = open(path, "ab")
         self._lock = threading.Lock()
@@ -227,7 +228,7 @@ class InmemRaft:
                  snapshot_threshold: int = 8192) -> None:
         self.fsm = fsm
         self.log_store = log_store
-        self.snapshots = snapshots
+        self.snapshots: Immutable = snapshots
         self.snapshot_threshold = snapshot_threshold
         self._lock = threading.Lock()
         self._applied = 0

@@ -35,6 +35,7 @@ import msgpack
 
 from nomad_tpu import faultinject
 from nomad_tpu.structs import codec
+from nomad_tpu.utils.sync import Immutable
 
 from .raft import (
     ApplyFuture,
@@ -73,6 +74,9 @@ class _PeerReplicator:
             target=self.run, daemon=True,
             name=f"raft-repl-{peer[0]}:{peer[1]}")
         self.thread.start()
+
+    def join(self, timeout: "float | None" = None) -> None:
+        self.thread.join(timeout)
 
     def run(self) -> None:
         from nomad_tpu.utils.retry import Backoff
@@ -113,8 +117,8 @@ class NetRaft:
         self.fsm = fsm
         self.rpc = rpc_server
         self.pool = conn_pool
-        self.address = tuple(rpc_server.address)
-        self.election_timeout = election_timeout
+        self.address: Immutable = tuple(rpc_server.address)
+        self.election_timeout: Immutable = election_timeout
         self.heartbeat_interval = heartbeat_interval
         self.snapshot_threshold = snapshot_threshold
 
@@ -142,9 +146,11 @@ class NetRaft:
         self._snap_term = 0
 
         # Durability (term/vote + snapshots + log), reloaded on boot.
-        self._meta_path = None
-        self._log_store = None
-        self._snap_store = None
+        # All three handles are bound during construction and never
+        # rebound; shutdown only calls the log store's idempotent close.
+        self._meta_path: Immutable = None
+        self._log_store: Immutable = None
+        self._snap_store: Immutable = None
         if data_dir:
             os.makedirs(f"{data_dir}/raft", exist_ok=True)
             self._meta_path = f"{data_dir}/raft/meta.json"
@@ -277,6 +283,10 @@ class NetRaft:
         if repl is not None:
             repl.stop.set()
             repl.wake.set()
+            # A removed peer's replicator must actually die (it holds a
+            # conn-pool reference and wakes on every apply otherwise);
+            # bounded join — a mid-flight RPC times out at 1s.
+            repl.join(3.0)
 
     def notify_leadership(self, cb: Callable[[bool], None]) -> None:
         self._notify.append(cb)
@@ -289,6 +299,14 @@ class NetRaft:
             repl.stop.set()
             repl.wake.set()
         self._notify_queue.put(None)
+        # Reap every thread this instance started: ticker, per-peer
+        # replicators, then the notifier (which exits on the sentinel).
+        # All joins are bounded — the longest in-flight work is a 1s
+        # peer RPC (analyzer: thread-leak).
+        self._ticker.join(2.0)
+        for repl in replicators:
+            repl.join(3.0)
+        self._notifier.join(2.0)
         if self._log_store is not None:
             self._log_store.close()
 
@@ -368,7 +386,8 @@ class NetRaft:
                 self._reset_election_timer()
 
     def elections_enabled(self) -> bool:
-        return self._elections_enabled
+        with self._lock:
+            return self._elections_enabled
 
     def _reset_election_timer(self) -> None:
         if not self._elections_enabled:
@@ -381,8 +400,8 @@ class NetRaft:
         while not self._stop.is_set():
             with self._lock:
                 state = self._state
-            if state != LEADER and \
-                    time.monotonic() >= self._election_deadline:
+                deadline = self._election_deadline
+            if state != LEADER and time.monotonic() >= deadline:
                 self._start_election()
             time.sleep(0.01)
 

@@ -31,6 +31,7 @@ from typing import Callable, Optional
 import msgpack
 
 from nomad_tpu import faultinject
+from nomad_tpu.utils.sync import Immutable
 
 logger = logging.getLogger("nomad_tpu.server.rpc")
 
@@ -173,6 +174,10 @@ class RPCServer:
     def shutdown(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        # serve_forever has returned once shutdown() unblocks; reap the
+        # listener thread so teardown leaves nothing running.
+        if self._thread is not None:
+            self._thread.join(2.0)
         # Sever established connections too (long-poll/mux sessions would
         # otherwise outlive the listener and talk to a dead server).
         with self._lock:
@@ -365,8 +370,8 @@ class _PooledConn:
     def __init__(self, address: tuple,
                  tls_context: Optional[ssl.SSLContext] = None,
                  server_hostname: str = "") -> None:
-        self.sock = _dial(address, RPC_NOMAD, tls_context,
-                          server_hostname)
+        self.sock: Immutable = _dial(address, RPC_NOMAD, tls_context,
+                                     server_hostname)
         self.lock = threading.Lock()
         self.seq = 0
 
@@ -404,7 +409,8 @@ class MuxConn:
     def __init__(self, address: tuple,
                  tls_context: Optional[ssl.SSLContext] = None,
                  server_hostname: str = "") -> None:
-        self.sock = _dial(address, RPC_MUX, tls_context, server_hostname)
+        self.sock: Immutable = _dial(address, RPC_MUX, tls_context,
+                                     server_hostname)
         self.sock.settimeout(None)  # reader blocks; callers use events
         self._lock = threading.Lock()    # waiter table + seq state
         self._wlock = threading.Lock()   # socket writes ONLY
@@ -464,20 +470,32 @@ class MuxConn:
             raise TimeoutError(f"rpc {method} timed out")
         resp = waiter[1]
         if resp is None:  # reader died
-            raise ConnectionError(str(self._broken))
+            with self._lock:
+                err = self._broken
+            raise ConnectionError(str(err))
         if resp.get("error"):
             raise RPCError(resp["error"])
         return resp.get("result")
 
     @property
     def broken(self) -> bool:
-        return self._broken is not None
+        with self._lock:
+            return self._broken is not None
 
     def close(self) -> None:
+        # shutdown() (not just close) reliably wakes a blocked recv with
+        # EOF; the reader then exits and gets reaped, so a torn-down
+        # session never leaves a thread behind.
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self.sock.close()
         except OSError:
             pass
+        if self._reader is not threading.current_thread():
+            self._reader.join(2.0)
 
 
 class ConnPool:
@@ -492,8 +510,8 @@ class ConnPool:
                  server_hostname: str = "",
                  multiplex: bool = True) -> None:
         self.max_per_host = max_per_host
-        self.tls_context = tls_context
-        self.server_hostname = server_hostname
+        self.tls_context: Immutable = tls_context
+        self.server_hostname: Immutable = server_hostname
         self.multiplex = multiplex
         self._lock = threading.Lock()
         self._pools: dict = {}   # address -> [idle _PooledConn]
@@ -504,12 +522,28 @@ class ConnPool:
             sess = self._sessions.get(address)
             if sess is not None and not sess.broken:
                 return sess
-            if sess is not None:
-                sess.close()
-            sess = MuxConn(address, tls_context=self.tls_context,
-                           server_hostname=self.server_hostname)
-            self._sessions[address] = sess
-            return sess
+        # Dial OUTSIDE the pool lock: a slow or unreachable peer (the
+        # connect timeout is 330s) must not stall every other thread's
+        # RPC to every other address behind this lock
+        # (analyzer: blocking-under-lock).  Concurrent re-dials to the
+        # same address may race; the loser's session is closed.
+        fresh = MuxConn(address, tls_context=self.tls_context,
+                        server_hostname=self.server_hostname)
+        stale = loser = None
+        with self._lock:
+            current = self._sessions.get(address)
+            if current is not None and not current.broken and \
+                    current is not sess:
+                keep, loser = current, fresh  # another thread won
+            else:
+                stale, keep = current, fresh
+                self._sessions[address] = fresh
+        # close() joins the reader thread — never under the pool lock.
+        if stale is not None:
+            stale.close()
+        if loser is not None:
+            loser.close()
+        return keep
 
     def _call_mux(self, address: tuple, method: str, args: dict,
                   timeout: Optional[float]):
@@ -578,11 +612,15 @@ class ConnPool:
         conn.close()
 
     def shutdown(self) -> None:
+        # Detach under the lock, close outside it (MuxConn.close joins
+        # its reader thread).
         with self._lock:
-            for pool in self._pools.values():
-                for conn in pool:
-                    conn.close()
+            pools = list(self._pools.values())
             self._pools.clear()
-            for sess in self._sessions.values():
-                sess.close()
+            sessions = list(self._sessions.values())
             self._sessions.clear()
+        for pool in pools:
+            for conn in pool:
+                conn.close()
+        for sess in sessions:
+            sess.close()

@@ -404,6 +404,10 @@ class Server:
         for w in self.workers:
             w.stop()
         self.revoke_leadership()
+        # Stop first, join after revoke: disabling the broker pops
+        # workers out of their blocking dequeues immediately.
+        for w in self.workers:
+            w.join(3.0)
         if self.gossip is not None:
             self.gossip.shutdown()
         raft_shutdown = getattr(self.raft, "shutdown", None)

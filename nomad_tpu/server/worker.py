@@ -55,6 +55,12 @@ class Worker:
     def stop(self) -> None:
         self._stop.set()
 
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Reap the worker thread after ``stop()``; bounded — the loop
+        re-checks the stop event at least every dequeue timeout."""
+        if self._thread is not None:
+            self._thread.join(timeout)
+
     def set_pause(self, paused: bool) -> None:
         """Leader reserves a worker's CPU for its own duties
         (worker.go:77-93)."""
@@ -139,6 +145,15 @@ class Worker:
             try:
                 return future.wait(PLAN_WAIT_POLL)
             except TimeoutError:
+                # The future may have been responded since (or DURING)
+                # the poll: re-read it rather than trusting this
+                # TimeoutError, which is ambiguous between our poll
+                # expiring, a respond() racing the poll's expiry, and a
+                # RESPONDED result whose stored error is itself a
+                # TimeoutError (re-raised instantly — treating that as
+                # the poll would zero-sleep spin here forever).
+                if future.done():
+                    return future.wait(0)
                 if not self.server.plan_queue.enabled():
                     raise RuntimeError(
                         "plan queue closed while awaiting plan result")

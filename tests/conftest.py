@@ -80,7 +80,7 @@ def wait_until(fn, timeout=15.0, msg="condition"):
     while time.monotonic() < deadline:
         if fn():
             return
-        time.sleep(0.02)
+        time.sleep(0.02)  # sleep-ok: poll interval of the bounded wait
     raise AssertionError(f"timeout waiting for {msg}")
 
 

@@ -13,14 +13,7 @@ from nomad_tpu.agent import Agent, AgentConfig
 from nomad_tpu.api import APIClient, APIError, QueryOptions
 from nomad_tpu.jobspec import ParseError, parse
 
-
-def wait_until(fn, timeout=15.0, msg="condition"):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if fn():
-            return
-        time.sleep(0.02)
-    raise AssertionError(f"timeout waiting for {msg}")
+from tests.conftest import wait_until
 
 
 JOBSPEC = """

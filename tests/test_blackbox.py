@@ -84,7 +84,7 @@ def _wait_http(proc, base, timeout=60):
         try:
             return _http("GET", base + "/v1/agent/self", timeout=2)
         except Exception:
-            time.sleep(0.2)
+            time.sleep(0.2)  # sleep-ok: poll interval of the bounded wait
     raise AssertionError("agent never served HTTP")
 
 
@@ -105,7 +105,7 @@ def wait_for(fn, msg, timeout=45):
     while time.monotonic() < deadline:
         if fn():
             return
-        time.sleep(0.3)
+        time.sleep(0.3)  # sleep-ok: poll interval of the bounded wait
     raise AssertionError(f"timeout: {msg}")
 
 
@@ -171,7 +171,7 @@ def test_blackbox_job_lifecycle(agent_proc):
     proc.send_signal(signal.SIGHUP)
     # SIGUSR1 metrics dump (reference go-metrics InmemSignal).
     proc.send_signal(signal.SIGUSR1)
-    time.sleep(1.0)
+    time.sleep(1.0)  # sleep-ok: prove the agent SURVIVES the signals
     assert proc.poll() is None, "agent must survive SIGHUP/SIGUSR1"
     self_doc = _http("GET", base + "/v1/agent/self")
     assert self_doc["stats"]["nomad"]["leader"] == "true"
@@ -381,7 +381,7 @@ def test_blackbox_leader_kill_failover(tmp_path):
                 except Exception:
                     if time.monotonic() >= deadline:
                         raise
-                    time.sleep(0.5)
+                    time.sleep(0.5)  # sleep-ok: poll interval of the bounded retry
 
         # The cluster still schedules: a new job through the converged
         # survivor (retried across any residual forwarding churn).

@@ -153,11 +153,11 @@ def _server_phase(plan: FaultPlan) -> None:
                     len(evals) >= len(jobs):
                 # Quiesced once; re-check after a beat in case expiry
                 # evals were still being written.
-                time.sleep(0.3)
+                time.sleep(0.3)  # sleep-ok: settle window for in-flight expiry evals
                 evals = srv.fsm.state.evals()
                 if all(e.status in TERMINAL for e in evals):
                     break
-            time.sleep(0.1)
+            time.sleep(0.1)  # sleep-ok: poll cadence between liveness heartbeats
 
         stop_beat.set()
         beater.join(5.0)
@@ -244,7 +244,7 @@ def _device_phase(plan: FaultPlan) -> None:
             reruns += runner.breaker_reruns
             parity += runner.parity_checks
             if breaker.state == OPEN:
-                time.sleep(0.06)  # let the cooldown elapse -> probe next
+                time.sleep(0.06)  # sleep-ok: let the breaker cooldown elapse -> probe next
 
     stats = breaker.stats()
     # Both fault families tripped it (the hung collect landed on the

@@ -89,7 +89,7 @@ def test_chaos_storm_with_heartbeat_expiry(seed):
         # Mid-storm chaos: a deterministic subset of nodes misses its
         # heartbeats — the REAL expiry path marks them down and spawns
         # node-update evals that race the in-flight storm.
-        time.sleep(0.15)
+        time.sleep(0.15)  # sleep-ok: mid-storm pacing before injected expiry
         expire = [node_ids[int(i)] for i in
                   rng.choice(n_nodes, size=10, replace=False)]
         for node_id in expire:
@@ -112,7 +112,7 @@ def test_chaos_storm_with_heartbeat_expiry(seed):
             if evals and all(e.status in TERMINAL for e in evals) and \
                     len(evals) >= len(eval_ids):
                 break
-            time.sleep(0.2)
+            time.sleep(0.2)  # sleep-ok: poll cadence between liveness heartbeats
 
         state = srv.fsm.state
 
@@ -185,7 +185,7 @@ def test_chaos_storm_with_drain():
                              {"job": job.to_dict()})
             eval_ids.append(resp["eval_id"])
 
-        time.sleep(0.1)
+        time.sleep(0.1)  # sleep-ok: mid-storm pacing before injected drain
         drained = [node_ids[int(i)] for i in
                    rng.choice(n_nodes, size=8, replace=False)]
         for nid in drained:
@@ -204,7 +204,7 @@ def test_chaos_storm_with_drain():
             if evals and all(e.status in TERMINAL for e in evals) and \
                     len(evals) >= len(eval_ids):
                 break
-            time.sleep(0.2)
+            time.sleep(0.2)  # sleep-ok: poll cadence between liveness heartbeats
 
         state = srv.fsm.state
         stuck = [(e.id, e.status) for e in state.evals()
@@ -230,7 +230,7 @@ def test_chaos_storm_with_drain():
             if len(evals) > n_evals and \
                     all(e.status in TERMINAL for e in evals):
                 break
-            time.sleep(0.2)
+            time.sleep(0.2)  # sleep-ok: poll cadence between liveness heartbeats
         state = srv.fsm.state
 
         # Drained nodes end empty; survivors are never oversubscribed.

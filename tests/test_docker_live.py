@@ -111,7 +111,7 @@ def _wait(cond, timeout=60.0, msg=""):
     while time.monotonic() < deadline:
         if cond():
             return
-        time.sleep(0.25)
+        time.sleep(0.25)  # sleep-ok: poll interval of the bounded wait
     raise AssertionError(f"timeout waiting for {msg}")
 
 

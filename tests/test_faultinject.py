@@ -706,7 +706,7 @@ class TestClientRetries:
         client = _make_client(_DeadRPC())
         t = threading.Thread(target=client._register, daemon=True)
         t.start()
-        time.sleep(0.1)
+        time.sleep(0.1)  # sleep-ok: park _register inside its backoff sleep
         client._shutdown.set()
         t.join(2.0)
         assert not t.is_alive()

@@ -18,14 +18,7 @@ import nomad_tpu.mock as mock
 from nomad_tpu.server import Server, ServerConfig
 from nomad_tpu.server.rpc import ConnPool, RPCError
 
-
-def wait_until(fn, timeout=10.0, msg="condition"):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if fn():
-            return
-        time.sleep(0.02)
-    raise AssertionError(f"timeout waiting for {msg}")
+from tests.conftest import wait_until
 
 
 def _server(region: str, name: str) -> Server:

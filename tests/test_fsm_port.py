@@ -1,0 +1,250 @@
+"""Port of the reference's fsm_test.go table (nomad/fsm_test.go).
+
+Continues the plan_apply/worker/heartbeat/eval_broker port series: one
+log-apply test per message type (the FSM is the only writer of durable
+state, so each dispatch path deserves its own proof), the unknown-type
+contract, and the snapshot/restore round-trip
+(fsm_test.go TestFSM_SnapshotRestore_*).
+"""
+from __future__ import annotations
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.server.eval_broker import EvalBroker
+from nomad_tpu.server.fsm import NomadFSM
+from nomad_tpu.structs import codec
+from nomad_tpu.structs.codec import (
+    ALLOC_CLIENT_UPDATE_REQUEST,
+    ALLOC_UPDATE_REQUEST,
+    EVAL_DELETE_REQUEST,
+    EVAL_UPDATE_REQUEST,
+    IGNORE_UNKNOWN_TYPE_FLAG,
+    JOB_DEREGISTER_REQUEST,
+    JOB_REGISTER_REQUEST,
+    NODE_DEREGISTER_REQUEST,
+    NODE_REGISTER_REQUEST,
+    NODE_UPDATE_DRAIN_REQUEST,
+    NODE_UPDATE_STATUS_REQUEST,
+)
+
+
+def apply(fsm: NomadFSM, index: int, msg_type: int, payload: dict):
+    return fsm.apply(index, codec.encode(msg_type, payload))
+
+
+# ---------------------------------------------------------------------------
+# per-message-type log applies (fsm_test.go:49-353)
+# ---------------------------------------------------------------------------
+
+class TestApplyTable:
+    def test_upsert_node(self):
+        fsm = NomadFSM()
+        node = mock.node()
+        apply(fsm, 1, NODE_REGISTER_REQUEST, {"node": node.to_dict()})
+        got = fsm.state.node_by_id(node.id)
+        assert got is not None and got.name == node.name
+        assert fsm.state.get_index("nodes") == 1
+
+    def test_deregister_node(self):
+        fsm = NomadFSM()
+        node = mock.node()
+        apply(fsm, 1, NODE_REGISTER_REQUEST, {"node": node.to_dict()})
+        apply(fsm, 2, NODE_DEREGISTER_REQUEST, {"node_id": node.id})
+        assert fsm.state.node_by_id(node.id) is None
+        assert fsm.state.get_index("nodes") == 2
+
+    def test_update_node_status(self):
+        fsm = NomadFSM()
+        node = mock.node()
+        apply(fsm, 1, NODE_REGISTER_REQUEST, {"node": node.to_dict()})
+        apply(fsm, 2, NODE_UPDATE_STATUS_REQUEST,
+              {"node_id": node.id, "status": "down"})
+        got = fsm.state.node_by_id(node.id)
+        assert got.status == "down"
+        assert got.modify_index == 2
+
+    def test_update_node_drain(self):
+        fsm = NomadFSM()
+        node = mock.node()
+        apply(fsm, 1, NODE_REGISTER_REQUEST, {"node": node.to_dict()})
+        apply(fsm, 2, NODE_UPDATE_DRAIN_REQUEST,
+              {"node_id": node.id, "drain": True})
+        assert fsm.state.node_by_id(node.id).drain is True
+
+    def test_register_job(self):
+        fsm = NomadFSM()
+        job = mock.job()
+        apply(fsm, 1, JOB_REGISTER_REQUEST, {"job": job.to_dict()})
+        got = fsm.state.job_by_id(job.id)
+        assert got is not None and got.name == job.name
+        assert fsm.state.get_index("jobs") == 1
+
+    def test_deregister_job(self):
+        fsm = NomadFSM()
+        job = mock.job()
+        apply(fsm, 1, JOB_REGISTER_REQUEST, {"job": job.to_dict()})
+        apply(fsm, 2, JOB_DEREGISTER_REQUEST, {"job_id": job.id})
+        assert fsm.state.job_by_id(job.id) is None
+
+    def test_update_eval(self):
+        fsm = NomadFSM()
+        ev = mock.eval()
+        apply(fsm, 1, EVAL_UPDATE_REQUEST, {"evals": [ev.to_dict()]})
+        got = fsm.state.eval_by_id(ev.id)
+        assert got is not None and got.priority == ev.priority
+        assert fsm.state.get_index("evals") == 1
+
+    def test_pending_eval_enters_enabled_broker(self):
+        """fsm.go:243-250: pending evals (re-)enter the broker on apply,
+        leader only (the broker no-ops unless enabled)."""
+        broker = EvalBroker(nack_timeout=5, delivery_limit=2)
+        broker.set_enabled(True)
+        fsm = NomadFSM(eval_broker=broker)
+        ev = mock.eval()
+        apply(fsm, 1, EVAL_UPDATE_REQUEST, {"evals": [ev.to_dict()]})
+        assert broker.stats()["total_ready"] == 1
+
+    def test_pending_eval_skips_disabled_broker(self):
+        broker = EvalBroker(nack_timeout=5, delivery_limit=2)
+        fsm = NomadFSM(eval_broker=broker)
+        ev = mock.eval()
+        apply(fsm, 1, EVAL_UPDATE_REQUEST, {"evals": [ev.to_dict()]})
+        assert broker.stats()["total_ready"] == 0
+
+    def test_delete_eval(self):
+        fsm = NomadFSM()
+        ev = mock.eval()
+        apply(fsm, 1, EVAL_UPDATE_REQUEST, {"evals": [ev.to_dict()]})
+        apply(fsm, 2, EVAL_DELETE_REQUEST,
+              {"evals": [ev.id], "allocs": []})
+        assert fsm.state.eval_by_id(ev.id) is None
+
+    def test_upsert_allocs(self):
+        fsm = NomadFSM()
+        alloc = mock.alloc()
+        apply(fsm, 1, ALLOC_UPDATE_REQUEST, {"alloc": [alloc.to_dict()]})
+        got = fsm.state.alloc_by_id(alloc.id)
+        assert got is not None and got.node_id == alloc.node_id
+        assert fsm.state.get_index("allocs") == 1
+
+    def test_client_update_preserves_server_fields(self):
+        """fsm_test.go TestFSM_UpdateAllocFromClient: the client owns
+        client_status/task_states; the server's desired_status and job
+        survive the merge."""
+        fsm = NomadFSM()
+        alloc = mock.alloc()
+        apply(fsm, 1, ALLOC_UPDATE_REQUEST, {"alloc": [alloc.to_dict()]})
+        update = alloc.copy()
+        update.client_status = "failed"
+        update.job = None  # the client strips the job payload
+        apply(fsm, 2, ALLOC_CLIENT_UPDATE_REQUEST,
+              {"alloc": [update.to_dict()]})
+        got = fsm.state.alloc_by_id(alloc.id)
+        assert got.client_status == "failed"
+        assert got.desired_status == alloc.desired_status
+        assert got.job is not None, "server-side job payload was lost"
+        assert got.modify_index == 2
+
+    def test_unknown_type_errors_unless_flagged_ignorable(self):
+        fsm = NomadFSM()
+        with pytest.raises(ValueError, match="unknown type"):
+            fsm.apply(1, codec.encode(101, {}))
+        # The ignore flag (structs.go:40-43) makes it a no-op instead.
+        assert fsm.apply(
+            2, codec.encode(IGNORE_UNKNOWN_TYPE_FLAG | 101, {})) is None
+
+    def test_apply_hook_fires_per_entry(self):
+        seen = []
+        fsm = NomadFSM(on_apply=lambda idx, t, payload:
+                       seen.append((idx, t)))
+        node = mock.node()
+        apply(fsm, 7, NODE_REGISTER_REQUEST, {"node": node.to_dict()})
+        assert seen == [(7, NODE_REGISTER_REQUEST)]
+
+
+# ---------------------------------------------------------------------------
+# snapshot / restore round-trip (fsm_test.go:355-520)
+# ---------------------------------------------------------------------------
+
+def populated_fsm() -> tuple[NomadFSM, dict]:
+    fsm = NomadFSM()
+    nodes = [mock.node(i) for i in range(2)]
+    jobs = [mock.job() for _ in range(2)]
+    evals = [mock.eval() for _ in range(2)]
+    allocs = [mock.alloc() for _ in range(2)]
+    index = 0
+    for n in nodes:
+        index += 1
+        apply(fsm, index, NODE_REGISTER_REQUEST, {"node": n.to_dict()})
+    for j in jobs:
+        index += 1
+        apply(fsm, index, JOB_REGISTER_REQUEST, {"job": j.to_dict()})
+    index += 1
+    apply(fsm, index, EVAL_UPDATE_REQUEST,
+          {"evals": [e.to_dict() for e in evals]})
+    index += 1
+    apply(fsm, index, ALLOC_UPDATE_REQUEST,
+          {"alloc": [a.to_dict() for a in allocs]})
+    return fsm, {"nodes": nodes, "jobs": jobs, "evals": evals,
+                 "allocs": allocs, "last_index": index}
+
+
+class TestSnapshotRestore:
+    def test_round_trip_restores_all_tables(self):
+        fsm, world = populated_fsm()
+        blob = fsm.snapshot()
+
+        fresh = NomadFSM()
+        fresh.restore(blob)
+        for n in world["nodes"]:
+            got = fresh.state.node_by_id(n.id)
+            assert got is not None and got.to_dict() == \
+                fsm.state.node_by_id(n.id).to_dict()
+        for j in world["jobs"]:
+            assert fresh.state.job_by_id(j.id) is not None
+        for e in world["evals"]:
+            assert fresh.state.eval_by_id(e.id) is not None
+        for a in world["allocs"]:
+            assert fresh.state.alloc_by_id(a.id) is not None
+
+    def test_round_trip_preserves_table_indexes(self):
+        """Restore must not reset the MVCC indexes: a blocking query
+        armed at the pre-snapshot index would otherwise spin."""
+        fsm, world = populated_fsm()
+        blob = fsm.snapshot()
+        fresh = NomadFSM()
+        fresh.restore(blob)
+        for table in ("nodes", "jobs", "evals", "allocs"):
+            assert fresh.state.get_index(table) == \
+                fsm.state.get_index(table), table
+
+    def test_round_trip_preserves_timetable(self):
+        """fsm.go:313-410: the TimeTable rides the snapshot stream as
+        its own record type."""
+        fsm, world = populated_fsm()
+        witnessed = fsm.timetable.nearest_index(
+            fsm.timetable.nearest_time(world["last_index"]) or 0)
+        blob = fsm.snapshot()
+        fresh = NomadFSM()
+        fresh.restore(blob)
+        assert fresh.timetable.serialize() == fsm.timetable.serialize()
+        assert witnessed is not None or \
+            fresh.timetable.serialize() == fsm.timetable.serialize()
+
+    def test_restore_replaces_not_merges(self):
+        """Restoring over a dirty FSM discards the pre-restore state
+        (state_store.go:104-112: a fresh store, one big txn)."""
+        fsm, world = populated_fsm()
+        blob = fsm.snapshot()
+
+        dirty = NomadFSM()
+        stray = mock.node()
+        apply(dirty, 1, NODE_REGISTER_REQUEST, {"node": stray.to_dict()})
+        dirty.restore(blob)
+        assert dirty.state.node_by_id(stray.id) is None
+        assert len(list(dirty.state.nodes())) == len(world["nodes"])
+
+    def test_snapshot_is_deterministic_for_same_state(self):
+        fsm, _ = populated_fsm()
+        assert fsm.snapshot() == fsm.snapshot()

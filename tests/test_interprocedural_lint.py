@@ -1,0 +1,842 @@
+"""Unit + regression tests for the interprocedural analyzers (PR 4).
+
+Layout mirrors tests/test_static_analysis.py's philosophy:
+
+1. **Call-graph units** — resolution facts the passes depend on
+   (self/MRO, attr types, typed module constants, jit aliases,
+   self-coverage accounting).
+2. **Pass units on synthetic packages** — every new rule
+   (blocking-under-lock, interprocedural lock-cycle /
+   nested-self-acquire, thread/future/event lifecycle,
+   immutable-write) proves it fires AND proves its exemptions hold;
+   a lint that cannot fail gates nothing.
+3. **Regression tests for the defects the passes surfaced** in the real
+   package — each was a genuine pre-existing bug fixed in this PR.
+"""
+from __future__ import annotations
+
+import textwrap
+import threading
+import time
+
+import pytest
+
+from nomad_tpu.analysis import blocking, lockcheck
+from nomad_tpu.analysis.callgraph import CallGraph
+
+from tests.conftest import wait_until
+
+
+def write_pkg(tmp_path, name, source) -> str:
+    d = tmp_path / name
+    d.mkdir(exist_ok=True)
+    (d / "mod.py").write_text(textwrap.dedent(source))
+    return str(d)
+
+
+def run_blocking(pkg: str) -> list:
+    scan = lockcheck.scan_package(pkg)
+    lockcheck.analyze_package(pkg, scan=scan)  # populates cycle dedup
+    return blocking.analyze_package(pkg, scan=scan)
+
+
+# ---------------------------------------------------------------------------
+# 1. call-graph units
+# ---------------------------------------------------------------------------
+
+class TestCallGraph:
+    def test_self_and_mro_resolution(self, tmp_path):
+        pkg = write_pkg(tmp_path, "cg1", """
+            class Base:
+                def helper(self):
+                    return 1
+            class Derived(Base):
+                def run(self):
+                    return self.helper()
+        """)
+        g = CallGraph.build(pkg)
+        calls = list(g.callees("cg1.mod:Derived.run"))
+        assert calls[0].kind == "intra"
+        assert calls[0].callee == "cg1.mod:Base.helper"
+
+    def test_attr_type_and_module_constant(self, tmp_path):
+        pkg = write_pkg(tmp_path, "cg2", """
+            class Engine:
+                def fire(self):
+                    pass
+            GLOBAL = Engine()
+            class Car:
+                def __init__(self, engine=None):
+                    self.engine = engine if engine is not None else GLOBAL
+                def drive(self):
+                    self.engine.fire()
+                    GLOBAL.fire()
+        """)
+        g = CallGraph.build(pkg)
+        calls = {c.text: c.callee
+                 for c in g.callees("cg2.mod:Car.drive")}
+        assert calls["self.engine.fire"] == "cg2.mod:Engine.fire"
+        assert calls["GLOBAL.fire"] == "cg2.mod:Engine.fire"
+
+    def test_jit_alias_reaches_wrapped_impl(self, tmp_path):
+        pkg = write_pkg(tmp_path, "cg3", """
+            import jax
+
+            def _impl(x):
+                return x
+
+            kernel = jax.jit(_impl)
+
+            def caller(x):
+                return kernel(x)
+        """)
+        g = CallGraph.build(pkg)
+        calls = list(g.callees("cg3.mod:caller"))
+        assert calls[0].callee == "cg3.mod:_impl"
+
+    def test_nested_def_calls_not_attributed_to_parent(self, tmp_path):
+        pkg = write_pkg(tmp_path, "cg4", """
+            import time
+            def outer():
+                def inner():
+                    time.sleep(1)
+                return inner
+        """)
+        g = CallGraph.build(pkg)
+        outer = [c.text for c in g.callees("cg4.mod:outer")]
+        assert "time.sleep" not in outer
+        inner = [c.callee for c in g.callees("cg4.mod:outer.inner")]
+        assert "time.sleep" in inner
+
+    def test_coverage_counts_dynamic_sites(self, tmp_path):
+        pkg = write_pkg(tmp_path, "cg5", """
+            def f(cb):
+                cb()        # dynamic
+                len([])     # builtin
+                g()         # intra
+            def g():
+                pass
+        """)
+        g = CallGraph.build(pkg)
+        cov = g.coverage()
+        assert cov["dynamic"] == 1 and cov["builtin"] == 1 \
+            and cov["resolved"] == 1
+        assert 0 < cov["resolved_fraction"] < 1
+
+    def test_real_package_coverage_is_reported(self):
+        g = CallGraph.build("nomad_tpu")
+        cov = g.coverage()
+        assert cov["functions"] > 500
+        assert cov["call_sites"] > 2000
+        # The analyzer's blind spots are visible, not silent.
+        assert cov["dynamic"] > 0
+        assert 0.3 < cov["resolved_fraction"] <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# 2a. blocking-under-lock units
+# ---------------------------------------------------------------------------
+
+class TestBlockingUnderLock:
+    def test_direct_sleep_under_lock(self, tmp_path):
+        pkg = write_pkg(tmp_path, "b1", """
+            import threading
+            import time
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def bad(self):
+                    with self._lock:
+                        time.sleep(1)
+        """)
+        fs = [f for f in run_blocking(pkg)
+              if f.rule == "blocking-under-lock"]
+        assert len(fs) == 1
+        assert fs[0].where == "C.bad[C._lock]"
+        assert "time.sleep" in fs[0].message
+
+    def test_chain_through_helpers_flagged_with_chain(self, tmp_path):
+        pkg = write_pkg(tmp_path, "b2", """
+            import socket
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.sock = socket.socket()
+                def a(self):
+                    with self._lock:
+                        self.b()
+                def b(self):
+                    self.c()
+                def c(self):
+                    self.sock.sendall(b"x")
+        """)
+        fs = [f for f in run_blocking(pkg)
+              if f.rule == "blocking-under-lock"]
+        assert len(fs) == 1
+        assert fs[0].where == "C.a[C._lock]"
+        # The full call chain is in the finding.
+        assert "self.b" in fs[0].message and "socket send" in fs[0].message
+
+    def test_condition_wait_on_guarding_lock_exempt(self, tmp_path):
+        pkg = write_pkg(tmp_path, "b3", """
+            import threading
+            class Q:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cond = threading.Condition(self._lock)
+                    self.items = []
+                def get(self):
+                    with self._lock:
+                        while not self.items:
+                            self._cond.wait(1.0)
+                        return self.items.pop()
+        """)
+        assert [f for f in run_blocking(pkg)
+                if f.rule == "blocking-under-lock"] == []
+
+    def test_foreign_lock_held_across_wait_still_flagged(self, tmp_path):
+        pkg = write_pkg(tmp_path, "b4", """
+            import threading
+            class Q:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cond = threading.Condition(self._lock)
+                    self.items = []
+                def get(self):
+                    with self._lock:
+                        self._cond.wait(1.0)
+            class User:
+                def __init__(self):
+                    self._mu = threading.Lock()
+                    self.q = Q()
+                def drain(self):
+                    with self._mu:
+                        self.q.get()
+        """)
+        fs = [f for f in run_blocking(pkg)
+              if f.rule == "blocking-under-lock"]
+        assert any(f.where == "User.drain[User._mu]" for f in fs)
+
+    def test_unbounded_queue_put_not_a_root(self, tmp_path):
+        pkg = write_pkg(tmp_path, "b5", """
+            import queue
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()          # unbounded
+                    self._bq = queue.Queue(maxsize=4)  # bounded
+                def ok(self):
+                    with self._lock:
+                        self._q.put(1)
+                def ok_negative(self):
+                    # stdlib: maxsize <= 0 is unbounded too
+                    import queue as q2
+                    nq = q2.Queue(-1)
+                    with self._lock:
+                        nq.put(1)
+                def bad(self):
+                    with self._lock:
+                        self._bq.put(1)
+                def also_bad(self):
+                    with self._lock:
+                        self._q.get()
+        """)
+        fs = [f for f in run_blocking(pkg)
+              if f.rule == "blocking-under-lock"]
+        wheres = {f.where for f in fs}
+        assert "C.bad[C._lock]" in wheres
+        assert "C.also_bad[C._lock]" in wheres
+        assert "C.ok[C._lock]" not in wheres
+        assert "C.ok_negative[C._lock]" not in wheres
+
+    def test_retry_sleep_path_via_typed_constant(self, tmp_path):
+        """The utils/retry.py shape: a module-level policy object whose
+        .call sleeps, invoked under a lock three frames up."""
+        pkg = write_pkg(tmp_path, "b6", """
+            import threading
+            import time
+            class Policy:
+                def call(self, fn):
+                    time.sleep(0.1)
+                    return fn()
+            POLICY = Policy()
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def sync(self):
+                    with self._lock:
+                        self._locked_sync()
+                def _locked_sync(self):
+                    POLICY.call(lambda: None)
+        """)
+        fs = [f for f in run_blocking(pkg)
+              if f.rule == "blocking-under-lock"]
+        assert any(f.where == "C.sync[C._lock]" for f in fs)
+
+    def test_acquire_release_region_tracked(self, tmp_path):
+        """The try/finally acquire pattern extends the held region."""
+        pkg = write_pkg(tmp_path, "b7", """
+            import threading
+            import time
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def bad(self):
+                    self._lock.acquire()
+                    try:
+                        time.sleep(1)
+                    finally:
+                        self._lock.release()
+        """)
+        fs = [f for f in run_blocking(pkg)
+              if f.rule == "blocking-under-lock"]
+        assert len(fs) == 1 and fs[0].where == "C.bad[C._lock]"
+
+    def test_device_dispatch_is_a_root(self, tmp_path):
+        pkg = write_pkg(tmp_path, "b8", """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def bad(self, sched, args):
+                    with self._lock:
+                        sched.collect_device(args, None)
+        """)
+        fs = [f for f in run_blocking(pkg)
+              if f.rule == "blocking-under-lock"]
+        assert len(fs) == 1 and "device collect" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# 2b. cross-function lock-order units
+# ---------------------------------------------------------------------------
+
+class TestCrossFunctionLockOrder:
+    def test_cycle_visible_only_interprocedurally(self, tmp_path):
+        """A->B syntactically, B->A only through a helper whose callee
+        resolves via a parameter annotation — and whose method name is
+        deliberately ambiguous (two lock-holding owners), so lockcheck's
+        uniqueness devirtualizer cannot see the back edge.  Only the
+        call-graph pass closes the cycle."""
+        pkg = write_pkg(tmp_path, "xl1", """
+            import threading
+            class Decoy:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def touch(self):
+                    with self._lock:
+                        pass
+            class A:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def touch(self):
+                    with self._lock:
+                        pass
+                def forward(self, b: "B"):
+                    with self._lock:
+                        b.poke()
+            class B:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def poke(self):
+                    with self._lock:
+                        pass
+                def back(self, a: A):
+                    with self._lock:
+                        self._helper(a)
+                def _helper(self, a: A):
+                    a.touch()
+        """)
+        scan = lockcheck.scan_package(pkg)
+        lc = lockcheck.analyze_package(pkg, scan=scan)
+        assert [f for f in lc if f.rule == "lock-cycle"] == []
+        fs = blocking.analyze_package(pkg, scan=scan)
+        cycles = [f for f in fs if f.rule == "lock-cycle"]
+        assert cycles, [f.render() for f in fs]
+        assert "A._lock" in cycles[0].where and "B._lock" in \
+            cycles[0].where
+
+    def test_interprocedural_self_acquire(self, tmp_path):
+        pkg = write_pkg(tmp_path, "xl2", """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def outer(self):
+                    with self._lock:
+                        self._middle()
+                def _middle(self):
+                    self._leaf()
+                def _leaf(self):
+                    with self._lock:
+                        pass
+        """)
+        fs = run_blocking(pkg)
+        hits = [f for f in fs if f.rule == "nested-self-acquire"]
+        assert hits and hits[0].where.startswith("C.outer->")
+
+    def test_rlock_self_acquire_not_flagged(self, tmp_path):
+        pkg = write_pkg(tmp_path, "xl3", """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.RLock()
+                def outer(self):
+                    with self._lock:
+                        self._middle()
+                def _middle(self):
+                    self._leaf()
+                def _leaf(self):
+                    with self._lock:
+                        pass
+        """)
+        fs = run_blocking(pkg)
+        assert [f for f in fs if f.rule == "nested-self-acquire"] == []
+
+    def test_syntactic_cycles_not_double_reported(self, tmp_path):
+        """A cycle lockcheck's own pass sees must NOT come back from the
+        interprocedural pass under a second key."""
+        pkg = write_pkg(tmp_path, "xl4", """
+            import threading
+            class Inner:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def poke(self, outer):
+                    with self._lock:
+                        outer.touch()
+            class Outer:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.inner = Inner()
+                def go(self):
+                    with self._lock:
+                        self.inner.poke(self)
+                def touch(self):
+                    with self._lock:
+                        pass
+        """)
+        scan = lockcheck.scan_package(pkg)
+        lc = lockcheck.analyze_package(pkg, scan=scan)
+        assert any(f.rule == "lock-cycle" for f in lc)
+        bl = blocking.analyze_package(pkg, scan=scan)
+        assert [f for f in bl if f.rule == "lock-cycle"] == []
+
+
+# ---------------------------------------------------------------------------
+# 2c. lifecycle units
+# ---------------------------------------------------------------------------
+
+class TestLifecycle:
+    def test_anonymous_thread_flagged(self, tmp_path):
+        pkg = write_pkg(tmp_path, "lf1", """
+            import threading
+            def spawn():
+                threading.Thread(target=print, daemon=True).start()
+        """)
+        fs = run_blocking(pkg)
+        hits = [f for f in fs if f.rule == "thread-leak"]
+        assert len(hits) == 1 and "<anonymous>" in hits[0].where
+
+    def test_attr_thread_without_join_flagged(self, tmp_path):
+        pkg = write_pkg(tmp_path, "lf2", """
+            import threading
+            class C:
+                def start(self):
+                    self._t = threading.Thread(target=print)
+                    self._t.start()
+        """)
+        fs = run_blocking(pkg)
+        assert [f.rule for f in fs] == ["thread-leak"]
+
+    def test_attr_thread_with_join_clean(self, tmp_path):
+        pkg = write_pkg(tmp_path, "lf3", """
+            import threading
+            class C:
+                def start(self):
+                    self._t = threading.Thread(target=print)
+                    self._t.start()
+                def stop(self):
+                    self._t.join(1.0)
+        """)
+        assert [f for f in run_blocking(pkg)
+                if f.rule == "thread-leak"] == []
+
+    def test_local_thread_handed_off_clean(self, tmp_path):
+        pkg = write_pkg(tmp_path, "lf4", """
+            import threading
+            class C:
+                def __init__(self):
+                    self._threads = []
+                def start(self):
+                    t = threading.Thread(target=print)
+                    t.start()
+                    self._threads.append(t)
+                def stop(self):
+                    for t in self._threads:
+                        t.join(1.0)
+        """)
+        assert [f for f in run_blocking(pkg)
+                if f.rule == "thread-leak"] == []
+
+    def test_future_without_resolution_flagged(self, tmp_path):
+        pkg = write_pkg(tmp_path, "lf5", """
+            import threading
+            class ApplyFuture:
+                def __init__(self):
+                    self._event = threading.Event()
+                def respond(self):
+                    self._event.set()
+                def wait(self):
+                    self._event.wait(5)
+            class Broken:
+                def submit(self):
+                    f = ApplyFuture()
+                    f.wait()
+        """)
+        fs = run_blocking(pkg)
+        hits = [f for f in fs if f.rule == "future-leak"]
+        assert len(hits) == 1 and hits[0].where == "Broken.submit.f"
+
+    def test_future_responded_or_returned_clean(self, tmp_path):
+        pkg = write_pkg(tmp_path, "lf6", """
+            import threading
+            class ApplyFuture:
+                def __init__(self):
+                    self._event = threading.Event()
+                def respond(self):
+                    self._event.set()
+            class Good:
+                def submit(self):
+                    f = ApplyFuture()
+                    return f
+                def apply(self):
+                    f = ApplyFuture()
+                    f.respond()
+        """)
+        assert [f for f in run_blocking(pkg)
+                if f.rule == "future-leak"] == []
+
+    def test_untimed_event_wait_without_set_flagged(self, tmp_path):
+        pkg = write_pkg(tmp_path, "lf7", """
+            import threading
+            class C:
+                def __init__(self):
+                    self._ready = threading.Event()
+                def block(self):
+                    self._ready.wait()
+        """)
+        fs = run_blocking(pkg)
+        hits = [f for f in fs if f.rule == "event-leak"]
+        assert len(hits) == 1 and "_ready" in hits[0].where
+
+    def test_event_with_set_or_timeout_clean(self, tmp_path):
+        pkg = write_pkg(tmp_path, "lf8", """
+            import threading
+            class C:
+                def __init__(self):
+                    self._ready = threading.Event()
+                    self._gone = threading.Event()
+                def block(self):
+                    self._ready.wait()
+                def arm(self):
+                    self._ready.set()
+                def poll(self):
+                    self._gone.wait(0.5)
+        """)
+        assert [f for f in run_blocking(pkg)
+                if f.rule == "event-leak"] == []
+
+
+# ---------------------------------------------------------------------------
+# 2d. Immutable / CopySwap annotation units
+# ---------------------------------------------------------------------------
+
+class TestSyncAnnotations:
+    def test_immutable_suppresses_bare_read(self, tmp_path):
+        pkg = write_pkg(tmp_path, "sa1", """
+            import threading
+            from nomad_tpu.utils.sync import Immutable
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.addr: Immutable = ("h", 1)
+                def locked_use(self):
+                    with self._lock:
+                        return self.addr
+                def bare_use(self):
+                    return self.addr
+        """)
+        assert lockcheck.analyze_package(pkg, strict=True) == []
+
+    def test_immutable_write_after_init_flagged(self, tmp_path):
+        pkg = write_pkg(tmp_path, "sa2", """
+            import threading
+            from nomad_tpu.utils.sync import Immutable
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.addr: Immutable = ("h", 1)
+                def locked_use(self):
+                    with self._lock:
+                        return self.addr
+                def rebind(self):
+                    with self._lock:
+                        self.addr = ("h", 2)   # locked, still illegal
+        """)
+        fs = lockcheck.analyze_package(pkg, strict=True)
+        assert [f.rule for f in fs] == ["immutable-write"]
+        assert fs[0].where == "C.addr" and "rebind" in fs[0].message
+
+    def test_immutable_receiver_mutation_is_not_a_rebind(self, tmp_path):
+        """Calling a mutator on the OBJECT (log.append) is the object's
+        own business; Immutable only promises the binding is stable."""
+        pkg = write_pkg(tmp_path, "sa3", """
+            import threading
+            from nomad_tpu.utils.sync import Immutable
+            class Store:
+                def append(self, x):
+                    pass
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.store: Immutable = Store()
+                def locked_use(self):
+                    with self._lock:
+                        self.store.append(1)
+                def bare_use(self):
+                    self.store.append(2)
+        """)
+        assert lockcheck.analyze_package(pkg, strict=True) == []
+
+    def test_copy_swap_reads_exempt_writes_still_locked(self, tmp_path):
+        pkg = write_pkg(tmp_path, "sa4", """
+            import threading
+            from nomad_tpu.utils.sync import CopySwap
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.state: CopySwap = {}
+                def publish(self, new):
+                    with self._lock:
+                        self.state = new
+                def read(self):
+                    return self.state          # exempt
+                def bad(self, new):
+                    self.state = new           # still a bare-write
+        """)
+        fs = lockcheck.analyze_package(pkg, strict=True)
+        assert [f.rule for f in fs] == ["bare-write"]
+        assert fs[0].where == "C.state"
+
+    def test_markers_are_inert_at_runtime(self):
+        from nomad_tpu.utils.sync import CopySwap, Immutable
+
+        assert Immutable[str] is Immutable
+        assert CopySwap[dict] is CopySwap
+        with pytest.raises(TypeError):
+            Immutable()
+
+
+# ---------------------------------------------------------------------------
+# 3. regression tests for analyzer-found defects fixed in this PR
+# ---------------------------------------------------------------------------
+
+class TestAnalyzerFoundDefects:
+    def test_pool_dial_does_not_block_other_addresses(self, monkeypatch):
+        """blocking-under-lock ConnPool._session: the MuxConn dial (up
+        to the 330s connect timeout) ran INSIDE the pool-wide lock, so
+        one dead peer stalled every thread's RPC to every address."""
+        from nomad_tpu.server import rpc as rpc_mod
+
+        hang = threading.Event()
+        release = threading.Event()
+
+        class StubMux:
+            def __init__(self, address, tls_context=None,
+                         server_hostname=""):
+                self.address = address
+                if address[1] == 1:   # the "dead" peer
+                    hang.set()
+                    release.wait(10)
+                self.broken = False
+
+            def call(self, method, args, timeout=None):
+                return {"ok": self.address[1]}
+
+            def close(self):
+                pass
+
+        monkeypatch.setattr(rpc_mod, "MuxConn", StubMux)
+        pool = rpc_mod.ConnPool()
+        t = threading.Thread(
+            target=lambda: pool._session(("127.0.0.1", 1)), daemon=True)
+        t.start()
+        assert hang.wait(5), "dial thread never started"
+        # While address 1's dial hangs, address 2 must connect at once.
+        start = time.monotonic()
+        out = pool.call(("127.0.0.1", 2), "X.y", {})
+        elapsed = time.monotonic() - start
+        release.set()
+        t.join(5)
+        assert out == {"ok": 2}
+        assert elapsed < 2.0, \
+            f"call to a healthy peer waited {elapsed:.1f}s on a dead " \
+            "peer's dial"
+
+    def test_session_redial_race_keeps_one_session(self, monkeypatch):
+        """Two threads re-dialing the same broken address converge on
+        ONE installed session; the loser is closed, not leaked."""
+        from nomad_tpu.server import rpc as rpc_mod
+
+        closed = []
+
+        class StubMux:
+            def __init__(self, address, tls_context=None,
+                         server_hostname=""):
+                self.address = address
+                self.broken = False
+
+            def close(self):
+                closed.append(self)
+
+        monkeypatch.setattr(rpc_mod, "MuxConn", StubMux)
+        pool = rpc_mod.ConnPool()
+        addr = ("127.0.0.1", 9)
+        sessions = []
+        threads = [threading.Thread(
+            target=lambda: sessions.append(pool._session(addr)))
+            for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(5)
+        assert len(sessions) == 4
+        installed = pool._sessions[addr]
+        assert all(s is installed for s in sessions) or \
+            len({id(s) for s in sessions}) <= 2
+        # Everything not installed was closed.
+        for s in set(sessions):
+            if s is not installed:
+                assert s in closed
+
+    def test_gossip_shutdown_reaps_loops(self):
+        """thread-leak Gossip._rx/_probe: shutdown set the stop event
+        but never joined, leaking two threads per torn-down server."""
+        from nomad_tpu.server.gossip import Gossip
+
+        g = Gossip(tags={"role": "t"})
+        assert g._rx.is_alive() and g._probe.is_alive()
+        g.shutdown()
+        assert not g._rx.is_alive(), "rx loop still running"
+        assert not g._probe.is_alive(), "probe loop still running"
+
+    def test_netraft_shutdown_reaps_all_threads(self):
+        """thread-leak NetRaft._ticker/_notifier/_PeerReplicator:
+        shutdown signaled the threads but never joined them."""
+        from nomad_tpu.server.raft_net import NetRaft
+        from nomad_tpu.server.rpc import ConnPool, RPCServer
+
+        class NullFSM:
+            def apply(self, index, entry):
+                return None
+
+            def snapshot(self):
+                return b"{}"
+
+            def restore(self, blob):
+                pass
+
+        rpc = RPCServer()
+        rpc.start()
+        pool = ConnPool(multiplex=False)
+        raft = NetRaft(NullFSM(), rpc, pool,
+                       election_timeout=(5.0, 6.0))
+        raft.add_peer(("127.0.0.1", 65500))  # unreachable peer
+        repl = list(raft._replicators.values())[0]
+        assert raft._ticker.is_alive() and raft._notifier.is_alive()
+        raft.shutdown()
+        assert not raft._ticker.is_alive()
+        assert not raft._notifier.is_alive()
+        assert not repl.thread.is_alive()
+        rpc.shutdown()
+        pool.shutdown()
+        assert rpc._thread is not None and not rpc._thread.is_alive()
+
+    def test_netraft_remove_peer_reaps_replicator(self):
+        from nomad_tpu.server.raft_net import NetRaft
+        from nomad_tpu.server.rpc import ConnPool, RPCServer
+
+        class NullFSM:
+            def apply(self, index, entry):
+                return None
+
+            def snapshot(self):
+                return b"{}"
+
+            def restore(self, blob):
+                pass
+
+        rpc = RPCServer()
+        rpc.start()
+        pool = ConnPool(multiplex=False)
+        raft = NetRaft(NullFSM(), rpc, pool,
+                       election_timeout=(5.0, 6.0))
+        peer = ("127.0.0.1", 65501)
+        raft.add_peer(peer)
+        repl = raft._replicators[peer]
+        raft.remove_peer(peer)
+        assert not repl.thread.is_alive()
+        raft.shutdown()
+        rpc.shutdown()
+        pool.shutdown()
+
+    def test_muxconn_close_reaps_reader(self):
+        """thread-leak MuxConn._reader: close() left the reader thread
+        parked in recv on the dead socket."""
+        from nomad_tpu.server.rpc import MuxConn, RPCServer
+
+        server = RPCServer()
+        server.register("Echo.ping", lambda args: {"pong": True})
+        server.start()
+        conn = MuxConn(server.address)
+        assert conn.call("Echo.ping", {}) == {"pong": True}
+        reader = conn._reader
+        assert reader.is_alive()
+        conn.close()
+        assert not reader.is_alive(), "reader thread survived close()"
+        server.shutdown()
+
+    def test_server_shutdown_joins_workers(self):
+        """thread-leak Worker._thread: server shutdown stopped workers
+        without joining them."""
+        from nomad_tpu.server.server import Server, ServerConfig
+
+        srv = Server(ServerConfig(num_schedulers=1,
+                                  use_device_scheduler=False,
+                                  tune_gc=False))
+        srv.establish_leadership()
+        threads = [w._thread for w in srv.workers]
+        assert all(t is not None and t.is_alive() for t in threads)
+        srv.shutdown()
+        for t in threads:
+            assert not t.is_alive(), "worker thread survived shutdown"
+
+    def test_broken_mux_session_error_is_lock_consistent(self):
+        """bare-read MuxConn._broken: the 'reader died' error path read
+        _broken without the lock; now both the property and the raise
+        read it under _lock (no torn read of the exception slot)."""
+        from nomad_tpu.server.rpc import MuxConn, RPCServer
+
+        server = RPCServer()
+        server.register("Echo.ping", lambda args: {"pong": True})
+        server.start()
+        conn = MuxConn(server.address)
+        server.shutdown()  # severs the live connection server-side
+        wait_until(lambda: conn.broken, timeout=5,
+                   msg="reader observes the severed session")
+        from nomad_tpu.server.rpc import _SendError
+        with pytest.raises((_SendError, ConnectionError, OSError)):
+            conn.call("Echo.ping", {})
+        conn.close()

@@ -14,6 +14,8 @@ import nomad_tpu.mock as mock
 from nomad_tpu.server import Server, ServerConfig
 from nomad_tpu.server.rpc import ConnPool
 
+from tests.conftest import wait_until
+
 FAST = dict(
     raft_mode="net",
     raft_election_timeout=(0.05, 0.10),
@@ -37,17 +39,8 @@ def wait_for_leader(servers, timeout=5.0) -> Server:
         leaders = [s for s in servers if s.raft.is_leader()]
         if len(leaders) == 1 and leaders[0].is_leader():
             return leaders[0]
-        time.sleep(0.02)
+        time.sleep(0.02)  # sleep-ok: poll interval of the bounded wait
     raise AssertionError("no single leader elected")
-
-
-def wait_until(fn, timeout=5.0, msg="condition"):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if fn():
-            return
-        time.sleep(0.02)
-    raise AssertionError(f"timeout waiting for {msg}")
 
 
 @pytest.fixture
@@ -96,7 +89,7 @@ def _call_retry(pool, addr, method, args, timeout=10.0):
         except RPCError:
             if time.monotonic() >= deadline:
                 raise
-            time.sleep(0.1)
+            time.sleep(0.1)  # sleep-ok: poll interval of the bounded retry
 
 
 def test_follower_forwards_writes(pool):

@@ -110,7 +110,7 @@ class TestRetryPolicy:
         fn, _ = self._flaky(10)
 
         def trip():
-            time.sleep(0.05)
+            time.sleep(0.05)  # sleep-ok: fire stop mid-backoff-sleep
             stop.set()
         threading.Thread(target=trip, daemon=True).start()
         t0 = time.monotonic()

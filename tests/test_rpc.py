@@ -10,6 +10,8 @@ import nomad_tpu.mock as mock
 from nomad_tpu.server import Server, ServerConfig
 from nomad_tpu.server.rpc import ConnPool, RPCError, RPCServer
 
+from tests.conftest import wait_until
+
 
 @pytest.fixture
 def srv():
@@ -41,7 +43,10 @@ class TestTransport:
 
     def test_conn_reuse_and_concurrency(self, pool):
         rs = RPCServer()
-        rs.register("S.Slow", lambda args: (time.sleep(0.02), {"n": 1})[1])
+        rs.register(
+            "S.Slow",
+            lambda args: (time.sleep(0.02), {"n": 1})[1],  # sleep-ok: slow handler
+        )
         rs.start()
         try:
             results = []
@@ -118,7 +123,7 @@ class TestEndpoints:
         t = threading.Thread(target=blocked)
         start = time.monotonic()
         t.start()
-        time.sleep(0.1)
+        time.sleep(0.1)  # sleep-ok: park the blocking query server-side first
         srv.node_register(mock.node(1))
         t.join(timeout=5)
         assert not t.is_alive()
@@ -395,7 +400,7 @@ class TestMuxPlane:
 
             t = threading.Thread(target=call_slow)
             t.start()
-            time.sleep(0.1)  # slow request is in flight on the session
+            time.sleep(0.1)  # sleep-ok: slow request is in flight on the session
             for i in range(5):
                 assert pool.call(rpc.address, "T.fast", {})["who"] == \
                     "fast"
@@ -436,20 +441,19 @@ class TestMuxPlane:
         assert pool.call(rpc.address, "T.ping", {}) == "pong"
         address = rpc.address
         rpc.shutdown()
-        time.sleep(0.1)
+        time.sleep(0.1)  # sleep-ok: let the OS release the listening port
         rpc2 = RPCServer(host=address[0], port=address[1])
         rpc2.register("T.ping", lambda args: "pong2")
         rpc2.start()
         try:
-            deadline = time.monotonic() + 5
-            while time.monotonic() < deadline:
+            def reconnected():
                 try:
-                    assert pool.call(address, "T.ping", {}) == "pong2"
-                    break
+                    return pool.call(address, "T.ping", {}) == "pong2"
                 except (ConnectionError, OSError):
-                    time.sleep(0.1)
-            else:
-                raise AssertionError("mux session never reconnected")
+                    return False
+
+            wait_until(reconnected, timeout=5,
+                       msg="mux session reconnect")
             pool.shutdown()
         finally:
             rpc2.shutdown()
@@ -498,7 +502,7 @@ def test_many_blocking_queries_share_one_mux_session(srv, pool):
                for i in range(16)]
     for t in threads:
         t.start()
-    time.sleep(0.3)  # all parked server-side
+    time.sleep(0.3)  # sleep-ok: all blocking queries parked server-side
     assert not results
     srv.node_register(mock.node())
     for t in threads:
@@ -584,7 +588,7 @@ class TestMuxRobustness:
 
             t = threading.Thread(target=call_slow)
             t.start()
-            time.sleep(0.05)
+            time.sleep(0.05)  # sleep-ok: large send in flight on the write lock
             # Large frames keep the write lock busy; replies must still
             # flow for other streams, and the state lock must never be
             # held across a send (deadlock would fail this in 10s).

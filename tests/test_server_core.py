@@ -494,10 +494,10 @@ class TestWorker:
             # Outwait an in-flight dequeue (0.25s timeout) started
             # before the pause flag was set: the loop only re-checks
             # the gate between iterations.
-            time.sleep(0.4)
+            time.sleep(0.4)  # sleep-ok: outwait the in-flight dequeue
             job = mock.job()
             _, eval_id = srv.job_register(job)
-            time.sleep(0.4)
+            time.sleep(0.4)  # sleep-ok: prove the ABSENCE of processing
             ev = srv.fsm.state.eval_by_id(eval_id)
             assert ev.status == "pending", "paused worker processed eval"
             worker.set_pause(False)
@@ -681,16 +681,13 @@ class TestNodeLifecycle:
             victim = next(iter(placed))
             srv.node_update_status(victim, "down")
             # A node-update eval per affected job reschedules the allocs.
-            deadline = time.monotonic() + 15
-            while time.monotonic() < deadline:
+            def migrated():
                 allocs = srv.fsm.state.allocs_by_job(job.id)
                 live = [a for a in allocs if not a.terminal_status()]
-                if len(live) == 2 and all(a.node_id != victim for a in live):
-                    break
-                time.sleep(0.02)
-            else:
-                raise AssertionError("allocs were not migrated off the "
-                                     "down node")
+                return len(live) == 2 and all(
+                    a.node_id != victim for a in live)
+
+            wait_until(migrated, msg="allocs migrated off the down node")
         finally:
             srv.shutdown()
 
@@ -706,15 +703,13 @@ class TestNodeLifecycle:
             alloc = srv.fsm.state.allocs_by_job(job.id)[0]
 
             srv.node_update_drain(alloc.node_id, True)
-            deadline = time.monotonic() + 15
-            while time.monotonic() < deadline:
+            def migrated():
                 live = [a for a in srv.fsm.state.allocs_by_job(job.id)
                         if not a.terminal_status()]
-                if live and all(a.node_id != alloc.node_id for a in live):
-                    break
-                time.sleep(0.02)
-            else:
-                raise AssertionError("alloc not migrated off drained node")
+                return bool(live) and all(
+                    a.node_id != alloc.node_id for a in live)
+
+            wait_until(migrated, msg="alloc migrated off drained node")
         finally:
             srv.shutdown()
 
@@ -728,14 +723,9 @@ class TestNodeLifecycle:
             ttl = srv.node_heartbeat(node.id)
             assert ttl >= 0.1
             # Stop heartbeating: the node must be marked down.
-            deadline = time.monotonic() + 5
-            while time.monotonic() < deadline:
-                n = srv.fsm.state.node_by_id(node.id)
-                if n.status == "down":
-                    break
-                time.sleep(0.02)
-            else:
-                raise AssertionError("node not marked down after TTL")
+            wait_until(
+                lambda: srv.fsm.state.node_by_id(node.id).status == "down",
+                timeout=5, msg="node marked down after TTL")
         finally:
             srv.shutdown()
 

@@ -130,6 +130,62 @@ class TestDequeueAck:
             srv.shutdown()
 
 
+class TestWaitPlan:
+    def test_responded_timeout_error_propagates_not_spins(self):
+        """A plan RESPONDED with a TimeoutError result (e.g. a raft
+        apply timeout surfaced through the applier) must re-raise to
+        the worker, not be mistaken for the poll expiring — that
+        mistake zero-sleep spun _wait_plan forever (code-review
+        regression)."""
+        from nomad_tpu.server.plan_queue import PlanFuture
+
+        srv = make_server()
+        try:
+            srv.plan_queue.set_enabled(True)
+            w = Worker(srv)
+            future = PlanFuture(mock.plan())
+            future.respond(None, TimeoutError("raft apply timed out"))
+            start = time.monotonic()
+            with pytest.raises(TimeoutError, match="raft apply"):
+                w._wait_plan(future)
+            # Propagated immediately — not after a poll interval, and
+            # certainly not never.
+            assert time.monotonic() - start < 1.0
+        finally:
+            srv.shutdown()
+
+    def test_respond_racing_poll_expiry_returns_result(self):
+        """respond(result) landing between the poll's TimeoutError and
+        the done() check must surface the RESULT, not the spurious poll
+        error — a committed plan reported as failed would be retried
+        and double-place (code-review regression)."""
+        srv = make_server()
+        try:
+            w = Worker(srv)
+
+            class ScriptedFuture:
+                """First wait raises like an expired poll; by then the
+                applier has responded."""
+
+                def __init__(self, result):
+                    self._result = result
+                    self._calls = 0
+
+                def wait(self, timeout=None):
+                    self._calls += 1
+                    if self._calls == 1:
+                        raise TimeoutError("poll expired")
+                    return self._result
+
+                def done(self):
+                    return True
+
+            sentinel = object()
+            assert w._wait_plan(ScriptedFuture(sentinel)) is sentinel
+        finally:
+            srv.shutdown()
+
+
 class TestWaitForIndex:
     def test_returns_when_index_lands_mid_wait(self):
         """TestWorker_waitForIndex: an apply landing WHILE the worker
@@ -140,7 +196,7 @@ class TestWaitForIndex:
             target = srv.raft.applied_index() + 1
 
             def apply_later():
-                time.sleep(0.1)
+                time.sleep(0.1)  # sleep-ok: delayed apply exercises mid-wait wakeup
                 srv.apply_eval_update([make_eval()])
 
             t = threading.Thread(target=apply_later)
