@@ -756,6 +756,7 @@ def main() -> None:
         [make_eval(j) for j in jw])
     cont_dev = cont_seq = float("inf")
     dev_placed = dev_conflicts = seq_placed = 0
+    dev_commits = dev_committed = dev_fallbacks = 0
     for _ in range(args.repeats):
         hc, jc5 = _contended_setup()
         t0 = time.perf_counter()
@@ -767,6 +768,9 @@ def main() -> None:
             cont_dev = dt
             dev_placed = _placed_in_state(hc)
             dev_conflicts = hc.planner.conflicts
+            dev_commits = hc.planner.commits
+            dev_committed = hc.planner.committed_plans
+            dev_fallbacks = hc.planner.conflict_fallbacks
 
         hs, js5 = _contended_setup()
         t0 = time.perf_counter()
@@ -787,13 +791,26 @@ def main() -> None:
         "nodes": cont_nodes, "storm_groups": cont_groups,
         "placed": dev_placed, "seq_placed": seq_placed,
         "plan_conflicts": dev_conflicts,
+        # Group-commit window stats (ops/plan_conflict.py +
+        # VerifyingPlanner.submit_plans): commits = serialized commit
+        # operations the whole storm paid (vs one per plan before);
+        # batch_occupancy = mean plans per commit; conflict_fallbacks =
+        # window plans whose claims overlapped an earlier plan and took
+        # the exact order-sensitive path.
+        "commits": dev_commits,
+        "commits_per_sec": round(dev_commits / cont_dev, 2),
+        "batch_occupancy": round(dev_committed / max(1, dev_commits), 2),
+        "conflict_fallbacks": dev_fallbacks,
     }
     note(f"config5b contended storm {args.storm_jobs} evals x "
          f"{cont_groups}tg on {cont_nodes}n through the verifying "
          f"applier: {cont_dev:.3f}s ({args.storm_jobs / cont_dev:.1f}/s, "
          f"{dev_conflicts} plan conflicts, {dev_placed} placed) vs "
          f"sequential {cont_seq:.3f}s ({args.storm_jobs / cont_seq:.1f}/s,"
-         f" {seq_placed} placed) -> {cont_seq / cont_dev:.1f}x")
+         f" {seq_placed} placed) -> {cont_seq / cont_dev:.1f}x; "
+         f"group commit: {dev_commits} commits "
+         f"({dev_committed / max(1, dev_commits):.1f} plans/commit, "
+         f"{dev_fallbacks} conflict fallbacks)")
 
     # Headline = the north-star metric BASELINE.md defines the 50x target
     # on: config 4 (10k nodes x 1k TGs) evals/sec vs the in-process

@@ -246,7 +246,19 @@ def _net_row(alloc: Allocation):
     exactly the set NetworkIndex.add_allocs accounts
     (structs/network.py:87-95, reference nomad/structs/network.go
     AddAllocs) — or None when the alloc reserves no network.  Offers
-    spanning multiple ips or devices get NET_KEY_ODD."""
+    spanning multiple ips or devices get NET_KEY_ODD.  Cached on the
+    alloc under the same immutability contract as ``alloc_vec`` (store
+    objects are replaced, never mutated) — the plan verifier reads the
+    row once per verify and once per window fold."""
+    row = alloc.__dict__.get("_net_row")
+    if row is not None:
+        return row[0]
+    row = (_net_row_build(alloc),)
+    alloc.__dict__["_net_row"] = row
+    return row[0]
+
+
+def _net_row_build(alloc: Allocation):
     ports: list = []
     mbits = 0
     key = None

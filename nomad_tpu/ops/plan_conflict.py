@@ -1,0 +1,449 @@
+"""Vectorized cross-plan conflict windows for the group-commit applier.
+
+The leader's plan applier is the serialization point of optimistic
+concurrency (server/plan_apply.py): under a contended storm it pays one
+verify + one commit per plan.  ``evaluate_window`` restructures the
+verify side for a whole *window* of pending plans:
+
+  - the per-node resource fit — the numpy-churn hot loop of
+    ``_evaluate_plan_vec`` — is computed for every (plan, node) claim in
+    the window with a handful of dense array ops against the base
+    snapshot's incremental usage mirror (models/fleet.py UsageMirror);
+  - order sensitivity is preserved exactly by a *window overlay* over
+    the mirror (``_WindowState``): plans are judged in eval order, and
+    each plan's accepted portion is folded into the overlay before the
+    next plan's verdicts — so plan i's claims are checked against
+    committed state plus every earlier non-conflicting claim in the
+    window, exactly the state sequential application would have reached;
+  - claims the incremental path cannot serve (node not in the fleet,
+    odd network topology) punt to the exact scalar walk against an
+    OptimisticSnapshot carrying the same folds, exactly as the per-plan
+    verifier punts them.
+
+A plan whose claims overlap an earlier plan in the window (the
+order-sensitive prefix conflict) is reported as a ``fallback`` — its
+verdicts rode the window overlay rather than the clean dense pass — and
+counted by the applier's ``conflict_fallbacks`` stat.
+
+Results are identical to calling ``evaluate_plan`` per plan in eval
+order with the accepted portion of each plan folded into the view before
+the next — the property the group-commit parity test
+(tests/test_plan_batch.py) locks down.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from nomad_tpu.structs import PlanResult
+
+from nomad_tpu.utils.metrics import metrics
+
+_MISS = object()
+
+
+class WindowOutcome:
+    """One plan's verdict within a window."""
+
+    __slots__ = ("result", "fallback")
+
+    def __init__(self, result: PlanResult, fallback: bool) -> None:
+        self.result = result
+        # True when this plan's claims overlapped an earlier plan in the
+        # window (or an in-flight apply) — the order-sensitive prefix
+        # conflict: its verdicts came from the window overlay, not the
+        # clean dense pass.
+        self.fallback = fallback
+
+
+class _OverGet:
+    """dict-shaped ``.get`` view: window overrides chained over the base
+    mirror's dict.  An override of None is a tombstone (entry removed
+    within the window)."""
+
+    __slots__ = ("over", "base")
+
+    def __init__(self, over: dict, base: dict) -> None:
+        self.over = over
+        self.base = base
+
+    def get(self, key, default=None):
+        v = self.over.get(key, _MISS)
+        if v is _MISS:
+            return self.base.get(key, default)
+        return default if v is None else v
+
+
+class _DupGet:
+    """``node_dup``-shaped view: duplicate-port counts recomputed from
+    the window's materialized per-node port dicts, base passthrough for
+    untouched nodes.  Port dicts are tens of entries, so the recompute
+    is cheaper than incremental bookkeeping is error-prone."""
+
+    __slots__ = ("ports", "base")
+
+    def __init__(self, ports: dict, base: dict) -> None:
+        self.ports = ports
+        self.base = base
+
+    def get(self, ni, default=None):
+        pc = self.ports.get(ni)
+        if pc is None:
+            return self.base.get(ni, default)
+        dup = sum(1 for c in pc.values() if c > 1)
+        return dup if dup else default
+
+
+class _WindowState:
+    """Window overlay over a SYNCED UsageMirror: base state plus the
+    accepted portions of earlier plans in the window (and any in-flight
+    apply overlay), exposing exactly the reads the verifier needs —
+    the same ``net_rows/node_ports/node_dup/node_bw/node_net_keys``
+    surface ``plan_apply._verify_node_net`` consumes, plus per-node
+    4-dim usage deltas for the fit check.  Never mutates the mirror:
+    per-node dicts are copied on first window write.
+
+    Caller holds the mirror lock for the lifetime of this object."""
+
+    def __init__(self, mirror, statics) -> None:
+        from nomad_tpu.models.fleet import _net_row, alloc_vec
+
+        self._net_row = _net_row
+        self._alloc_vec = alloc_vec
+        self.m = mirror
+        self.index_of = statics.index_of
+        self.usage_delta: dict = {}   # ni -> [f, f, f, f]
+        self._rows: dict = {}         # aid -> (ni, vec) | None
+        self._net_over: dict = {}     # aid -> net row | None
+        self._ports: dict = {}        # ni -> merged {port: count}
+        self._bw: dict = {}           # ni -> merged mbits
+        self._keys: dict = {}         # ni -> merged {(ip, dev): count}
+        # The verifier-facing surface:
+        self.net_rows = _OverGet(self._net_over, mirror.net_rows)
+        self.node_ports = _OverGet(self._ports, mirror.node_ports)
+        self.node_bw = _OverGet(self._bw, mirror.node_bw)
+        self.node_net_keys = _OverGet(self._keys, mirror.node_net_keys)
+        self.node_dup = _DupGet(self._ports, mirror.node_dup)
+
+    # -- removal accounting (the caller's removed_ids walk) ---------------
+    def alloc_row(self, aid):
+        """(ni, vec) of a live alloc — window override first, then the
+        mirror — or None when absent/removed."""
+        v = self._rows.get(aid, _MISS)
+        if v is not _MISS:
+            return v
+        row = self.m.alloc_rows.get(aid)
+        return None if row is None else (row[0], row[1])
+
+    # -- copy-on-write materialization ------------------------------------
+    def _ports_for(self, ni) -> dict:
+        pc = self._ports.get(ni)
+        if pc is None:
+            pc = self._ports[ni] = dict(self.m.node_ports.get(ni, ()))
+        return pc
+
+    def _keys_for(self, ni) -> dict:
+        keys = self._keys.get(ni)
+        if keys is None:
+            keys = self._keys[ni] = dict(
+                self.m.node_net_keys.get(ni, ()))
+        return keys
+
+    def _bw_add(self, ni, mbits) -> None:
+        self._bw[ni] = self.node_bw.get(ni, 0) + mbits
+
+    # -- folds -------------------------------------------------------------
+    def fold(self, alloc) -> None:
+        """Apply one accepted alloc (placement or eviction) to the
+        window overlay — the same old-row-out/new-row-in transition the
+        mirror's own delta sync performs on commit."""
+        aid = alloc.id
+        old = self.alloc_row(aid)
+        if old is not None:
+            ni0, vec0 = old
+            d = self.usage_delta.setdefault(ni0, [0.0] * 4)
+            d[0] -= float(vec0[0])
+            d[1] -= float(vec0[1])
+            d[2] -= float(vec0[2])
+            d[3] -= float(vec0[3])
+        self._rows[aid] = None
+        nr = self.net_rows.get(aid)
+        if nr is not None:
+            ni0, ports, mbits, key = nr
+            if mbits:
+                self._bw_add(ni0, -mbits)
+            keys = self._keys_for(ni0)
+            c = keys.get(key, 0) - 1
+            if c > 0:
+                keys[key] = c
+            else:
+                keys.pop(key, None)
+            if ports:
+                pc = self._ports_for(ni0)
+                for p in ports:
+                    c = pc.get(p, 0) - 1
+                    if c > 0:
+                        pc[p] = c
+                    else:
+                        pc.pop(p, None)
+        self._net_over[aid] = None
+
+        if alloc.terminal_status():
+            return
+        ni = self.index_of.get(alloc.node_id, -1)
+        if ni < 0:
+            return
+        vec = self._alloc_vec(alloc)
+        self._rows[aid] = (ni, vec)
+        d = self.usage_delta.setdefault(ni, [0.0] * 4)
+        d[0] += float(vec[0])
+        d[1] += float(vec[1])
+        d[2] += float(vec[2])
+        d[3] += float(vec[3])
+        row = self._net_row(alloc)
+        if row is not None:
+            ports, mbits, key = row
+            self._net_over[aid] = (ni, ports, mbits, key)
+            if mbits:
+                self._bw_add(ni, mbits)
+            keys = self._keys_for(ni)
+            keys[key] = keys.get(key, 0) + 1
+            if ports:
+                pc = self._ports_for(ni)
+                for p in ports:
+                    pc[p] = pc.get(p, 0) + 1
+
+
+def _touched(plan) -> set:
+    return set(plan.node_update) | set(plan.node_allocation)
+
+
+def _accepted_allocs(result) -> list:
+    allocs = []
+    for updates in result.node_update.values():
+        allocs.extend(updates)
+    for placements in result.node_allocation.values():
+        allocs.extend(placements)
+    allocs.extend(result.failed_allocs)
+    return allocs
+
+
+def evaluate_window(snap, plans: list) -> list:
+    """Verify a window of plans in eval order; returns one WindowOutcome
+    per plan, results identical to sequential ``evaluate_plan`` +
+    fold-into-overlay per plan.
+
+    ``snap`` may be an OptimisticSnapshot carrying an in-flight apply's
+    overlay; it is MUTATED — each plan's accepted portion is folded in so
+    the caller's overlay ends up exactly as sequential application would
+    leave it.
+    """
+    from nomad_tpu.server.plan_apply import (
+        OptimisticSnapshot,
+        evaluate_plan,
+    )
+
+    overlay = snap if isinstance(snap, OptimisticSnapshot) \
+        else OptimisticSnapshot(snap)
+    if len(plans) == 1:
+        # No cross-plan structure to exploit: the per-plan path already
+        # carries its own vectorized fit (plan_apply._evaluate_plan_vec).
+        # Same fallback definition as the window paths — overlap with
+        # the in-flight apply's overlay counts.
+        fallback = bool(_touched(plans[0])
+                        & {n for n in overlay._by_node if n})
+        result = evaluate_plan(snap, plans[0])
+        if overlay is snap:
+            # Only a caller-owned overlay needs the fold; a throwaway
+            # one built here is dead work.
+            overlay.upsert_allocs(_accepted_allocs(result))
+        return [WindowOutcome(result, fallback)]
+
+    start = time.perf_counter()
+    outcomes = _evaluate_window_vec(overlay, plans)
+    if outcomes is None:
+        # No incremental mirror for this snapshot: per-plan exact path
+        # against the running overlay, still in eval order.
+        outcomes = []
+        dirty: set = {n for n in overlay._by_node if n}
+        for plan in plans:
+            nodes = _touched(plan)
+            result = evaluate_plan(overlay, plan)
+            outcomes.append(WindowOutcome(result, bool(nodes & dirty)))
+            overlay.upsert_allocs(_accepted_allocs(result))
+            # Same fallback definition as the vec path's `claimed`:
+            # every node an earlier plan TOUCHED (accepted or not), so
+            # the stat means one thing regardless of which path ran.
+            dirty |= nodes
+    metrics.measure_since("nomad.plan.evaluate_window", start)
+    return outcomes
+
+
+def _evaluate_window_vec(overlay, plans: list) -> Optional[list]:
+    """The vectorized window pass: dense base fit for every claim, then
+    an in-order verdict walk against the window overlay.  Returns None
+    when the snapshot cannot take the incremental path at all."""
+    from nomad_tpu.models.fleet import alloc_vec, fleet_cache, mirror_for
+    from nomad_tpu.server.plan_apply import (
+        _evaluate_node_plan,
+        _verify_node_net,
+    )
+    from nomad_tpu.structs import NODE_STATUS_READY
+
+    base = overlay.base
+    if getattr(base, "_t", None) is None:
+        return None
+    if not any(any(p.node_allocation.values()) for p in plans):
+        # Evict/update-only window: every per-node verdict is True by
+        # definition; don't spin up the mirror's net tracking for it.
+        # The fallback stat keeps the uniform definition (claims
+        # overlapping an earlier plan's touched nodes) even though the
+        # verdicts here are state-independent.
+        outcomes = []
+        claimed = {n for n in overlay._by_node if n}
+        for plan in plans:
+            nodes = _touched(plan)
+            result = PlanResult(
+                node_update={k: v for k, v in plan.node_update.items()
+                             if v},
+                node_allocation={k: v for k, v
+                                 in plan.node_allocation.items() if v},
+                failed_allocs=list(plan.failed_allocs))
+            outcomes.append(WindowOutcome(result, bool(nodes & claimed)))
+            overlay.upsert_allocs(_accepted_allocs(result))
+            claimed |= nodes
+        return outcomes
+
+    statics = fleet_cache.statics_for(base)
+    mirror = mirror_for(statics)
+    capacity = statics.capacity
+    index_of = statics.index_of
+
+    # The net dicts are mutated in place by concurrent worker syncs;
+    # hold the mirror for the whole composite read (same discipline as
+    # the per-plan vector pass).
+    with mirror.lock:
+        if not mirror.sync_net(base):
+            return None  # snapshot older than the mirror: scalar truth
+        usage = mirror.usage
+
+        # Pass 1: classify every (plan, node) claim; gather the
+        # placement-carrying in-fleet ones into flat arrays for ONE
+        # dense base-fit pass (usage + reserved + sum-of-placements).
+        verdicts: list = [dict() for _ in plans]
+        pairs: list = []     # (plan_i, nid, ni, node, placements, removed)
+        vec_rows: list = []  # placement resource vectors
+        vec_pair: list = []  # pair index per vec row
+        for i, plan in enumerate(plans):
+            pv = verdicts[i]
+            for nid in _touched(plan):
+                placements = plan.node_allocation.get(nid)
+                if not placements:
+                    pv[nid] = True  # evict-only claims always fit
+                    continue
+                node = base.node_by_id(nid)
+                if node is None or node.status != NODE_STATUS_READY \
+                        or node.drain:
+                    pv[nid] = False
+                    continue
+                ni = index_of.get(nid, -1)
+                if ni < 0:
+                    pv[nid] = None  # not in fleet: exact walk
+                    continue
+                removed = {a.id for a in plan.node_update.get(nid, ())}
+                removed.update(a.id for a in placements)  # in-place upd
+                pair = len(pairs)
+                pairs.append((i, nid, ni, node, placements, removed))
+                for a in placements:
+                    vec_pair.append(pair)
+                    vec_rows.append(alloc_vec(a))
+
+        base_used: list = []
+        caps: list = []
+        if pairs:
+            # Dense fit inputs over every claim at once: the 4 dims
+            # Resources.superset checks, float32 like the mirror rows
+            # (exact for values < 2^24, i.e. any realistic node).
+            ni_arr = np.fromiter((p[2] for p in pairs), dtype=np.int64,
+                                 count=len(pairs))
+            delta = np.zeros((len(pairs), 4), dtype=np.float32)
+            np.add.at(delta, np.asarray(vec_pair, dtype=np.int64),
+                      np.asarray(vec_rows, dtype=np.float32)[:, :4])
+            used = usage[ni_arr, :4] + statics.reserved[ni_arr, :4] \
+                + delta
+            base_used = used.tolist()
+            caps = capacity[ni_arr, :4].tolist()
+
+        # Pass 2: verdicts in eval order against the window overlay.
+        wm = _WindowState(mirror, statics)
+        for alloc in overlay._overlay.values():
+            wm.fold(alloc)  # in-flight apply: part of "committed" state
+        pair_of: dict = {}
+        for pair, (i, nid, *_rest) in enumerate(pairs):
+            pair_of[(i, nid)] = pair
+
+        outcomes: list = []
+        claimed: set = {n for n in overlay._by_node if n}
+        for i, plan in enumerate(plans):
+            pv = verdicts[i]
+            nodes = _touched(plan)
+            fallback = bool(nodes & claimed)
+            result = PlanResult(failed_allocs=list(plan.failed_allocs))
+            for nid in nodes:
+                ok = pv.get(nid, _MISS)
+                if ok is None:
+                    # Vector-ineligible claim: exact walk against the
+                    # overlay (identical to the sequential verdict).
+                    ok = _evaluate_node_plan(overlay, plan, nid)
+                elif ok is _MISS:
+                    pair = pair_of[(i, nid)]
+                    _i, _nid, ni, node, placements, removed = pairs[pair]
+                    u0, u1, u2, u3 = base_used[pair]
+                    d = wm.usage_delta.get(ni)
+                    if d is not None:
+                        u0 += d[0]
+                        u1 += d[1]
+                        u2 += d[2]
+                        u3 += d[3]
+                    for aid in removed:
+                        row = wm.alloc_row(aid)
+                        if row is not None and row[0] == ni:
+                            vec = row[1]
+                            u0 -= float(vec[0])
+                            u1 -= float(vec[1])
+                            u2 -= float(vec[2])
+                            u3 -= float(vec[3])
+                    c = caps[pair]
+                    if not (u0 <= c[0] and u1 <= c[1] and u2 <= c[2]
+                            and u3 <= c[3]):
+                        ok = False
+                    else:
+                        # Port collisions + bandwidth: exact, against
+                        # base + window overlay (None punts the node to
+                        # the scalar walk).
+                        ok = _verify_node_net(wm, statics, node, ni,
+                                              placements, removed)
+                        if ok is None:
+                            ok = _evaluate_node_plan(overlay, plan, nid)
+                if ok:
+                    if plan.node_update.get(nid):
+                        result.node_update[nid] = plan.node_update[nid]
+                    if plan.node_allocation.get(nid):
+                        result.node_allocation[nid] = \
+                            plan.node_allocation[nid]
+                    continue
+                result.refresh_index = max(overlay.get_index("nodes"),
+                                           overlay.get_index("allocs"))
+                if plan.all_at_once:
+                    result.node_update = {}
+                    result.node_allocation = {}
+                    break
+            outcomes.append(WindowOutcome(result, fallback))
+            accepted = _accepted_allocs(result)
+            overlay.upsert_allocs(accepted)
+            for alloc in accepted:
+                wm.fold(alloc)
+            claimed |= nodes
+    return outcomes

@@ -292,11 +292,12 @@ class BatchEvalRunner:
                     feasible, asks, distinct, counts, penalty,
                     k_cap=k_cap, rounds=rounds)
             chosen_s, score_s = fetch_results(chosen_s, score_s)
+            done = []
             for b, (sched, place, args) in enumerate(pending):
                 chosen, scores = rounds_to_placements(
                     args, chosen_s[b], score_s[b])
-                sched.finish_deferred(place, args, chosen, scores)
-                self._finish(sched, retries)
+                done.append((sched, place, args, chosen, scores))
+            self._finish_window(done, retries)
         else:
             if mesh is not None:
                 from nomad_tpu.parallel.mesh import \
@@ -310,9 +311,10 @@ class BatchEvalRunner:
                     capacity_d, reserved_d, base_usage, job_counts,
                     feasible, asks, distinct, group_idx, valid, penalty)
             chosen, scores = fetch_results(chosen, scores)
-            for b, (sched, place, args) in enumerate(pending):
-                sched.finish_deferred(place, args, chosen[b], scores[b])
-                self._finish(sched, retries)
+            self._finish_window(
+                [(sched, place, args, chosen[b], scores[b])
+                 for b, (sched, place, args) in enumerate(pending)],
+                retries)
 
         if leftovers:
             self._process_leftovers(leftovers)
@@ -330,6 +332,7 @@ class BatchEvalRunner:
         statics = pending[0][2].statics
         base_usage = pending[0][2].view.usage  # host array
         n_real = statics.n_real
+        done = []
         for sched, place, args in pending:
             if rounds_ok:
                 chosen_s, score_s, _u = place_rounds_host(
@@ -345,8 +348,8 @@ class BatchEvalRunner:
                     args.view.job_counts, args.feasible_h, args.asks,
                     args.distinct, args.group_idx, args.valid,
                     float(args.penalty), n_real=n_real)
-            sched.finish_deferred(place, args, chosen, scores)
-            self._finish(sched, retries)
+            done.append((sched, place, args, chosen, scores))
+        self._finish_window(done, retries)
 
     def _process_leftovers(self, leftovers: list) -> None:
         if self.state_refresh is None:
@@ -363,6 +366,99 @@ class BatchEvalRunner:
         chosen, scores = sched.collect_device(args, handles)
         sched.finish_deferred(place, args, chosen, scores)
         self._finish(sched, retries)
+
+    @staticmethod
+    def _finish_lanes(lanes: list) -> None:
+        """Windowed finish for a list of lanes in lane order — ONE
+        shared uuid slab (structs.generate_uuids) and ONE native call
+        (native/port_alloc.cpp bulk_finish_many) cover every lane's
+        happy-path prefix, then each lane's Python tail runs.  The one
+        implementation of the windowed finish sequence, shared by the
+        fused batch runner and the staged pipeline's drain stage.
+        ``lanes`` is [(sched, place, args, chosen, scores), ...];
+        semantics per lane are identical to ``finish_deferred``."""
+        from nomad_tpu.structs import generate_uuids
+
+        from .jax_binpack import _native_bulk
+
+        slab = generate_uuids(sum(len(place) for _, place, *_ in lanes))
+        states = []
+        nargs = []
+        off = 0
+        for sched, place, args, chosen, scores in lanes:
+            fs = sched._finish_prepare(place, args, chosen, scores,
+                                       slab[off:off + len(place)])
+            off += len(place)
+            states.append(fs)
+            nargs.append(sched._finish_native_args(fs))
+        native = _native_bulk()
+        if native is not None and hasattr(native, "bulk_finish_many") \
+                and len(lanes) > 1 and all(a is not None for a in nargs):
+            outs = native.bulk_finish_many(nargs)
+            for (sched, *_rest), fs, out in zip(lanes, states, outs):
+                sched._finish_consume_native(fs, out)
+        else:
+            for (sched, *_rest), fs, a in zip(lanes, states, nargs):
+                if a is not None:
+                    sched._finish_consume_native(
+                        fs, native.bulk_finish(*a))
+        for (sched, *_rest), fs in zip(lanes, states):
+            sched._finish_python_tail(fs)
+
+    def _finish_window(self, done: list, retries=None) -> None:
+        """Windowed finish + group submit for fused lanes
+        (``_finish_lanes``), then every lane's plan submits as one group
+        through the planner's window path (``submit_plans``) so the
+        commit point is paid once per window, not per lane."""
+        if not done:
+            return
+        self._finish_lanes(done)
+        self._submit_window([sched for sched, *_rest in done], retries)
+
+    def _submit_window(self, scheds: list, retries=None) -> None:
+        """Submit a window of finished lanes' plans, preserving lane
+        order and per-lane status semantics (see ``_finish``).  Uses the
+        planner's group path when it has one; per-plan submits
+        otherwise."""
+        submitters = []
+        for sched in scheds:
+            ev = sched.eval
+            try:
+                done = sched._submit_begin()
+            except SetStatusError as e:  # pragma: no cover - defensive
+                set_status(self.planner, ev, sched.next_eval,
+                           e.eval_status, str(e))
+                continue
+            if done is not None:
+                set_status(self.planner, ev, sched.next_eval,
+                           EVAL_STATUS_COMPLETE)
+                continue
+            submitters.append(sched)
+        if not submitters:
+            return
+        group = getattr(self.planner, "submit_plans", None)
+        if group is not None and len(submitters) > 1:
+            outs = group([s.plan for s in submitters])
+        else:
+            outs = [self.planner.submit_plan(s.plan)
+                    for s in submitters]
+        for sched, (result, state) in zip(submitters, outs):
+            ev = sched.eval
+            try:
+                ok = sched._submit_finish(result, state)
+            except SetStatusError as e:  # pragma: no cover - defensive
+                set_status(self.planner, ev, sched.next_eval,
+                           e.eval_status, str(e))
+                continue
+            if ok:
+                set_status(self.planner, ev, sched.next_eval,
+                           EVAL_STATUS_COMPLETE)
+            elif retries is not None:
+                retries.append(ev)  # no status yet: a later round owns it
+            else:
+                retry = JaxBinPackScheduler(
+                    sched.state, self.planner, batch=(ev.type == "batch"))
+                retry.process(ev)
 
     def _finish(self, sched, retries=None) -> None:
         """Submit the plan; on rejection/partial commit either queue the

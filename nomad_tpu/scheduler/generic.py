@@ -111,6 +111,18 @@ class GenericScheduler:
         return self._submit()
 
     def _submit(self) -> bool:
+        done = self._submit_begin()
+        if done is not None:
+            return done
+        result, new_state = self.planner.submit_plan(self.plan)
+        return self._submit_finish(result, new_state)
+
+    def _submit_begin(self) -> "Optional[bool]":
+        """Pre-submission step: noop short-circuit + rolling-update
+        follow-up eval.  Returns True when there is nothing to submit,
+        None when the plan should go to the planner — split out so a
+        window driver (scheduler/batch.py) can gather many plans and
+        submit them as one group."""
         if self.plan.is_noop():
             return True
 
@@ -119,9 +131,11 @@ class GenericScheduler:
             self.next_eval = self.eval.next_rolling_eval(
                 self.job.update.stagger)
             self.planner.create_eval(self.next_eval)
+        return None
 
-        result, new_state = self.planner.submit_plan(self.plan)
-
+    def _submit_finish(self, result, new_state) -> bool:
+        """Interpret one submitted plan's response (the post-submission
+        half of ``_submit``)."""
         if new_state is not None:
             # Forced refresh: stale data, try again.
             self.state = new_state

@@ -295,9 +295,6 @@ class PipelinedEvalRunner(BatchEvalRunner):
                     pass
 
     def _drain_window(self, window: list) -> None:
-        from nomad_tpu.structs import generate_uuids
-        from nomad_tpu.utils.native import native
-
         times = self.stage_times
         self.windows.append(len(window))
 
@@ -314,41 +311,23 @@ class PipelinedEvalRunner(BatchEvalRunner):
         t1 = time.perf_counter()
         times["collect"] += t1 - t0
 
-        # 2) finish: one uuid slab + one native call for the window,
-        # then each eval's Python tail.
-        slab = generate_uuids(sum(len(it.place) for it in work))
-        states = {}
-        nargs = []
-        off = 0
-        for it in work:
-            chosen, scores = results[id(it)]
-            n = len(it.place)
-            fs = it.sched._finish_prepare(
-                it.place, it.args, chosen, scores, slab[off:off + n])
-            off += n
-            states[id(it)] = fs
-            nargs.append(it.sched._finish_native_args(fs))
-        if native is not None and hasattr(native, "bulk_finish_many") \
-                and len(work) > 1 and all(a is not None for a in nargs):
-            outs = native.bulk_finish_many(nargs)
-            for it, out in zip(work, outs):
-                it.sched._finish_consume_native(states[id(it)], out)
-        else:
-            for it, a in zip(work, nargs):
-                if a is not None:
-                    it.sched._finish_consume_native(
-                        states[id(it)], native.bulk_finish(*a))
-        for it in work:
-            it.sched._finish_python_tail(states[id(it)])
+        # 2) finish: the shared windowed-finish sequence — one uuid slab
+        # + one native call + Python tails (BatchEvalRunner._finish_lanes
+        # is the single implementation).
+        self._finish_lanes([(it.sched, it.place, it.args)
+                            + tuple(results[id(it)]) for it in work])
         t2 = time.perf_counter()
         times["finish"] += t2 - t1
 
         # 3) submit, strictly in eval order (noop items interleave at
-        # their original position).
+        # their original position), as ONE group through the planner's
+        # window path when it has one — the drain window is exactly the
+        # commit window the group-commit applier amortizes.
+        self._submit_window([it.sched for it in window])
+        now = time.perf_counter()
         for it in window:
-            self._finish(it.sched)
-            self.latencies.append(time.perf_counter() - it.start)
-        times["submit"] += time.perf_counter() - t2
+            self.latencies.append(now - it.start)
+        times["submit"] += now - t2
 
     # -- device failure handling (breaker) ---------------------------------
     def _collect_item(self, it: _Item) -> tuple:

@@ -29,6 +29,7 @@ from nomad_tpu.structs import (
 from nomad_tpu.structs.codec import (
     ALLOC_CLIENT_UPDATE_REQUEST,
     ALLOC_UPDATE_REQUEST,
+    PLAN_BATCH_APPLY_REQUEST,
     EVAL_DELETE_REQUEST,
     EVAL_UPDATE_REQUEST,
     JOB_DEREGISTER_REQUEST,
@@ -70,6 +71,7 @@ class NomadFSM:
             EVAL_DELETE_REQUEST: self._apply_eval_delete,
             ALLOC_UPDATE_REQUEST: self._apply_alloc_update,
             ALLOC_CLIENT_UPDATE_REQUEST: self._apply_alloc_client_update,
+            PLAN_BATCH_APPLY_REQUEST: self._apply_plan_batch,
         }
 
     # -- apply ------------------------------------------------------------
@@ -133,6 +135,18 @@ class NomadFSM:
     def _apply_alloc_update(self, index: int, payload: dict):
         allocs = [Allocation.from_dict(a) for a in payload["alloc"]]
         self.state.upsert_allocs(index, allocs)
+        return None
+
+    def _apply_plan_batch(self, index: int, payload: dict):
+        """Group commit: one log entry carrying a whole plan window's
+        accepted alloc sets, upserted in eval order under one store
+        lock (state/store.py upsert_allocs_batched) — final state is
+        byte-identical to one ALLOC_UPDATE_REQUEST per plan in order.
+        All allocs are constructed BEFORE any state moves so a malformed
+        sub-plan rejects the entry with the store untouched."""
+        items = [(index, [Allocation.from_dict(a) for a in sub["alloc"]])
+                 for sub in payload["plans"]]
+        self.state.upsert_allocs_batched(items)
         return None
 
     def _apply_alloc_client_update(self, index: int, payload: dict):

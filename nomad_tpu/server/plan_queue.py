@@ -92,6 +92,19 @@ class PlanQueue:
                 else:
                     self._cond.wait()
 
+    def drain_pending(self, max_n: int) -> list:
+        """Pop up to ``max_n`` already-queued plans WITHOUT blocking, in
+        priority order — the group-commit applier's window gather: after
+        ``dequeue`` returns the window's first plan, everything else
+        that piled up behind the serialized commit drains with it."""
+        out: list = []
+        if max_n <= 0:
+            return out
+        with self._lock:
+            while self._heap and len(out) < max_n:
+                out.append(heapq.heappop(self._heap)[2])
+        return out
+
     def flush(self) -> None:
         with self._lock:
             for _, _, future in self._heap:

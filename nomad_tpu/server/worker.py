@@ -251,6 +251,44 @@ class _BatchPlanner:
     def submit_plan(self, plan: Plan):
         plan.eval_token = self.worker._tokens.get(plan.eval_id, "")
         future = self.worker.server.plan_queue.enqueue(plan)
+        return self._await(future)
+
+    def submit_plans(self, plans: list) -> list:
+        """Group submit: enqueue the whole window BEFORE waiting any
+        future, so the leader's group-commit applier sees the window at
+        once (one vectorized conflict pass + one raft apply) instead of
+        one plan per pop.  Results come back in plan order.  EVERY
+        enqueued future is drained before any error is re-raised: an
+        abandoned in-flight future's plan can still commit, and raising
+        early would hand the batch worker evals to nack whose plans are
+        committing underneath it — the retries would double-place."""
+        futures = []
+        for plan in plans:
+            plan.eval_token = self.worker._tokens.get(plan.eval_id, "")
+            try:
+                futures.append(
+                    self.worker.server.plan_queue.enqueue(plan))
+            except Exception as e:
+                futures.append(e)
+        out = []
+        first_err = None
+        for future in futures:
+            if isinstance(future, Exception):
+                first_err = first_err or future
+                continue
+            try:
+                out.append(self._await(future))
+            except Exception as e:
+                first_err = first_err or e
+        if first_err is not None:
+            # Same failure shape as the sequential path: the whole
+            # batch surfaces one error (the worker nacks and the evals
+            # re-reconcile) — but only after every submitted plan has
+            # settled.
+            raise first_err
+        return out
+
+    def _await(self, future):
         result = self.worker._wait_plan(future)
         state = None
         if result is not None and result.refresh_index > 0:

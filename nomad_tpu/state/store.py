@@ -418,35 +418,91 @@ class StateStore(_ReadMixin):
     # -- allocs -----------------------------------------------------------
     def upsert_allocs(self, index: int, allocs: list) -> None:
         """Scheduler/plan-authoritative write: preserves client-owned fields
-        of any existing alloc (reference: state_store.go:601-637)."""
+        of any existing alloc (reference: state_store.go:601-637).  One
+        item of the batched path — the merge semantics live in exactly
+        one place (upsert_allocs_batched)."""
+        if not allocs:
+            # The batched path skips empty items; a bare index write
+            # must still move the table fence — on a PRIVATE generation
+            # (_writable_table clones when shared), never in place under
+            # a live snapshot.
+            with self._lock:
+                self._writable_table("allocs")
+                self._bump("allocs", index)
+            self.watch.notify(("allocs",))
+            return
+        self.upsert_allocs_batched([(index, allocs)])
+
+    def upsert_allocs_batched(self, items: list) -> None:
+        """Group-commit write: ``items`` is ``[(index, allocs), ...]`` in
+        eval order, applied under ONE lock hold with one coalesced watch
+        notification — byte-identical final state to calling
+        ``upsert_allocs(index, allocs)`` per item in order (same
+        create/modify indexes, same changelog entries, same last-writer-
+        wins on duplicate alloc ids), minus the per-plan lock/notify
+        churn.  The raft path passes one shared entry index per item;
+        the harness path passes per-plan indexes so sequential replays
+        stay index-exact."""
         touched_nodes = []
+        # Buckets already copied within THIS call: _index_add/_remove
+        # copy the shared bucket set on every touch (snapshot safety);
+        # across a whole window that is O(bucket x allocs) churn for
+        # buckets that are only shared once.  Copy each bucket the
+        # first time the window touches it, then mutate the private
+        # copy in place.
+        fresh: dict = {}  # (id(index dict), key) -> private bucket
+
+        def add(idx: dict, key: str, item_id: str) -> None:
+            bucket = fresh.get((id(idx), key))
+            if bucket is None:
+                base = idx.get(key)
+                bucket = set() if base is None else set(base)
+                idx[key] = fresh[(id(idx), key)] = bucket
+            bucket.add(item_id)
+
+        def remove(idx: dict, key: str, item_id: str) -> None:
+            bucket = fresh.get((id(idx), key))
+            if bucket is None:
+                base = idx.get(key)
+                if base is None:
+                    return
+                bucket = idx[key] = fresh[(id(idx), key)] = set(base)
+            bucket.discard(item_id)
+            if not bucket:
+                idx.pop(key, None)
+                fresh.pop((id(idx), key), None)
+
         with self._lock:
             table = self._writable_table("allocs")
             a_node = self._writable_index("allocs_by_node")
             a_job = self._writable_index("allocs_by_job")
             a_eval = self._writable_index("allocs_by_eval")
-            for alloc in allocs:
-                existing = table.get(alloc.id)
-                new = alloc.copy()
-                if existing is not None:
-                    new.create_index = existing.create_index
-                    new.client_status = existing.client_status
-                    new.client_description = existing.client_description
-                    new.task_states = existing.task_states
-                    self._index_remove(a_node, existing.node_id, alloc.id)
-                else:
-                    new.create_index = index
-                new.modify_index = index
-                table[new.id] = new
-                self._index_add(a_node, new.node_id, new.id)
-                self._index_add(a_job, new.job_id, new.id)
-                if new.eval_id:
-                    self._index_add(a_eval, new.eval_id, new.id)
-                touched_nodes.append(new.node_id)
-            self._bump("allocs", index)
-            if allocs:
+            for index, allocs in items:
+                if not allocs:
+                    continue
+                for alloc in allocs:
+                    existing = table.get(alloc.id)
+                    new = alloc.copy()
+                    if existing is not None:
+                        new.create_index = existing.create_index
+                        new.client_status = existing.client_status
+                        new.client_description = \
+                            existing.client_description
+                        new.task_states = existing.task_states
+                        remove(a_node, existing.node_id, alloc.id)
+                    else:
+                        new.create_index = index
+                    new.modify_index = index
+                    table[new.id] = new
+                    add(a_node, new.node_id, new.id)
+                    add(a_job, new.job_id, new.id)
+                    if new.eval_id:
+                        add(a_eval, new.eval_id, new.id)
+                    touched_nodes.append(new.node_id)
+                self._bump("allocs", index)
                 self._log_alloc_change(index, [a.id for a in allocs])
-        keys = [("allocs",)] + [("alloc-node", n) for n in set(touched_nodes)]
+        keys = [("allocs",)] + [("alloc-node", n)
+                                for n in set(touched_nodes)]
         self.watch.notify(*keys)
 
     def update_alloc_from_client(self, index: int,

@@ -19,6 +19,10 @@ EVAL_UPDATE_REQUEST = 6
 EVAL_DELETE_REQUEST = 7
 ALLOC_UPDATE_REQUEST = 8
 ALLOC_CLIENT_UPDATE_REQUEST = 9
+# Group-commit extension (no reference analogue): one log entry carrying
+# the accepted alloc sets of a whole plan window, applied in eval order
+# by one batched FSM pass (server/plan_apply.py group commit).
+PLAN_BATCH_APPLY_REQUEST = 10
 
 # Upper bit: apply must not error on unknown type (structs.go:40-43)
 IGNORE_UNKNOWN_TYPE_FLAG = 128

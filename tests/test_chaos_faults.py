@@ -203,6 +203,73 @@ def _server_phase(plan: FaultPlan) -> None:
         srv.shutdown()
 
 
+def test_batched_commit_rides_raft_apply_faults():
+    """Group-commit window through the REAL ``raft.apply`` site under
+    injected drops/errors (ISSUE 5 satellite): an errored/dropped batch
+    apply must respond EVERY member future — no scheduler worker may
+    park — and the workers' retries must converge to exactly-once
+    placement with no double-placed group."""
+    srv = Server(ServerConfig(num_schedulers=2))
+    srv.establish_leadership()
+    try:
+        for i in range(12):
+            srv.node_register(mock.node(i))
+
+        # Faults go live only for the eval storm: every batched commit
+        # rides the same raft.apply chokepoint, so the first few
+        # windows die (drop = entry never entered the log) and the
+        # member evals retry through the plan-rejection path.
+        plan = FaultPlan.parse(
+            "seed=11;raft.apply=drop(p=0.7,count=3)")
+        jobs = [_job(n_groups=4, count=2) for _ in range(8)]
+        with faultinject.injected(plan):
+            for job in jobs:
+                SUBMIT_POLICY.call(lambda j=job: srv.job_register(j))
+
+            deadline = time.monotonic() + 45
+            while time.monotonic() < deadline:
+                evals = srv.fsm.state.evals()
+                if evals and len(evals) >= len(jobs) and \
+                        all(e.status in TERMINAL for e in evals):
+                    break
+                time.sleep(0.1)  # sleep-ok: poll cadence while the storm converges
+
+        state = srv.fsm.state
+        stuck = [(e.id, e.status) for e in state.evals()
+                 if e.status not in TERMINAL]
+        assert not stuck, \
+            f"non-terminal evals after raft chaos: {stuck[:5]}"
+        assert plan.fire_count("raft.apply") == 3, \
+            "the batched commit never crossed the fault site"
+
+        # Exactly-once placement per group despite the dropped windows.
+        for job in jobs:
+            live = [a for a in state.allocs_by_job(job.id)
+                    if not a.terminal_status()]
+            want = sum(tg.count for tg in job.task_groups)
+            assert len(live) == want, \
+                f"job {job.id}: {len(live)} live allocs, want {want}"
+            by_group: dict = {}
+            for a in live:
+                by_group[a.task_group] = by_group.get(a.task_group, 0) + 1
+            assert all(by_group[tg.name] == tg.count
+                       for tg in job.task_groups), "duplicate placement"
+        for node in state.nodes():
+            live = [a for a in state.allocs_by_node(node.id)
+                    if not a.terminal_status()]
+            fit, dim, _ = allocs_fit(node, live)
+            assert fit, f"node {node.id} oversubscribed on {dim}"
+
+        # The group-commit applier actually batched: strictly fewer
+        # commits than the plans they carried (a drain regression that
+        # degrades every window to one plan fails here).
+        stats = srv.plan_applier.stats()
+        assert stats["plans_committed"] >= len(jobs)
+        assert stats["commits"] < stats["plans_committed"], stats
+    finally:
+        srv.shutdown()
+
+
 def _device_phase(plan: FaultPlan) -> None:
     """Pipelined-runner stream under device faults: the breaker must
     complete open -> half-open -> closed cycles with parity asserted,

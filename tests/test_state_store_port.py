@@ -1,0 +1,275 @@
+"""Port of the reference's state_store_test.go allocation/index table
+(/root/reference/nomad/state/state_store_test.go) against
+state/store.py, extended to the group-commit batched upsert:
+
+  1. UpsertAlloc / UpdateAlloc / EvictAlloc semantics — create/modify
+     index stamping, client-field preservation, eviction as an upsert
+     (TestStateStore_UpsertAlloc_Alloc / _UpdateAlloc_Alloc /
+     _EvictAlloc_Alloc).
+  2. Secondary-index queries — AllocsByNode / AllocsByJob /
+     AllocsByEval / Allocs iteration (TestStateStore_AllocsByNode /
+     _Allocs).
+  3. Batched vs single upserts: upsert_allocs_batched applied in one
+     lock hold must be byte-identical to per-item upsert_allocs calls,
+     including index monotonicity and watch notification.
+  4. Snapshot round-trip of batch-applied allocs through the FSM
+     (TestStateStore_RestoreAlloc shape, driven by the
+     PLAN_BATCH_APPLY_REQUEST log entry).
+"""
+from __future__ import annotations
+
+from nomad_tpu import mock
+from nomad_tpu.server.fsm import NomadFSM
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import (
+    ALLOC_CLIENT_STATUS_RUNNING,
+    ALLOC_DESIRED_STATUS_EVICT,
+    codec,
+)
+from nomad_tpu.structs.codec import PLAN_BATCH_APPLY_REQUEST
+
+
+def image(store) -> tuple:
+    """Byte-comparable store image: every alloc's serialized form plus
+    the table indexes."""
+    return (
+        {a.id: a.to_dict() for a in store.allocs()},
+        {t: store.get_index(t)
+         for t in ("nodes", "jobs", "evals", "allocs")},
+    )
+
+
+# ---------------------------------------------------------------------------
+# 1. the upstream alloc table
+# ---------------------------------------------------------------------------
+
+class TestAllocTable:
+    def test_upsert_alloc(self):
+        """TestStateStore_UpsertAlloc_Alloc: stored copy, both indexes
+        stamped, table index bumped."""
+        s = StateStore()
+        a = mock.alloc()
+        s.upsert_allocs(1000, [a])
+        out = s.alloc_by_id(a.id)
+        assert out is not None and out is not a
+        assert out.create_index == 1000 and out.modify_index == 1000
+        assert s.get_index("allocs") == 1000
+
+    def test_update_alloc_preserves_create_index(self):
+        """TestStateStore_UpdateAlloc_Alloc: a re-upsert moves
+        modify_index only."""
+        s = StateStore()
+        a = mock.alloc()
+        s.upsert_allocs(1000, [a])
+        update = a.copy()
+        update.name = "updated"
+        s.upsert_allocs(1001, [update])
+        out = s.alloc_by_id(a.id)
+        assert out.name == "updated"
+        assert out.create_index == 1000 and out.modify_index == 1001
+        assert s.get_index("allocs") == 1001
+
+    def test_evict_alloc(self):
+        """TestStateStore_EvictAlloc_Alloc: eviction is an upsert with a
+        terminal desired status — the record stays queryable."""
+        s = StateStore()
+        a = mock.alloc()
+        s.upsert_allocs(1000, [a])
+        evicted = a.copy()
+        evicted.desired_status = ALLOC_DESIRED_STATUS_EVICT
+        s.upsert_allocs(1001, [evicted])
+        out = s.alloc_by_id(a.id)
+        assert out.desired_status == ALLOC_DESIRED_STATUS_EVICT
+        assert out.terminal_status()
+        assert out.create_index == 1000 and out.modify_index == 1001
+
+    def test_allocs_by_node_job_eval(self):
+        """TestStateStore_AllocsByNode + the job/eval secondary
+        indexes."""
+        s = StateStore()
+        allocs = []
+        for i in range(10):
+            a = mock.alloc()
+            a.node_id = "the-node"
+            allocs.append(a)
+        s.upsert_allocs(1000, allocs)
+        by_node = s.allocs_by_node("the-node")
+        assert sorted(x.id for x in by_node) == \
+            sorted(a.id for a in allocs)
+        one = allocs[3]
+        assert [x.id for x in s.allocs_by_job(one.job_id)
+                if x.id == one.id] == [one.id]
+        assert [x.id for x in s.allocs_by_eval(one.eval_id)] == [one.id]
+
+    def test_allocs_iteration(self):
+        """TestStateStore_Allocs: full-table iteration sees every
+        record."""
+        s = StateStore()
+        allocs = [mock.alloc() for _ in range(10)]
+        s.upsert_allocs(1000, allocs)
+        assert sorted(a.id for a in s.allocs()) == \
+            sorted(a.id for a in allocs)
+
+
+# ---------------------------------------------------------------------------
+# 2. batched upsert: byte parity with singles, index monotonicity
+# ---------------------------------------------------------------------------
+
+class TestBatchedUpsert:
+    def _stream(self):
+        """A mixed stream: fresh placements on two nodes, a client-side
+        update in between, an in-place replacement, and an eviction."""
+        a1, a2, a3 = mock.alloc(), mock.alloc(), mock.alloc()
+        a2.node_id = a1.node_id
+        repl = a1.copy()
+        repl.name = "replaced"
+        evict = a3.copy()
+        evict.desired_status = ALLOC_DESIRED_STATUS_EVICT
+        return [
+            (2000, [a1, a2]),
+            (2001, [a3]),
+            (2002, [repl, evict]),
+        ]
+
+    def test_batched_equals_singles(self):
+        items = self._stream()
+        s_single, s_batch = StateStore(), StateStore()
+        for index, allocs in items:
+            s_single.upsert_allocs(index, allocs)
+        s_batch.upsert_allocs_batched(items)
+        assert image(s_single) == image(s_batch)
+
+    def test_batched_preserves_client_fields(self):
+        """The scheduler-authoritative merge holds inside a batch: a
+        batched rewrite must not clobber client-owned fields."""
+        s = StateStore()
+        a = mock.alloc()
+        s.upsert_allocs(1000, [a])
+        client_view = s.alloc_by_id(a.id).copy()
+        client_view.client_status = ALLOC_CLIENT_STATUS_RUNNING
+        client_view.client_description = "up"
+        s.update_alloc_from_client(1001, client_view)
+
+        sched_view = a.copy()
+        sched_view.client_status = "pending"
+        s.upsert_allocs_batched([(1002, [sched_view])])
+        out = s.alloc_by_id(a.id)
+        assert out.client_status == ALLOC_CLIENT_STATUS_RUNNING
+        assert out.client_description == "up"
+        assert out.create_index == 1000 and out.modify_index == 1002
+
+    def test_index_monotonicity_across_mixed_writes(self):
+        """The allocs table index only ever moves forward, through
+        singles and batches alike, and lands on the batch's last
+        sub-index."""
+        s = StateStore()
+        seen = [s.get_index("allocs")]
+        s.upsert_allocs(1000, [mock.alloc()])
+        seen.append(s.get_index("allocs"))
+        s.upsert_allocs_batched([(1001, [mock.alloc()]),
+                                 (1002, [mock.alloc()]),
+                                 (1003, [])])  # empty item: no bump
+        seen.append(s.get_index("allocs"))
+        s.upsert_allocs(1004, [mock.alloc()])
+        seen.append(s.get_index("allocs"))
+        assert seen == [0, 1000, 1002, 1004]
+        assert seen == sorted(seen)
+        assert s.latest_index() == 1004
+
+    def test_batched_last_writer_wins_in_order(self):
+        """Two sub-plans touching the same alloc id: the LATER item's
+        version lands, exactly as sequential upserts in eval order."""
+        s = StateStore()
+        a = mock.alloc()
+        v1 = a.copy()
+        v1.name = "first"
+        v2 = a.copy()
+        v2.name = "second"
+        s.upsert_allocs_batched([(3000, [v1]), (3001, [v2])])
+        out = s.alloc_by_id(a.id)
+        assert out.name == "second"
+        assert out.create_index == 3000 and out.modify_index == 3001
+
+    def test_batched_fires_watches_once_per_touched_node(self):
+        s = StateStore()
+        a1, a2 = mock.alloc(), mock.alloc()
+        ev_all = s.watch.watch(("allocs",))
+        ev_n1 = s.watch.watch(("alloc-node", a1.node_id))
+        ev_n2 = s.watch.watch(("alloc-node", a2.node_id))
+        ev_other = s.watch.watch(("alloc-node", "untouched"))
+        s.upsert_allocs_batched([(1000, [a1]), (1001, [a2])])
+        assert ev_all.is_set() and ev_n1.is_set() and ev_n2.is_set()
+        assert not ev_other.is_set()
+
+    def test_batched_respects_snapshot_isolation(self):
+        s = StateStore()
+        a = mock.alloc()
+        s.upsert_allocs(1000, [a])
+        snap = s.snapshot()
+        b = mock.alloc()
+        b.node_id = a.node_id
+        s.upsert_allocs_batched([(1001, [b])])
+        assert len(snap.allocs_by_node(a.node_id)) == 1
+        assert len(s.allocs_by_node(a.node_id)) == 2
+        assert snap.get_index("allocs") == 1000
+
+    def test_batched_feeds_the_mirror_changelog(self):
+        """Each batched sub-plan logs its own (index, ids) changelog
+        entry so the incremental usage mirror can sync by delta."""
+        s = StateStore()
+        a1, a2 = mock.alloc(), mock.alloc()
+        s.upsert_allocs_batched([(1000, [a1]), (1001, [a2])])
+        log = s._t.alloc_log
+        assert (1000, (a1.id,)) in log
+        assert (1001, (a2.id,)) in log
+
+
+# ---------------------------------------------------------------------------
+# 3. snapshot round-trip of batch-applied allocs
+# ---------------------------------------------------------------------------
+
+class TestBatchSnapshotRoundTrip:
+    def test_fsm_batch_apply_then_snapshot_restore(self):
+        """TestStateStore_RestoreAlloc shape, driven end-to-end: a
+        PLAN_BATCH_APPLY_REQUEST log entry lands allocs in state; a
+        snapshot/restore round trip preserves them byte-for-byte,
+        indexes included."""
+        fsm = NomadFSM()
+        node = mock.node()
+        fsm.apply(10, codec.encode(codec.NODE_REGISTER_REQUEST,
+                                   {"node": node.to_dict()}))
+        allocs_a = [mock.alloc() for _ in range(3)]
+        allocs_b = [mock.alloc() for _ in range(2)]
+        for a in allocs_a + allocs_b:
+            a.node_id = node.id
+        entry = codec.encode(
+            PLAN_BATCH_APPLY_REQUEST,
+            {"plans": [{"alloc": [a.to_dict() for a in allocs_a]},
+                       {"alloc": [a.to_dict() for a in allocs_b]}]})
+        fsm.apply(11, entry)
+        assert len(fsm.state.allocs_by_node(node.id)) == 5
+        before = image(fsm.state)
+
+        blob = fsm.snapshot()
+        fresh = NomadFSM()
+        fresh.restore(blob)
+        assert image(fresh.state) == before
+        out = sorted(fresh.state.allocs(), key=lambda a: a.id)
+        assert all(a.create_index == 11 and a.modify_index == 11
+                   for a in out)
+
+    def test_batch_apply_is_atomic_on_malformed_subplan(self):
+        """A malformed sub-plan rejects the whole entry with the store
+        untouched (alloc construction precedes any state move)."""
+        import pytest
+
+        fsm = NomadFSM()
+        good = mock.alloc()
+        entry = codec.encode(
+            PLAN_BATCH_APPLY_REQUEST,
+            {"plans": [{"alloc": [good.to_dict()]},
+                       {"allocs_typo": []}]})
+        with pytest.raises(Exception):
+            fsm.apply(11, entry)
+        assert fsm.state.alloc_by_id(good.id) is None
+        assert fsm.state.get_index("allocs") == 0

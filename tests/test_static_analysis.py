@@ -165,6 +165,39 @@ class TestLintGate:
         assert "untouched.py" not in out, \
             "changed-mode must filter pre-existing findings"
 
+    def test_group_commit_paths_ride_the_gates(self):
+        """ISSUE 5 satellite: the group-commit window pass
+        (ops/plan_conflict.py) and the FSM batch-apply path are inside
+        every gate's scan set — tracer lint, lockcheck and the
+        interprocedural passes — with zero findings and no allowlist
+        entries of their own."""
+        from nomad_tpu.analysis import (default_package_root,
+                                        load_allowlist)
+        from nomad_tpu.analysis.callgraph import CallGraph
+
+        pkg = default_package_root()
+        graph = CallGraph.build(pkg)
+        assert any(q.startswith("nomad_tpu.ops.plan_conflict:")
+                   for q in graph.functions), \
+            "plan_conflict.py missing from the interprocedural graph"
+        assert "nomad_tpu.server.fsm:NomadFSM._apply_plan_batch" in \
+            graph.functions, "fsm batch path missing from the graph"
+        assert "nomad_tpu.state.store:StateStore.upsert_allocs_batched" \
+            in graph.functions
+
+        findings = run_lint(strict=True)
+        touching = [f for f in findings
+                    if "plan_conflict" in f.path
+                    or "_apply_plan_batch" in f.render()
+                    or "upsert_allocs_batched" in f.render()]
+        assert touching == [], "group-commit paths must lint clean:\n" \
+            + "\n".join(f.render() for f in touching)
+        allow = load_allowlist(default_allowlist_path())
+        assert not any("plan_conflict" in e or "_apply_plan_batch" in e
+                       or "upsert_allocs_batched" in e
+                       for e in allow), \
+            "group-commit paths must not need allowlist entries"
+
     def test_fixed_sleep_ratchet_is_clean(self):
         """Every fixed time.sleep in the test tree is either converted
         to wait_until or carries a '# sleep-ok: why' justification —
