@@ -48,6 +48,10 @@ class InprocRPC:
             # Same chokepoint ConnPool.call instruments for networked
             # clients: a colocated client's "sends" are these calls.
             faultinject.fire_rpc("rpc.send", method, args)
+        if timeout is not None and "_deadline" not in args:
+            # Deadline propagation, same envelope the wire plane ships
+            # (server/overload.py) — the endpoint layer stamps arrival.
+            args = dict(args, _deadline=timeout)
         fn = self._methods.get(method)
         if fn is None:
             raise ValueError(f"unknown method {method!r}")

@@ -1,8 +1,9 @@
 """Deterministic fault injection at the system's chokepoints.
 
 The runtime consults a process-global plan at named *sites* — RPC
-send/receive, raft apply, heartbeat delivery, device dispatch/collect,
-driver start — so failure paths that production only exercises during
+send/receive/admit, raft apply, heartbeat delivery, broker enqueue,
+device dispatch/collect, driver start — so failure paths that
+production only exercises during
 an outage (lost frames, hung device calls, expiring TTLs) can be driven
 on demand, deterministically, in tests and soaks.
 

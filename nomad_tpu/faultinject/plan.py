@@ -53,8 +53,10 @@ from typing import Optional
 SITES = (
     "rpc.send",           # client/conn-pool about to send a request
     "rpc.recv",           # server received a request, pre-dispatch
+    "rpc.admit",          # admission control deciding on a request
     "raft.apply",         # an entry entering the replicated log
     "heartbeat.deliver",  # a node heartbeat reaching the leader
+    "broker.enqueue",     # an evaluation entering the eval broker
     "device.dispatch",    # a device placement dispatch starting
     "device.collect",     # blocking on a device dispatch's results
     "driver.start",       # a task driver starting a task
@@ -67,8 +69,12 @@ SITES = (
 SITE_CONTEXT = {
     "rpc.send": ("method", "node"),
     "rpc.recv": ("method", "node"),
+    "rpc.admit": ("method", "node"),
     "raft.apply": (),
     "heartbeat.deliver": ("node",),
+    # broker.enqueue passes the eval's scheduler type as ``method`` and
+    # its node id (node-update evals) as ``node``.
+    "broker.enqueue": ("method", "node"),
     "device.dispatch": (),
     "device.collect": (),
     "driver.start": ("method",),

@@ -17,7 +17,10 @@ import random
 import time
 from typing import Optional
 
+from nomad_tpu import faultinject
 from nomad_tpu.structs import Allocation, Evaluation, Job, Node
+
+from . import overload as overload_mod
 
 MAX_BLOCKING_WAIT = 300.0  # reference nomad/rpc.go:30-40
 
@@ -62,8 +65,9 @@ class Endpoints:
                 full = f"{service}.{m}"
                 if full in CONSISTENT_READS:
                     handler = self._with_leader_reads(full, handler)
+                handler = self._with_region(full, handler)
                 rpc_server.register(full,
-                                    self._with_region(full, handler))
+                                    self._with_admission(full, handler))
                 registered.add(full)
         # Guard against drift: a typo'd CONSISTENT_READS entry would
         # silently leave that read follower-local.
@@ -73,6 +77,24 @@ class Endpoints:
                 f"CONSISTENT_READS names unregistered methods: {missing}")
 
     # -- plumbing ---------------------------------------------------------
+    def _with_admission(self, method: str, handler):
+        """Overload control at the RPC plane, outermost on EVERY
+        endpoint (server/overload.py): the arriving envelope's relative
+        deadline is converted once to this host's monotonic clock, the
+        ``rpc.admit`` fault site fires, and the admission controller
+        sheds by priority class — heartbeats bypass on their lane.  A
+        shed request costs one state check and an exception: the whole
+        point is that rejecting is radically cheaper than serving."""
+        def admitted(args: dict):
+            overload_mod.stamp_arrival(args)
+            if faultinject.ACTIVE:
+                faultinject.fire_rpc("rpc.admit", method, args)
+            ctrl = self.server.overload
+            if ctrl is not None:
+                ctrl.admit_rpc(method, args)  # raises ErrOverloaded
+            return handler(args)
+        return admitted
+
     def _with_leader_reads(self, method: str, handler):
         """Default-consistent reads (reference nomad/rpc.go:175-185): a
         follower forwards the query to the leader unless the caller set
@@ -99,7 +121,7 @@ class Endpoints:
                         f"{self.server.config.region!r}, request wants "
                         f"{region!r}")
                 addr = self.server.region_server(region)
-                fwd_args = dict(args)
+                fwd_args = overload_mod.restamp_forward(dict(args))
                 fwd_args["_region_forwarded"] = True
                 return self.server.conn_pool.call(addr, method, fwd_args)
             return handler(args)
@@ -124,7 +146,7 @@ class Endpoints:
             raise RuntimeError("no cluster leader")
         if tuple(leader) == self.server.rpc_address():
             return None
-        fwd_args = dict(args)
+        fwd_args = overload_mod.restamp_forward(dict(args))
         fwd_args["_forwarded"] = True
         return self.server.conn_pool.call(tuple(leader), method, fwd_args)
 
@@ -327,8 +349,13 @@ class Endpoints:
         fwd = self._forward("Eval.Dequeue", args)
         if fwd is not None:
             return fwd
+        # Deadline propagation: never block longer than the caller's
+        # remaining budget — a reply past it talks to nobody.
+        timeout = overload_mod.remaining(
+            overload_mod.absolute_deadline(args),
+            float(args.get("timeout") or 0.5))
         ev, token = self.server.eval_broker.dequeue(
-            args["schedulers"], float(args.get("timeout") or 0.5))
+            args["schedulers"], timeout)
         return {"eval": ev.to_dict() if ev else None, "token": token}
 
     def eval_ack(self, args: dict) -> dict:
@@ -389,8 +416,13 @@ class Endpoints:
         from nomad_tpu.structs import Plan
 
         plan = Plan.from_dict(args["plan"])
+        # The wire value is another host's monotonic clock — meaningless
+        # here.  Re-stamp from the envelope's relative budget: the
+        # applier drops the plan unverified once it expires.
+        deadline = overload_mod.absolute_deadline(args)
+        plan.deadline = deadline
         future = self.server.plan_queue.enqueue(plan)
-        result = future.wait(60.0)
+        result = future.wait(overload_mod.remaining(deadline, 60.0))
         return {"result": result.to_dict() if result else None}
 
     # -- Alloc ------------------------------------------------------------

@@ -15,15 +15,37 @@ evals in one call (still one per job) so the device worker can fuse them
 into a single vmapped dispatch (nomad_tpu/scheduler/batch.py).  The
 reference dequeues one eval per worker goroutine; batching is what turns
 the device's throughput into scheduler throughput.
+
+Overload control plane (server/overload.py):
+
+  - **Bounded, priority-aware admission**: ``enqueue`` consults the
+    admission controller (system > service > batch shedding) and a hard
+    depth bound, raising ``ErrOverloaded`` — a retryable NACK — instead
+    of queueing without limit.  ``force=True`` bypasses both for evals
+    already committed to replicated state (the FSM apply path and the
+    leadership-restore scan must NEVER diverge broker from state).
+  - **Deadline drops**: an enqueue may carry an absolute monotonic
+    deadline; a deadline-expired eval found at dequeue time is never
+    delivered to a worker — it routes to the ``_failed`` queue (the
+    reaper marks it failed, a terminal state) and counts in
+    ``stats()["expired_drops"]``.
+  - **Timer lifecycle**: nothing is armed while the broker is disabled,
+    nack timers fire through a tolerant wrapper, and ``flush`` cancels
+    every timer — no stray ``threading.Timer`` can fire into a
+    torn-down server.
 """
 from __future__ import annotations
 
 import heapq
 import itertools
 import threading
+import time
 from typing import Optional
 
+from nomad_tpu import faultinject
 from nomad_tpu.structs import Evaluation, generate_uuid
+
+from .overload import ErrOverloaded
 
 FAILED_QUEUE = "_failed"
 
@@ -65,11 +87,15 @@ class _Unack:
 
 class EvalBroker:
     def __init__(self, nack_timeout: float = 60.0,
-                 delivery_limit: int = 3) -> None:
+                 delivery_limit: int = 3,
+                 admission=None,
+                 max_depth: Optional[int] = None) -> None:
         if nack_timeout < 0:
             raise ValueError("timeout cannot be negative")
         self.nack_timeout = nack_timeout
         self.delivery_limit = delivery_limit
+        self.admission = admission   # OverloadController (or None)
+        self.max_depth = max_depth   # hard enqueue bound (None = unbounded)
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._enabled = False
@@ -79,6 +105,9 @@ class EvalBroker:
         self._ready: dict = {}       # scheduler type -> _PendingHeap
         self._unack: dict = {}       # eval id -> _Unack
         self._time_wait: dict = {}   # eval id -> threading.Timer
+        self._deadlines: dict = {}   # eval id -> absolute monotonic deadline
+        self._expired_drops = 0      # deadline-expired evals never delivered
+        self._depth_sheds = 0        # enqueues refused by the hard bound
 
     # -- lifecycle --------------------------------------------------------
     def enabled(self) -> bool:
@@ -103,24 +132,63 @@ class EvalBroker:
             self._ready.clear()
             self._unack.clear()
             self._time_wait.clear()
+            self._deadlines.clear()
             self._cond.notify_all()
 
     # -- enqueue ----------------------------------------------------------
-    def enqueue(self, ev: Evaluation) -> None:
+    def depth(self) -> int:
+        """Total evals the broker is tracking (ready + blocked + waiting
+        + unacked) — the admission controller's pressure source."""
+        with self._lock:
+            return len(self._evals)
+
+    def enqueue(self, ev: Evaluation, deadline: Optional[float] = None,
+                force: bool = False) -> None:
+        """Queue an eval for delivery.
+
+        ``deadline`` (absolute monotonic) bounds USEFULNESS, not
+        queueing: a deadline-expired eval is dropped at dequeue time
+        (``expired_drops``) and routed to the failed queue instead of
+        being delivered to a worker.  ``force`` bypasses admission and
+        the depth bound — mandatory for evals already committed to
+        replicated state (FSM apply, leadership restore), where a shed
+        would silently diverge the broker from state."""
+        if faultinject.ACTIVE:
+            faultinject.fire("broker.enqueue", method=ev.type,
+                             node=ev.node_id or None)
+        if not force and self.admission is not None:
+            # Controller consultation OUTSIDE the broker lock (it reads
+            # other queues' depths, each behind its own lock).
+            self.admission.admit_eval(ev)  # may raise ErrOverloaded
         with self._lock:
             if ev.id in self._evals:
                 return
-            if self._enabled:
-                self._evals[ev.id] = 0
-
-            if ev.wait > 0:
-                timer = threading.Timer(ev.wait, self._enqueue_waiting, [ev])
-                timer.daemon = True
-                self._time_wait[ev.id] = timer
-                timer.start()
+            if not self._enabled:
+                # A disabled broker accepts nothing — and must not arm
+                # wait timers that would fire into a torn-down server.
                 return
-
-            self._enqueue_locked(ev, ev.type)
+            # Depth bound checked in the SAME critical section as the
+            # insert: concurrent enqueues cannot overshoot it.
+            if not force and self.max_depth is not None and \
+                    len(self._evals) >= self.max_depth:
+                self._depth_sheds += 1
+                shed = True
+            else:
+                shed = False
+                self._evals[ev.id] = 0
+                if deadline:
+                    self._deadlines[ev.id] = deadline
+                if ev.wait > 0:
+                    timer = threading.Timer(ev.wait,
+                                            self._enqueue_waiting, [ev])
+                    timer.daemon = True
+                    self._time_wait[ev.id] = timer
+                    timer.start()
+                else:
+                    self._enqueue_locked(ev, ev.type)
+        if shed:
+            raise ErrOverloaded(
+                f"eval broker at depth bound {self.max_depth}")
 
     def _enqueue_waiting(self, ev: Evaluation) -> None:
         with self._lock:
@@ -182,27 +250,52 @@ class EvalBroker:
 
     def _scan_locked(self, schedulers: list
                      ) -> tuple[Optional[Evaluation], str]:
-        best_sched = None
-        best_priority = None
-        for sched in schedulers:
-            heapq_ = self._ready.get(sched)
-            if not heapq_:
-                continue
-            ready = heapq_.peek()
-            if ready is None:
-                continue
-            if best_priority is None or ready.priority > best_priority:
-                best_sched, best_priority = sched, ready.priority
-        if best_sched is None:
-            return None, ""
-        ev = self._ready[best_sched].pop()
-        token = generate_uuid()
-        timer = threading.Timer(self.nack_timeout, self.nack, [ev.id, token])
-        timer.daemon = True
-        self._unack[ev.id] = _Unack(ev, token, timer)
-        self._evals[ev.id] = self._evals.get(ev.id, 0) + 1
-        timer.start()
-        return ev, token
+        while True:
+            best_sched = None
+            best_priority = None
+            for sched in schedulers:
+                heapq_ = self._ready.get(sched)
+                if not heapq_:
+                    continue
+                ready = heapq_.peek()
+                if ready is None:
+                    continue
+                if best_priority is None or ready.priority > best_priority:
+                    best_sched, best_priority = sched, ready.priority
+            if best_sched is None:
+                return None, ""
+            ev = self._ready[best_sched].pop()
+            # Deadline drop: nobody is waiting for this eval's outcome
+            # anymore — never burn a worker on it.  One-shot (the
+            # deadline entry is consumed) so the failed-queue reaper
+            # can still dequeue it to mark it terminal.
+            deadline = self._deadlines.pop(ev.id, None)
+            if deadline is not None and time.monotonic() > deadline and \
+                    best_sched != FAILED_QUEUE:
+                self._expired_drops += 1
+                # Route to the failed queue exactly like the
+                # delivery-limit path: the eval keeps its job's
+                # in-flight slot until the reaper acks it, so a blocked
+                # sibling can never double-deliver for the job.
+                self._enqueue_locked(ev, FAILED_QUEUE)
+                continue  # rescan: later evals may still be live
+            token = generate_uuid()
+            timer = threading.Timer(self.nack_timeout,
+                                    self._nack_timer_fired, [ev.id, token])
+            timer.daemon = True
+            self._unack[ev.id] = _Unack(ev, token, timer)
+            self._evals[ev.id] = self._evals.get(ev.id, 0) + 1
+            timer.start()
+            return ev, token
+
+    def _nack_timer_fired(self, eval_id: str, token: str) -> None:
+        """Nack-timeout path: tolerant of the delivery having been
+        acked/flushed in the firing window — a stray timer must log
+        nothing and touch nothing on a torn-down server."""
+        try:
+            self.nack(eval_id, token)
+        except ValueError:
+            pass
 
     # -- acknowledgement --------------------------------------------------
     def outstanding(self, eval_id: str) -> tuple[str, bool]:
@@ -258,4 +351,6 @@ class EvalBroker:
                 "total_blocked": sum(len(h) for h in self._blocked.values()),
                 "total_waiting": len(self._time_wait),
                 "by_scheduler": by_sched,
+                "expired_drops": self._expired_drops,
+                "depth_sheds": self._depth_sheds,
             }

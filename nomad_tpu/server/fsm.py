@@ -120,11 +120,15 @@ class NomadFSM:
         evals = [Evaluation.from_dict(e) for e in payload["evals"]]
         self.state.upsert_evals(index, evals)
         # Pending evals (re-)enter the broker on apply (fsm.go:243-250);
-        # the broker no-ops unless enabled (leader only).
+        # the broker no-ops unless enabled (leader only).  ``force``:
+        # admission control already ran at the RPC plane — an eval that
+        # reached the replicated log is committed state, and shedding it
+        # HERE would diverge the broker from state (and, on a real raft
+        # apply path, fail the FSM).
         if self.eval_broker is not None:
             for ev in evals:
                 if ev.should_enqueue():
-                    self.eval_broker.enqueue(ev)
+                    self.eval_broker.enqueue(ev, force=True)
         return None
 
     def _apply_eval_delete(self, index: int, payload: dict):

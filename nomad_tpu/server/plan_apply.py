@@ -321,6 +321,10 @@ class PlanApplier:
         self.conflict_fallbacks = 0  # window plans that needed the
         #                              exact per-plan walk (prefix
         #                              conflict with an earlier plan)
+        self.expired_drops = 0      # plans whose propagated deadline
+        #                             passed before verification — the
+        #                             leader never burns a verify+commit
+        #                             on a result nobody is waiting for
         # Recent drained window sizes, BOUNDED: a leader drains windows
         # for its whole tenure, so an unbounded list is a slow leak.
         self.windows = deque(maxlen=256)
@@ -373,8 +377,25 @@ class PlanApplier:
     def _fence(self, pending) -> bool:
         """Token fencing: the eval must be outstanding and the token
         must match (guards split-brain schedulers, plan_apply.go:53).
-        Responds the future and returns False on a fencing failure."""
+        Responds the future and returns False on a fencing failure.
+
+        Deadline drop first (overload control plane): a plan whose
+        propagated deadline passed gets an ``ErrDeadlineExceeded``
+        response without any verification — by then the submitter's
+        wait has expired and the broker's nack timer has (or is about
+        to) redeliver the eval, so a commit here would only race the
+        retry toward double placement while burning the leader."""
+        import time as _time
+
+        from .overload import ErrDeadlineExceeded
+
         plan = pending.plan
+        if plan.deadline and _time.monotonic() > plan.deadline:
+            with self._stats_lock:
+                self.expired_drops += 1
+            pending.respond(None, ErrDeadlineExceeded(
+                f"plan for eval {plan.eval_id} expired in queue"))
+            return False
         token, ok = self.eval_broker.outstanding(plan.eval_id)
         if not ok:
             pending.respond(None, RuntimeError(
@@ -506,10 +527,12 @@ class PlanApplier:
             plans = self.plans_committed
             windows = list(self.windows)
             fallbacks = self.conflict_fallbacks
+            expired = self.expired_drops
         return {
             "commits": commits,
             "plans_committed": plans,
             "batch_occupancy": plans / commits if commits else 0.0,
             "conflict_fallbacks": fallbacks,
+            "expired_drops": expired,
             "windows": windows,
         }

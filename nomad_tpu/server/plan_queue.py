@@ -15,6 +15,8 @@ from typing import Optional
 
 from nomad_tpu.structs import Plan, PlanResult
 
+from .overload import ErrOverloaded
+
 
 class PlanFuture:
     """Result slot a submitting worker blocks on."""
@@ -46,12 +48,18 @@ class PlanFuture:
 
 
 class PlanQueue:
-    def __init__(self) -> None:
+    def __init__(self, max_depth: Optional[int] = None) -> None:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._enabled = False
         self._heap: list = []
         self._count = itertools.count()
+        # Overload control plane: a bounded queue sheds instead of
+        # letting the serialized commit point grow an unbounded backlog
+        # (the applier drains windows, so a standing backlog means the
+        # leader is past saturation — more queue only adds latency).
+        self.max_depth = max_depth
+        self._depth_sheds = 0
 
     def enabled(self) -> bool:
         with self._lock:
@@ -63,10 +71,20 @@ class PlanQueue:
         if not enabled:
             self.flush()
 
+    def depth(self) -> int:
+        """Pending plans — the admission controller's pressure source."""
+        with self._lock:
+            return len(self._heap)
+
     def enqueue(self, plan: Plan) -> PlanFuture:
         with self._lock:
             if not self._enabled:
                 raise RuntimeError("plan queue is disabled")
+            if self.max_depth is not None and \
+                    len(self._heap) >= self.max_depth:
+                self._depth_sheds += 1
+                raise ErrOverloaded(
+                    f"plan queue at depth bound {self.max_depth}")
             future = PlanFuture(plan)
             heapq.heappush(self._heap,
                            (-plan.priority, next(self._count), future))
@@ -114,4 +132,5 @@ class PlanQueue:
 
     def stats(self) -> dict:
         with self._lock:
-            return {"depth": len(self._heap)}
+            return {"depth": len(self._heap),
+                    "depth_sheds": self._depth_sheds}

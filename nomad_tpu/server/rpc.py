@@ -561,6 +561,13 @@ class ConnPool:
             # request that never leaves this host — transport-shaped,
             # so callers' retry policies treat it like a dead socket.
             faultinject.fire_rpc("rpc.send", method, args)
+        if timeout is not None and "_deadline" not in args:
+            # Deadline propagation (server/overload.py): the transport
+            # timeout IS the caller's remaining budget (RetryPolicy
+            # feeds each attempt's share here) — ship it so the server
+            # can drop the work the moment nobody is waiting.  Copy:
+            # retry loops re-send the same args dict.
+            args = dict(args, _deadline=timeout)
         address = (address[0], address[1])
         if self.multiplex:
             return self._call_mux(address, method, args, timeout)

@@ -63,6 +63,16 @@ class ServerConfig:
         self.node_gc_interval: float = 300.0
         self.node_gc_threshold: float = 24 * 3600.0
         self.region: str = "global"
+        # Overload control plane (server/overload.py): queue bounds
+        # feed admission pressure; brownout/overload thresholds drive
+        # priority shedding; heartbeat knobs drive expiry damping.
+        self.broker_depth_limit: int = 4096
+        self.plan_queue_depth: int = 1024
+        self.overload_brownout_ratio: float = 0.75
+        self.overload_ratio: float = 1.0
+        self.heartbeat_seed: Optional[int] = None  # seeded TTL jitter
+        self.heartbeat_reconcile_rate: float = 32.0  # expiries/s pacing
+        self.heartbeat_reconcile_burst: float = 8.0
         self.enable_rpc: bool = False
         self.bind_addr: str = "127.0.0.1"
         self.rpc_port: int = 0      # 0 = ephemeral
@@ -113,13 +123,38 @@ class Server:
             # thresholds cost 100-200ms pauses (utils/gctune.py).
             from nomad_tpu.utils.gctune import tune_gc
             tune_gc()
-        self.eval_broker = EvalBroker(self.config.eval_nack_timeout,
-                                      self.config.eval_delivery_limit)
-        self.plan_queue = PlanQueue()
+        # Overload control plane: one controller watches every queue
+        # and gates every admission point (server/overload.py).
+        from .overload import OverloadController
+        self.overload = OverloadController(
+            brownout_ratio=self.config.overload_brownout_ratio,
+            overload_ratio=self.config.overload_ratio)
+        self.eval_broker = EvalBroker(
+            self.config.eval_nack_timeout,
+            self.config.eval_delivery_limit,
+            admission=self.overload,
+            max_depth=self.config.broker_depth_limit)
+        self.plan_queue = PlanQueue(
+            max_depth=self.config.plan_queue_depth)
+        self.overload.add_source(
+            "eval_broker",
+            lambda: (self.eval_broker.depth(),
+                     self.config.broker_depth_limit))
+        self.overload.add_source(
+            "plan_queue",
+            lambda: (self.plan_queue.depth(),
+                     self.config.plan_queue_depth))
         self.fsm = NomadFSM(eval_broker=self.eval_broker)
 
+        import random as _random
+
         from .heartbeat import HeartbeatManager
-        self.heartbeats = HeartbeatManager(self)
+        self.heartbeats = HeartbeatManager(
+            self, overload=self.overload,
+            rng=_random.Random(self.config.heartbeat_seed)
+            if self.config.heartbeat_seed is not None else None,
+            reconcile_rate=self.config.heartbeat_reconcile_rate,
+            reconcile_burst=self.config.heartbeat_reconcile_burst)
         self.workers: list = []
         self._leader = False
         self._shutdown = threading.Event()
@@ -417,13 +452,18 @@ class Server:
             self.rpc_server.shutdown()
         self.conn_pool.shutdown()
         self.raft_pool.shutdown()
+        # After revoke (which cleared the timers): reap the heartbeat
+        # service threads so nothing fires into the torn-down server.
+        self.heartbeats.shutdown()
 
     def _restore_eval_broker(self) -> None:
         """Broker is volatile; state is durable.  Re-enqueue all
-        non-terminal evals from replicated state (leader.go:145-168)."""
+        non-terminal evals from replicated state (leader.go:145-168).
+        ``force``: these evals are already committed — shedding them
+        would silently diverge the broker from state."""
         for ev in self.fsm.state.evals():
             if ev.should_enqueue():
-                self.eval_broker.enqueue(ev)
+                self.eval_broker.enqueue(ev, force=True)
 
     def _reap_failed_evals(self) -> None:
         """Mark evals past the delivery limit as failed
@@ -464,6 +504,8 @@ class Server:
                 last_node_gc = now
 
     def _enqueue_core_eval(self, core_job_id: str) -> None:
+        from .overload import ErrOverloaded
+
         ev = Evaluation(
             id=generate_uuid(),
             priority=CORE_JOB_PRIORITY,
@@ -474,8 +516,12 @@ class Server:
             modify_index=self.raft.applied_index(),
         )
         # Core evals skip raft: they are leader-local work
-        # (leader.go:188-199).
-        self.eval_broker.enqueue(ev)
+        # (leader.go:188-199).  They are also the FIRST work a browning
+        # out leader sheds: GC can always run on the next interval.
+        try:
+            self.eval_broker.enqueue(ev)
+        except ErrOverloaded:
+            logger.debug("core eval %s shed under overload", core_job_id)
 
     # -- raft-backed mutations (the endpoint layer calls these) -----------
     def raft_apply(self, msg_type: int, payload: dict) -> int:

@@ -45,6 +45,13 @@ class Worker:
         self._pause_cond = threading.Condition()
         self._thread: Optional[threading.Thread] = None
         self.eval_token: str = ""
+        # Deadline propagation (server/overload.py): a delivery is only
+        # useful until the broker's nack timer redelivers the eval —
+        # past that, any plan this worker submits will be token-fenced
+        # anyway.  Stamped at dequeue, propagated onto submitted plans,
+        # and checked after potentially-long waits.
+        self._delivery_deadline: float = 0.0
+        self.expired_drops = 0  # deliveries abandoned past deadline
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> None:
@@ -97,11 +104,22 @@ class Worker:
             if ev is None:
                 continue
             self.eval_token = token
+            self._delivery_deadline = time.monotonic() + \
+                self.server.eval_broker.nack_timeout
             try:
                 self._wait_for_index(ev.modify_index, RAFT_SYNC_LIMIT)
+                self._check_delivery_live(ev)
                 self._invoke_scheduler(ev)
-            except Exception:
-                logger.exception("worker: failed to process eval %s", ev.id)
+            except Exception as e:
+                from .overload import ErrDeadlineExceeded
+                if isinstance(e, ErrDeadlineExceeded):
+                    # Expected overload behavior, not a failure: the
+                    # broker redelivers; no traceback spam.
+                    logger.warning("worker: dropped expired eval %s: %s",
+                                   ev.id, e)
+                else:
+                    logger.exception("worker: failed to process eval %s",
+                                     ev.id)
                 try:
                     self.server.eval_broker.nack(ev.id, token)
                 except ValueError:
@@ -111,6 +129,20 @@ class Worker:
                 self.server.eval_broker.ack(ev.id, token)
             except ValueError:
                 pass
+
+    def _check_delivery_live(self, ev: Evaluation) -> None:
+        """Drop work whose delivery deadline passed (a long raft
+        catch-up or pause outlived the nack window): the broker has
+        redelivered the eval, so scheduling it here only races the
+        retry toward a token-fenced plan."""
+        from .overload import ErrDeadlineExceeded
+
+        if self._delivery_deadline and \
+                time.monotonic() > self._delivery_deadline:
+            self.expired_drops += 1
+            metrics.incr_counter("nomad.worker.expired_drops")
+            raise ErrDeadlineExceeded(
+                f"delivery of eval {ev.id} outlived the nack window")
 
     def _wait_for_index(self, index: int, timeout: float) -> None:
         """Block until the local FSM has applied at least `index`
@@ -161,6 +193,10 @@ class Worker:
     # -- Planner seam ------------------------------------------------------
     def submit_plan(self, plan: Plan) -> tuple[PlanResult, Optional[object]]:
         plan.eval_token = self.eval_token
+        if self._delivery_deadline and not plan.deadline:
+            # Propagate: the applier drops this plan unverified once
+            # the delivery's nack window has passed (expired_drops).
+            plan.deadline = self._delivery_deadline
         future = self.server.plan_queue.enqueue(plan)
         result = self._wait_plan(future)
         state = None
@@ -209,9 +245,15 @@ class BatchWorker(Worker):
             backoff.reset()
             if not batch:
                 continue
+            self._delivery_deadline = time.monotonic() + \
+                self.server.eval_broker.nack_timeout
             max_index = max(ev.modify_index for ev, _ in batch)
             try:
                 self._wait_for_index(max_index, RAFT_SYNC_LIMIT)
+                # ErrDeadlineExceeded is a TimeoutError: an expired
+                # delivery nacks the batch below instead of burning a
+                # whole fused device dispatch on redelivered work.
+                self._check_delivery_live(batch[0][0])
             except TimeoutError:
                 for ev, token in batch:
                     try:
@@ -250,8 +292,14 @@ class _BatchPlanner:
 
     def submit_plan(self, plan: Plan):
         plan.eval_token = self.worker._tokens.get(plan.eval_id, "")
+        self._stamp_deadline(plan)
         future = self.worker.server.plan_queue.enqueue(plan)
         return self._await(future)
+
+    def _stamp_deadline(self, plan: Plan) -> None:
+        deadline = self.worker._delivery_deadline
+        if deadline and not plan.deadline:
+            plan.deadline = deadline
 
     def submit_plans(self, plans: list) -> list:
         """Group submit: enqueue the whole window BEFORE waiting any
@@ -265,6 +313,7 @@ class _BatchPlanner:
         futures = []
         for plan in plans:
             plan.eval_token = self.worker._tokens.get(plan.eval_id, "")
+            self._stamp_deadline(plan)
             try:
                 futures.append(
                     self.worker.server.plan_queue.enqueue(plan))

@@ -743,6 +743,12 @@ class Plan(_Struct):
     node_update: dict = field(default_factory=dict)       # node_id -> [Alloc]
     node_allocation: dict = field(default_factory=dict)   # node_id -> [Alloc]
     failed_allocs: list = field(default_factory=list)
+    # Overload control plane: absolute MONOTONIC deadline on this
+    # host's clock (0.0 = none).  The applier drops expired plans
+    # instead of verifying them (server/plan_apply.py expired_drops).
+    # Host-local only — the Plan.Submit endpoint re-stamps it from the
+    # RPC envelope's relative budget, never trusting a wire value.
+    deadline: float = 0.0
 
     def append_update(self, alloc: Allocation, status: str, desc: str) -> None:
         new = alloc.copy()

@@ -42,6 +42,27 @@ from .metrics import metrics
 # (ConnectionError is an OSError subclass — listed for readability.)
 DEFAULT_RETRYABLE = (ConnectionError, TimeoutError, OSError)
 
+# Admission-control NACKs (server/overload.ErrOverloaded) carry this
+# marker.  In-proc the exception is an OSError subclass (already
+# retryable above); over the wire it arrives as an RPCError whose
+# STRING carries the marker — ``is_overloaded`` classifies both, and
+# ``transport_or_overload`` is the retryable predicate for clients that
+# should ride a shedding server with jittered backoff.
+OVERLOADED_MARKER = "overloaded:"
+
+
+def is_overloaded(exc: BaseException) -> bool:
+    """True when ``exc`` is (or wraps, via the RPC error string) an
+    admission-control shed — retry later, with backoff."""
+    return OVERLOADED_MARKER in str(exc)[:128]
+
+
+def transport_or_overload(exc: BaseException) -> bool:
+    """Retryable predicate: transport-shaped failures OR an explicit
+    server shed (the ``ErrOverloaded`` NACK, in-proc or over the
+    wire)."""
+    return isinstance(exc, DEFAULT_RETRYABLE) or is_overloaded(exc)
+
 
 class RetryAborted(RuntimeError):
     """The stop event fired while waiting to retry (owner shutdown)."""

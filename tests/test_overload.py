@@ -1,0 +1,743 @@
+"""Overload control plane units (server/overload.py + ttlwheel.py):
+admission state machine + priority shedding, deadline propagation
+through envelope → broker → worker → applier, TTL wheel semantics,
+brownout expiry deferral, and token-bucket paced reconciliation.
+
+The seeded end-to-end brownout scenario lives in
+tests/test_chaos_overload.py (slow tier); these are the fast invariants.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu import faultinject
+from nomad_tpu.faultinject import FaultPlan, FaultSpecError
+from nomad_tpu.server.eval_broker import FAILED_QUEUE, EvalBroker
+from nomad_tpu.server.heartbeat import HeartbeatManager
+from nomad_tpu.server.overload import (
+    BROWNOUT,
+    CLASS_BATCH,
+    CLASS_SERVICE,
+    CLASS_SYSTEM,
+    NORMAL,
+    OVERLOAD,
+    ErrDeadlineExceeded,
+    ErrOverloaded,
+    OverloadController,
+    TokenBucket,
+    absolute_deadline,
+    classify_eval,
+    classify_rpc,
+    expired,
+    remaining,
+    restamp_forward,
+    stamp_arrival,
+)
+from nomad_tpu.server.plan_queue import PlanQueue
+from nomad_tpu.server.ttlwheel import TTLWheel
+from nomad_tpu.structs import Evaluation, Plan, generate_uuid
+from nomad_tpu.utils.retry import (
+    DEFAULT_RETRYABLE,
+    is_overloaded,
+    transport_or_overload,
+)
+
+from tests.conftest import wait_until
+
+
+def make_eval(priority=50, type_="service", job_id=None) -> Evaluation:
+    return Evaluation(
+        id=generate_uuid(), priority=priority, type=type_,
+        job_id=job_id or generate_uuid(), status="pending",
+        triggered_by="job-register",
+    )
+
+
+class FakeClock:
+    def __init__(self, start: float = 1000.0) -> None:
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, secs: float) -> None:
+        self.now += secs
+
+
+# ---------------------------------------------------------------------------
+# Error shapes + retry classification
+# ---------------------------------------------------------------------------
+
+class TestErrorShapes:
+    def test_overloaded_is_transport_shaped(self):
+        """In-proc callers ride ErrOverloaded under the DEFAULT
+        retryable tuple — it is an OSError by design."""
+        e = ErrOverloaded("eval broker")
+        assert isinstance(e, OSError)
+        assert isinstance(e, DEFAULT_RETRYABLE)
+        assert is_overloaded(e)
+        assert transport_or_overload(e)
+
+    def test_marker_survives_the_wire_string(self):
+        """Over RPC only str(e) survives; the marker classifies it."""
+        from nomad_tpu.server.rpc import RPCError
+
+        wire = RPCError(str(ErrOverloaded("plan queue")))
+        assert is_overloaded(wire)
+        assert transport_or_overload(wire)
+        assert not is_overloaded(RPCError("no cluster leader"))
+
+    def test_deadline_exceeded_is_timeout_shaped(self):
+        assert isinstance(ErrDeadlineExceeded("x"), TimeoutError)
+
+
+# ---------------------------------------------------------------------------
+# Deadline envelope plumbing
+# ---------------------------------------------------------------------------
+
+class TestDeadlineEnvelope:
+    def test_stamp_arrival_converts_relative_once(self):
+        clock = FakeClock()
+        args = {"x": 1, "_deadline": 5.0}
+        dl = stamp_arrival(args, clock=clock)
+        assert dl == pytest.approx(1005.0)
+        assert "_deadline" not in args
+        # Idempotent: a second stamp (in-proc chains re-enter the
+        # endpoint layer) keeps the original arrival time.
+        clock.advance(3.0)
+        assert stamp_arrival(args, clock=clock) == pytest.approx(1005.0)
+        assert absolute_deadline(args) == pytest.approx(1005.0)
+
+    def test_unbounded_envelope(self):
+        args = {"x": 1}
+        assert stamp_arrival(args) == 0.0
+        assert absolute_deadline(args) == 0.0
+        assert remaining(0.0, 60.0) == 60.0
+        assert not expired(0.0)
+
+    def test_restamp_forward_rebases_budget(self):
+        clock = FakeClock()
+        args = {"_deadline": 10.0}
+        stamp_arrival(args, clock=clock)
+        clock.advance(4.0)
+        fwd = restamp_forward(dict(args), clock=clock)
+        assert "_abs_deadline" not in fwd
+        assert fwd["_deadline"] == pytest.approx(6.0)
+        # Expired budgets clamp positive so the remote rejects cheaply.
+        clock.advance(60.0)
+        fwd = restamp_forward(dict(args), clock=clock)
+        assert fwd["_deadline"] == pytest.approx(0.001)
+
+    def test_remaining_caps_and_floors(self):
+        clock = FakeClock()
+        assert remaining(clock.now + 5.0, 60.0,
+                         clock=clock) == pytest.approx(5.0)
+        assert remaining(clock.now + 500.0, 60.0, clock=clock) == 60.0
+        clock.advance(1000.0)
+        assert remaining(clock.now - 1.0, 60.0,
+                         clock=clock) == pytest.approx(0.001)
+
+    def test_conn_pool_and_inproc_stamp_the_envelope(self):
+        """Both transports attach the caller's budget as _deadline."""
+        from nomad_tpu.server.rpc import ConnPool
+
+        seen = {}
+
+        class _Spy(ConnPool):
+            def _call_mux(self, address, method, args, timeout):
+                seen.update(args)
+                return {}
+
+        _Spy().call(("127.0.0.1", 1), "Status.Ping", {"a": 1}, timeout=7.5)
+        assert seen["_deadline"] == 7.5
+
+
+# ---------------------------------------------------------------------------
+# TokenBucket
+# ---------------------------------------------------------------------------
+
+class TestTokenBucket:
+    def test_burst_then_paced(self):
+        clock = FakeClock()
+        tb = TokenBucket(rate=10.0, burst=3.0, clock=clock)
+        assert all(tb.try_take() for _ in range(3))
+        assert not tb.try_take()
+        assert tb.wait_time() == pytest.approx(0.1)
+        clock.advance(0.1)
+        assert tb.try_take()
+        assert not tb.try_take()
+
+    def test_refill_caps_at_burst(self):
+        clock = FakeClock()
+        tb = TokenBucket(rate=100.0, burst=2.0, clock=clock)
+        clock.advance(60.0)
+        assert tb.try_take() and tb.try_take()
+        assert not tb.try_take()
+
+    def test_rejects_nonpositive_config(self):
+        with pytest.raises(ValueError):
+            TokenBucket(rate=0, burst=1)
+
+
+# ---------------------------------------------------------------------------
+# OverloadController
+# ---------------------------------------------------------------------------
+
+class TestController:
+    def _ctrl(self, depth_ref: dict, limit: int = 100) -> OverloadController:
+        ctrl = OverloadController(brownout_ratio=0.5, overload_ratio=1.0)
+        ctrl.add_source("q", lambda: (depth_ref["d"], limit))
+        return ctrl
+
+    def test_state_machine_with_hysteresis(self):
+        depth = {"d": 0}
+        ctrl = self._ctrl(depth)
+        assert ctrl.state() == NORMAL
+        depth["d"] = 50
+        assert ctrl.state() == BROWNOUT
+        depth["d"] = 100
+        assert ctrl.state() == OVERLOAD
+        # Pressure dips just below the brownout threshold: hysteresis
+        # holds brownout instead of snapping to normal (no flapping).
+        depth["d"] = 47
+        assert ctrl.state() == BROWNOUT
+        depth["d"] = 10
+        assert ctrl.state() == NORMAL
+
+    def test_priority_shedding_order(self):
+        """system > service > batch: brownout sheds batch only,
+        overload sheds batch+service, system always admits."""
+        depth = {"d": 60}
+        ctrl = self._ctrl(depth)
+        assert ctrl.shed_classes() == (CLASS_BATCH,)
+        with pytest.raises(ErrOverloaded):
+            ctrl.admit(CLASS_BATCH)
+        ctrl.admit(CLASS_SERVICE)
+        ctrl.admit(CLASS_SYSTEM)
+        depth["d"] = 150
+        assert set(ctrl.shed_classes()) == {CLASS_BATCH, CLASS_SERVICE}
+        with pytest.raises(ErrOverloaded):
+            ctrl.admit(CLASS_SERVICE)
+        ctrl.admit(CLASS_SYSTEM)
+        stats = ctrl.stats()
+        assert stats["shed"][CLASS_BATCH] == 1
+        assert stats["shed"][CLASS_SERVICE] == 1
+        assert stats["shed"][CLASS_SYSTEM] == 0
+
+    def test_heartbeats_bypass_admission(self):
+        """The liveness lane: heartbeats get through even in overload —
+        shedding them would CAUSE the TTL-expiry storm."""
+        depth = {"d": 1000}
+        ctrl = self._ctrl(depth)
+        assert ctrl.state() == OVERLOAD
+        ctrl.admit_rpc("Node.Heartbeat", {"node_id": "n1"})
+        assert ctrl.stats()["heartbeat_lane"] == 1
+
+    def test_forced_state_pins_the_machine(self):
+        ctrl = OverloadController()
+        assert ctrl.state() == NORMAL
+        ctrl.force_state(OVERLOAD)
+        assert ctrl.state() == OVERLOAD
+        assert ctrl.in_brownout()
+        ctrl.force_state(None)
+        assert ctrl.state() == NORMAL
+        with pytest.raises(ValueError):
+            ctrl.force_state("meltdown")
+
+    def test_dead_source_does_not_wedge_admission(self):
+        ctrl = OverloadController()
+        ctrl.add_source("dead", lambda: (_ for _ in ()).throw(
+            RuntimeError("torn down")))
+        assert ctrl.state() == NORMAL
+        ctrl.admit(CLASS_BATCH)
+
+
+class TestClassification:
+    @pytest.mark.parametrize("method,args,want", [
+        ("Node.Register", {}, CLASS_SYSTEM),
+        ("Eval.Ack", {}, CLASS_SYSTEM),
+        ("Plan.Submit", {}, CLASS_SYSTEM),
+        ("Status.Ping", {}, CLASS_SYSTEM),
+        ("Job.Deregister", {}, CLASS_SYSTEM),
+        ("Job.Register", {"job": {"type": "batch"}}, CLASS_BATCH),
+        ("Job.Register", {"job": {"type": "service"}}, CLASS_SERVICE),
+        ("Job.Register", {"job": {"type": "system"}}, CLASS_SYSTEM),
+        ("Job.List", {}, CLASS_SERVICE),
+        ("Alloc.List", {}, CLASS_SERVICE),
+    ])
+    def test_classify_rpc(self, method, args, want):
+        assert classify_rpc(method, args) == want
+
+    @pytest.mark.parametrize("type_,want", [
+        ("system", CLASS_SYSTEM),
+        ("service", CLASS_SERVICE),
+        ("batch", CLASS_BATCH),
+        ("_core", CLASS_BATCH),  # GC defers under pressure: sheds first
+    ])
+    def test_classify_eval(self, type_, want):
+        assert classify_eval(make_eval(type_=type_)) == want
+
+
+# ---------------------------------------------------------------------------
+# TTL wheel
+# ---------------------------------------------------------------------------
+
+class TestTTLWheel:
+    def test_expiry_fires_once(self):
+        fired = []
+        wheel = TTLWheel(fired.append, name="t-wheel")
+        try:
+            wheel.arm("a", 0.05)
+            wait_until(lambda: fired == ["a"], timeout=5.0,
+                       msg="wheel expiry")
+            assert wheel.active() == 0
+        finally:
+            wheel.stop()
+
+    def test_rearm_supersedes_and_cancel_disarms(self):
+        fired = []
+        wheel = TTLWheel(fired.append, name="t-wheel")
+        try:
+            wheel.arm("a", 0.03)
+            wheel.arm("a", 10.0)   # heartbeat: pushes the deadline out
+            wheel.arm("b", 0.03)
+            wheel.cancel("b")
+            wheel.arm("c", 0.03)
+            wait_until(lambda: "c" in fired, timeout=5.0, msg="c expiry")
+            time.sleep(0.1)  # sleep-ok: settle window proving a/b stayed silent
+            assert fired == ["c"]
+            assert wheel.armed("a") and not wheel.armed("b")
+        finally:
+            wheel.stop()
+
+    def test_one_thread_any_fleet_size(self):
+        """The point of the wheel: 1000 armed nodes, ONE service
+        thread (the per-node threading.Timer army it replaces would be
+        1000)."""
+        wheel = TTLWheel(lambda k: None, name="t-wheel")
+        try:
+            before = threading.active_count()
+            for i in range(1000):
+                wheel.arm(f"n-{i}", 60.0)
+            assert wheel.active() == 1000
+            assert threading.active_count() <= before + 1
+        finally:
+            wheel.stop()
+
+    def test_compaction_keeps_live_entries(self):
+        """Re-arm churn (every heartbeat) must not leak heap entries or
+        lose live deadlines."""
+        fired = []
+        wheel = TTLWheel(fired.append, name="t-wheel")
+        try:
+            for rep in range(40):
+                for i in range(50):
+                    wheel.arm(f"n-{i}", 30.0)
+            assert wheel.active() == 50
+            assert len(wheel._heap) < 2000  # compacted, not 40*50
+            wheel.arm("n-7", 0.02)  # live re-arm after churn still fires
+            wait_until(lambda: fired == ["n-7"], timeout=5.0,
+                       msg="post-compaction expiry")
+        finally:
+            wheel.stop()
+
+    def test_callback_failure_does_not_kill_the_wheel(self):
+        fired = []
+
+        def cb(key):
+            if key == "bad":
+                raise RuntimeError("boom")
+            fired.append(key)
+
+        wheel = TTLWheel(cb, name="t-wheel")
+        try:
+            wheel.arm("bad", 0.01)
+            wheel.arm("good", 0.05)
+            wait_until(lambda: fired == ["good"], timeout=5.0,
+                       msg="wheel survives callback failure")
+        finally:
+            wheel.stop()
+
+    def test_stop_joins_the_thread(self):
+        wheel = TTLWheel(lambda k: None, name="t-wheel-stop")
+        wheel.arm("a", 60.0)
+        thread = wheel._thread
+        wheel.stop()
+        assert thread is not None and not thread.is_alive()
+        with pytest.raises(RuntimeError):
+            wheel.arm("b", 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat manager: wheel mode, deferral, pacing, seeded jitter
+# ---------------------------------------------------------------------------
+
+class _StubServer:
+    """Just enough server for the heartbeat manager: records
+    invalidations."""
+
+    def __init__(self) -> None:
+        self.downed: list = []
+        self.down_times: list = []
+
+    def node_update_status(self, node_id, status):
+        self.downed.append(node_id)
+        self.down_times.append(time.monotonic())
+        return 1
+
+
+class TestHeartbeatDamping:
+    def test_real_expiry_invalidates_through_pacing(self):
+        srv = _StubServer()
+        hb = HeartbeatManager(srv, min_ttl=0.05, grace=0.0)
+        try:
+            hb._arm("n-1", 0.05)
+            wait_until(lambda: srv.downed == ["n-1"], timeout=5.0,
+                       msg="paced invalidation")
+        finally:
+            hb.shutdown()
+
+    def test_heartbeat_rescues_node_pending_invalidation(self):
+        """Zero false expiries by construction: a heartbeat arriving
+        while the node waits in the pacing queue pulls it back out."""
+        srv = _StubServer()
+        hb = HeartbeatManager(srv, min_ttl=0.2, grace=0.0,
+                              reconcile_rate=0.5, reconcile_burst=1.0)
+        try:
+            # Exhaust the burst so the victim queues behind pacing.
+            hb._bucket.try_take()
+            hb._on_ttl_expire("n-victim")
+            assert hb.stats()["pending_expiries"] == 1
+            hb.reset_heartbeat_timer("n-victim")  # the node IS alive
+            assert hb.stats()["pending_expiries"] == 0
+            assert hb.stats()["rescued"] == 1
+            time.sleep(0.1)  # sleep-ok: settle window proving no invalidation
+            assert srv.downed == []
+        finally:
+            hb.shutdown()
+
+    def test_mass_expiry_drains_at_bounded_rate(self):
+        """The damping contract: N simultaneous expiries reach the
+        broker as a paced trickle, not one storm."""
+        srv = _StubServer()
+        hb = HeartbeatManager(srv, reconcile_rate=20.0,
+                              reconcile_burst=2.0)
+        try:
+            for i in range(10):
+                hb._on_ttl_expire(f"n-{i}")
+            wait_until(lambda: len(srv.downed) == 10, timeout=10.0,
+                       msg="paced drain")
+            # Burst of 2 immediately; the remaining 8 at 20/s => >=0.35s
+            # spread.  A storm (no pacing) lands in ~ms.
+            spread = max(srv.down_times) - min(srv.down_times)
+            assert spread >= 0.3, f"expiries not paced: {spread:.3f}s"
+        finally:
+            hb.shutdown()
+
+    def test_brownout_defers_expiry(self):
+        """While the server is browning out, a missed TTL re-arms
+        instead of invalidating: the server's own slowness can never
+        mass-expire the fleet."""
+        srv = _StubServer()
+        ctrl = OverloadController()
+        ctrl.force_state(BROWNOUT)
+        hb = HeartbeatManager(srv, overload=ctrl, brownout_defer=0.05)
+        try:
+            hb._on_ttl_expire("n-1")
+            assert hb.stats()["deferred_expiries"] == 1
+            assert srv.downed == []
+            assert hb.active() == 1  # re-armed at the defer TTL
+            # Brownout clears -> the deferred TTL expires for real.
+            ctrl.force_state(None)
+            wait_until(lambda: srv.downed == ["n-1"], timeout=5.0,
+                       msg="post-brownout expiry")
+        finally:
+            hb.shutdown()
+
+    def test_seeded_jitter_replays_bit_stable(self):
+        import random
+
+        ttls = []
+        for _ in range(2):
+            hb = HeartbeatManager(_StubServer(),
+                                  rng=random.Random(42))
+            try:
+                ttls.append([hb.reset_heartbeat_timer(f"n-{i}")
+                             for i in range(5)])
+            finally:
+                hb.shutdown()
+        assert ttls[0] == ttls[1]
+
+    def test_clear_disarms_pending_invalidations(self):
+        """Leadership revoked mid-pacing: a follower must never
+        invalidate queued nodes."""
+        srv = _StubServer()
+        hb = HeartbeatManager(srv, reconcile_rate=0.5,
+                              reconcile_burst=1.0)
+        try:
+            hb._bucket.try_take()  # force pacing
+            hb._on_ttl_expire("n-1")
+            hb.clear()
+            assert hb.stats()["pending_expiries"] == 0
+            time.sleep(0.05)  # sleep-ok: settle window proving no invalidation
+            assert srv.downed == []
+        finally:
+            hb.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Broker admission + deadlines
+# ---------------------------------------------------------------------------
+
+class TestBrokerAdmission:
+    def _broker(self, ctrl=None, **kw) -> EvalBroker:
+        b = EvalBroker(nack_timeout=5, delivery_limit=3,
+                       admission=ctrl, **kw)
+        b.set_enabled(True)
+        return b
+
+    def test_brownout_sheds_batch_admits_service(self):
+        ctrl = OverloadController()
+        ctrl.force_state(BROWNOUT)
+        b = self._broker(ctrl)
+        with pytest.raises(ErrOverloaded):
+            b.enqueue(make_eval(type_="batch"))
+        b.enqueue(make_eval(type_="service"))
+        b.enqueue(make_eval(type_="system"))
+        assert b.stats()["total_ready"] == 2
+
+    def test_force_bypasses_admission_and_bound(self):
+        """Committed-state paths (FSM apply, leadership restore) must
+        never shed — the broker would diverge from state."""
+        ctrl = OverloadController()
+        ctrl.force_state(OVERLOAD)
+        b = self._broker(ctrl, max_depth=1)
+        b.enqueue(make_eval(type_="system"))
+        b.enqueue(make_eval(type_="batch"), force=True)
+        b.enqueue(make_eval(type_="service"), force=True)
+        assert b.stats()["total_ready"] == 3
+
+    def test_depth_bound_sheds(self):
+        b = self._broker(max_depth=2)
+        b.enqueue(make_eval())
+        b.enqueue(make_eval())
+        with pytest.raises(ErrOverloaded):
+            b.enqueue(make_eval())
+        assert b.stats()["depth_sheds"] == 1
+        # Re-enqueue of a tracked eval is not a new admission.
+        ev = make_eval()
+        with pytest.raises(ErrOverloaded):
+            b.enqueue(ev)
+
+    def test_deadline_expired_eval_never_reaches_a_worker(self):
+        """The dequeue-side drop: expired work routes to the failed
+        queue (the reaper makes it terminal) and counts as an
+        expired_drop; live work behind it is still delivered."""
+        b = self._broker()
+        dead = make_eval(priority=90)
+        live = make_eval(priority=10)
+        b.enqueue(dead, deadline=time.monotonic() - 0.1)
+        b.enqueue(live)
+        ev, token = b.dequeue(["service"], timeout=0.2)
+        assert ev is not None and ev.id == live.id
+        assert b.stats()["expired_drops"] == 1
+        # The dropped eval is delivered to the reaper's queue instead.
+        failed_ev, ftoken = b.dequeue([FAILED_QUEUE], timeout=0.2)
+        assert failed_ev is not None and failed_ev.id == dead.id
+        b.ack(failed_ev.id, ftoken)
+        b.ack(ev.id, token)
+
+    def test_expired_drop_keeps_job_serialization(self):
+        """A dropped eval holds its job's in-flight slot until the
+        reaper acks (exactly like the delivery-limit path): blocked
+        siblings must not double-deliver."""
+        b = self._broker()
+        job = generate_uuid()
+        first = make_eval(job_id=job)
+        sibling = make_eval(job_id=job)
+        b.enqueue(first, deadline=time.monotonic() - 0.1)
+        b.enqueue(sibling)
+        assert b.stats()["total_blocked"] == 1
+        ev, _ = b.dequeue(["service"], timeout=0.05)
+        assert ev is None  # sibling stays blocked behind the drop
+        failed_ev, ftoken = b.dequeue([FAILED_QUEUE], timeout=0.2)
+        assert failed_ev.id == first.id
+        b.ack(failed_ev.id, ftoken)  # reaper acks -> sibling promotes
+        ev, token = b.dequeue(["service"], timeout=0.5)
+        assert ev is not None and ev.id == sibling.id
+        b.ack(ev.id, token)
+
+    def test_live_deadline_is_delivered(self):
+        b = self._broker()
+        ev_in = make_eval()
+        b.enqueue(ev_in, deadline=time.monotonic() + 30.0)
+        ev, token = b.dequeue(["service"], timeout=0.2)
+        assert ev is not None and ev.id == ev_in.id
+        assert b.stats()["expired_drops"] == 0
+        b.ack(ev.id, token)
+
+    def test_disabled_broker_arms_no_wait_timers(self):
+        """Stray threading.Timers must never fire into a torn-down
+        server: a disabled broker queues nothing and arms nothing."""
+        b = EvalBroker(nack_timeout=5, delivery_limit=3)
+        ev = make_eval()
+        ev.wait = 30.0
+        b.enqueue(ev, force=True)
+        assert b.stats()["total_waiting"] == 0
+
+    def test_broker_enqueue_fault_site(self):
+        """The new chokepoint: a broker.enqueue error rule injects at
+        admission, predicated on scheduler type via ``method``."""
+        b = self._broker()
+        plan = FaultPlan(seed=1).add("broker.enqueue", "error",
+                                     method="batch")
+        with faultinject.injected(plan):
+            with pytest.raises(faultinject.FaultInjected):
+                b.enqueue(make_eval(type_="batch"))
+            b.enqueue(make_eval(type_="service"))
+        assert plan.fire_count("broker.enqueue") == 1
+
+    def test_rpc_admit_site_context_validated(self):
+        """SITE_CONTEXT rejects predicates the new sites cannot satisfy
+        (a silently-never-firing chaos rule is the worst outcome)."""
+        FaultPlan().add("rpc.admit", "error", method="Job.*")
+        FaultPlan().add("broker.enqueue", "drop", node="n-*")
+        with pytest.raises(FaultSpecError):
+            FaultPlan().add("raft.apply", "error", method="Job.*")
+
+
+# ---------------------------------------------------------------------------
+# Plan queue bound + applier deadline drops
+# ---------------------------------------------------------------------------
+
+class TestPlanPathDeadlines:
+    def test_plan_queue_depth_bound(self):
+        pq = PlanQueue(max_depth=2)
+        pq.set_enabled(True)
+        pq.enqueue(Plan(eval_id="e1"))
+        pq.enqueue(Plan(eval_id="e2"))
+        with pytest.raises(ErrOverloaded):
+            pq.enqueue(Plan(eval_id="e3"))
+        assert pq.stats()["depth_sheds"] == 1
+        pq.set_enabled(False)
+
+    def test_applier_drops_expired_plans(self):
+        """An expired plan is answered with ErrDeadlineExceeded without
+        verification; live plans in the same window commit normally."""
+        from nomad_tpu.server.fsm import NomadFSM
+        from nomad_tpu.server.plan_apply import PlanApplier
+        from nomad_tpu.server.raft import InmemRaft
+
+        broker = EvalBroker(nack_timeout=30, delivery_limit=3)
+        broker.set_enabled(True)
+        fsm = NomadFSM(eval_broker=broker)
+        raft = InmemRaft(fsm)
+        node = mock.node(1)
+        fsm.state.upsert_node(1, node)
+        pq = PlanQueue()
+        pq.set_enabled(True)
+        applier = PlanApplier(pq, broker, raft, lambda: fsm.state)
+
+        def outstanding_plan(deadline=0.0) -> Plan:
+            ev = make_eval()
+            broker.enqueue(ev)
+            got, token = broker.dequeue(["service"], timeout=1.0)
+            assert got.id == ev.id
+            plan = Plan(eval_id=ev.id, eval_token=token,
+                        deadline=deadline)
+            alloc = mock.alloc()
+            alloc.node_id = node.id
+            plan.append_alloc(alloc)
+            return plan
+
+        expired_f = pq.enqueue(outstanding_plan(
+            deadline=time.monotonic() - 0.5))
+        live_f = pq.enqueue(outstanding_plan(
+            deadline=time.monotonic() + 30.0))
+        applier.start()
+        try:
+            with pytest.raises(ErrDeadlineExceeded):
+                expired_f.wait(5.0)
+            result = live_f.wait(5.0)
+            assert result is not None and result.node_allocation
+            assert applier.stats()["expired_drops"] == 1
+        finally:
+            pq.set_enabled(False)
+            applier.join(5.0)
+
+    def test_worker_stamps_delivery_deadline_on_plans(self):
+        """The worker propagates its nack-window deadline onto every
+        plan it submits — the applier's drop has something to check."""
+        from nomad_tpu.server import Server, ServerConfig
+
+        srv = Server(ServerConfig(num_schedulers=0,
+                                  eval_nack_timeout=7.0))
+        srv.establish_leadership()
+        try:
+            from nomad_tpu.server.worker import Worker
+
+            w = Worker(srv)
+            w._delivery_deadline = time.monotonic() + 7.0
+            seen = {}
+            real_enqueue = srv.plan_queue.enqueue
+
+            def spy(plan):
+                seen["deadline"] = plan.deadline
+                return real_enqueue(plan)
+
+            srv.plan_queue.enqueue = spy
+            plan = Plan(eval_id=generate_uuid())
+            try:
+                w.submit_plan(plan)
+            except Exception:
+                pass  # noop plan fencing may reject; the stamp happened
+            assert seen["deadline"] == pytest.approx(
+                w._delivery_deadline)
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: RPC admission at a real server
+# ---------------------------------------------------------------------------
+
+class TestServerAdmission:
+    def test_overloaded_server_sheds_job_but_serves_heartbeat(self):
+        from nomad_tpu.server import Server, ServerConfig
+        from nomad_tpu.server.rpc import ConnPool, RPCError
+
+        srv = Server(ServerConfig(num_schedulers=0, enable_rpc=True))
+        srv.establish_leadership()
+        pool = ConnPool()
+        try:
+            addr = srv.rpc_address()
+            node = mock.node(1)
+            pool.call(addr, "Node.Register", {"node": node.to_dict()})
+            srv.overload.force_state(OVERLOAD)
+            job = mock.job()
+            with pytest.raises(RPCError) as exc:
+                pool.call(addr, "Job.Register", {"job": job.to_dict()})
+            assert is_overloaded(exc.value)
+            # The liveness lane stays open in full overload.
+            out = pool.call(addr, "Node.Heartbeat",
+                            {"node_id": node.id})
+            assert out["heartbeat_ttl"] > 0
+            # Shedding cleared: the SAME register now rides a retry
+            # policy to success (the client-side story).
+            srv.overload.force_state(None)
+            from nomad_tpu.utils.retry import (RetryPolicy,
+                                               transport_or_overload)
+            policy = RetryPolicy(base=0.01, max_attempts=3,
+                                 retryable=transport_or_overload,
+                                 name="test.overload")
+            out = policy.call(lambda: pool.call(
+                addr, "Job.Register", {"job": job.to_dict()}))
+            assert out["eval_id"]
+            assert srv.overload.stats()["shed"][CLASS_SERVICE] >= 1
+        finally:
+            pool.shutdown()
+            srv.shutdown()

@@ -198,6 +198,43 @@ class TestLintGate:
                        for e in allow), \
             "group-commit paths must not need allowlist entries"
 
+    def test_overload_plane_rides_the_gates(self):
+        """ISSUE 6 satellite: the overload control plane
+        (server/overload.py) and the TTL wheel (server/ttlwheel.py +
+        the rewritten heartbeat manager) are inside every gate's scan
+        set — blocking-under-lock, lock-order, and thread-lifecycle
+        passes — with zero findings and no allowlist entries of their
+        own."""
+        from nomad_tpu.analysis import (default_package_root,
+                                        load_allowlist)
+        from nomad_tpu.analysis.callgraph import CallGraph
+
+        pkg = default_package_root()
+        graph = CallGraph.build(pkg)
+        for qual in (
+            "nomad_tpu.server.overload:OverloadController.admit",
+            "nomad_tpu.server.overload:TokenBucket.try_take",
+            "nomad_tpu.server.ttlwheel:TTLWheel.arm",
+            "nomad_tpu.server.ttlwheel:TTLWheel._run",
+            "nomad_tpu.server.heartbeat:"
+            "HeartbeatManager._reconcile_loop",
+            "nomad_tpu.server.heartbeat:HeartbeatManager._on_ttl_expire",
+        ):
+            assert qual in graph.functions, \
+                f"{qual} missing from the interprocedural graph"
+
+        findings = run_lint(strict=True)
+        touching = [f for f in findings
+                    if "overload" in f.path or "ttlwheel" in f.path
+                    or "heartbeat" in f.path]
+        assert touching == [], \
+            "overload plane must lint clean:\n" + \
+            "\n".join(f.render() for f in touching)
+        allow = load_allowlist(default_allowlist_path())
+        assert not any("server/overload" in e or "server/ttlwheel" in e
+                       or "server/heartbeat" in e for e in allow), \
+            "overload plane must not need allowlist entries"
+
     def test_fixed_sleep_ratchet_is_clean(self):
         """Every fixed time.sleep in the test tree is either converted
         to wait_until or carries a '# sleep-ok: why' justification —
