@@ -4,36 +4,107 @@ Capability parity with /root/reference/command/agent/http.go: JSON codec,
 the route table of http.go:93-121, blocking-query params
 (?wait=5s&index=N&stale&pretty), X-Nomad-Index response headers, and error
 coding (404 unknown route, 405 bad method, 500 with message body).
+
+Serving is event-driven like the RPC plane (server/mux.py): one
+selector thread accepts connections and watches idle keep-alive
+sockets, and a bounded worker pool parses/answers requests — resource
+usage is O(worker pool), not O(connected clients).  A connection only
+costs a thread while a complete-ish request is being served (the
+per-request socket timeout bounds a mid-headers slowloris); between
+requests it parks in the selector.  Past the connection cap new
+clients are shed with an immediate 503 instead of accepted-then-
+starved, and idle keep-alive connections are reaped on a timeout.
 """
 from __future__ import annotations
 
 import json
 import logging
+import selectors
+import socket
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from nomad_tpu.server.mux import DispatchPool
 from nomad_tpu.utils.duration import parse_duration
 
 logger = logging.getLogger("nomad_tpu.agent.http")
+
+HTTP_WORKERS = 8
+HTTP_MAX_CONNS = 2048
+HTTP_IDLE_TIMEOUT = 120.0
+HTTP_READ_DEADLINE = 10.0
+
+_SHED_503 = (b"HTTP/1.1 503 Service Unavailable\r\n"
+             b"Content-Length: 22\r\nConnection: close\r\n"
+             b"Content-Type: application/json\r\n\r\n"
+             b'{"error":"overloaded"}')
 
 
 class BadRequest(Exception):
     """Client error -> HTTP 400 (reference http.go CodedError)."""
 
 
+def _read_exact(rfile, n: int) -> bytes:
+    """Read exactly ``n`` body bytes from the unbuffered rfile (raw
+    SocketIO reads may return short)."""
+    chunks = []
+    while n > 0:
+        chunk = rfile.read(n)
+        if not chunk:
+            break
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
 class HTTPServer:
     def __init__(self, agent, host: str = "127.0.0.1",
-                 port: int = 4646) -> None:
+                 port: int = 4646, workers: int = HTTP_WORKERS,
+                 max_conns: int = HTTP_MAX_CONNS,
+                 idle_timeout: float = HTTP_IDLE_TIMEOUT,
+                 read_deadline: float = HTTP_READ_DEADLINE) -> None:
         self.agent = agent
+        self.max_conns = max_conns
+        self.idle_timeout = idle_timeout
+        self.read_deadline = read_deadline
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            timeout = read_deadline  # socket timeout while parsing
 
             def log_message(self, fmt, *args) -> None:
                 logger.debug("http: " + fmt, *args)
+
+            def _buffered_pending(self) -> bool:
+                """Bytes already pulled into the buffered reader (or
+                readable right now) — they must be served before the
+                raw socket re-parks in the selector, or a pipelined
+                request would be silently swallowed.  Probed without
+                blocking: an empty buffer + quiet socket returns
+                False via BlockingIOError."""
+                try:
+                    self.connection.settimeout(0)
+                    try:
+                        return bool(self.rfile.peek(1))
+                    finally:
+                        self.connection.settimeout(self.timeout)
+                except (BlockingIOError, OSError, ValueError):
+                    return False
+
+            def handle(self) -> None:
+                # One dispatch serves the request in hand plus any
+                # already-buffered pipelined ones; keep-alive then
+                # re-parks the socket instead of pinning a worker.
+                self.close_connection = True
+                self.handle_one_request()
+                while not self.close_connection and \
+                        self._buffered_pending():
+                    self.handle_one_request()
 
             def _respond(self, code: int, payload, pretty: bool = False,
                          index: Optional[int] = None) -> None:
@@ -57,13 +128,24 @@ class HTTPServer:
                 length = int(self.headers.get("Content-Length") or 0)
                 if length:
                     try:
-                        body = json.loads(self.rfile.read(length))
+                        body = json.loads(_read_exact(self.rfile,
+                                                      length))
                     except ValueError:
                         self._respond(400, {"error": "invalid JSON body"})
                         return
                 try:
-                    code, payload, index = outer.route(
-                        self.command, url.path, query, body)
+                    if "index" in query:
+                        # Blocking query: the in-proc RPC path waits
+                        # synchronously, so mark this worker parked —
+                        # bounded overflow workers keep the HTTP plane
+                        # live while long-polls wait (a handful of 5m
+                        # watches must never freeze the whole API).
+                        with outer._pool.blocking():
+                            code, payload, index = outer.route(
+                                self.command, url.path, query, body)
+                    else:
+                        code, payload, index = outer.route(
+                            self.command, url.path, query, body)
                 except KeyError as e:
                     self._respond(404, {"error": str(e)})
                     return
@@ -82,23 +164,217 @@ class HTTPServer:
 
             do_GET = do_PUT = do_POST = do_DELETE = _handle
 
-        self._server = ThreadingHTTPServer((host, port), _Handler)
-        self._server.daemon_threads = True
-        self.address = self._server.server_address
+        self._handler_cls = _Handler
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind((host, port))
+        listener.listen(256)
+        listener.setblocking(False)
+        self._listener = listener
+        self.address = listener.getsockname()
+
+        self._pool = DispatchPool(workers, max_queue=max_conns,
+                                  name="http-dispatch")
+        self._sel = selectors.DefaultSelector()
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self._ops: deque = deque()   # (sock, addr) to re-park
+        # fd -> (sock, addr, last_activity, reap_after).  A freshly
+        # accepted connection that has never spoken gets read_deadline
+        # before the sweep reaps it — a silent connect must not camp a
+        # max_conns slot for the whole keep-alive idle_timeout; only a
+        # connection that has completed a request earns idle_timeout.
+        self._conns: dict = {}
+        self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Counters (loop thread only).
+        self.accepts = 0
+        self.conn_sheds = 0
+        self.closed_idle = 0
+        self.closed_deadline = 0
 
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._server.serve_forever,
-                                        daemon=True, name="http-listener")
+        self._pool.start()
+        self._sel.register(self._listener, selectors.EVENT_READ, "accept")
+        self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="http-loop")
         self._thread.start()
 
     def shutdown(self) -> None:
-        self._server.shutdown()
-        self._server.server_close()
-        # serve_forever returns once shutdown() unblocks; reap the
-        # listener so agent teardown leaves no thread behind.
-        if self._thread is not None:
+        self._stop.set()
+        self._wakeup()
+        if self._thread is not None and \
+                self._thread is not threading.current_thread():
             self._thread.join(2.0)
+        self._pool.shutdown()
+
+    def stats(self) -> dict:
+        return {"open_conns": len(self._conns), "accepts": self.accepts,
+                "conn_sheds": self.conn_sheds,
+                "closed_idle": self.closed_idle,
+                "closed_deadline": self.closed_deadline,
+                "pool": self._pool.stats()}
+
+    # -- the edge loop ------------------------------------------------------
+    def _wakeup(self) -> None:
+        try:
+            self._wake_w.send(b"\0")
+        except (BlockingIOError, OSError):
+            pass
+
+    def _run(self) -> None:
+        last_sweep = time.monotonic()
+        try:
+            while not self._stop.is_set():
+                # Per-iteration guard: one thread IS the whole HTTP
+                # edge — an unexpected exception must cost at most one
+                # iteration, never the listener (same rationale as
+                # EdgeLoop._run).
+                try:
+                    last_sweep = self._run_once(last_sweep)
+                except Exception:
+                    logger.exception("http loop iteration failed; "
+                                     "continuing")
+                    time.sleep(0.05)
+        finally:
+            for sock, _addr, _ts, _reap in list(self._conns.values()):
+                self._drop(sock)
+            for sock in (self._listener, self._wake_r, self._wake_w):
+                try:
+                    self._sel.unregister(sock)
+                except (KeyError, ValueError):
+                    pass
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._sel.close()
+
+    def _run_once(self, last_sweep: float) -> float:
+        events = self._sel.select(0.25)
+        for key, _mask in events:
+            if key.data == "accept":
+                self._accept()
+            elif key.data == "wake":
+                try:
+                    while self._wake_r.recv(4096):
+                        pass
+                except (BlockingIOError, OSError):
+                    pass
+            else:
+                self._dispatch(key.data)
+        while self._ops:
+            try:
+                sock, addr = self._ops.popleft()
+            except IndexError:
+                break
+            self._park(sock, addr)
+        now = time.monotonic()
+        if now - last_sweep >= 1.0:
+            self._sweep(now)
+            return now
+        return last_sweep
+
+    def _accept(self) -> None:
+        while True:
+            try:
+                sock, addr = self._listener.accept()
+            except (BlockingIOError, OSError):
+                return
+            self.accepts += 1
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP,
+                                socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            if len(self._conns) + self._pool.depth() >= self.max_conns:
+                # Shed at the door: a 503 now beats accept-then-starve.
+                self.conn_sheds += 1
+                try:
+                    sock.setblocking(False)
+                    sock.send(_SHED_503)
+                except OSError:
+                    pass
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
+            self._park(sock, addr, fresh=True)
+
+    def _park(self, sock: socket.socket, addr, fresh: bool = False) -> None:
+        """Watch an (idle) connection for its next request.  ``fresh``
+        connections (straight off accept, no request served yet) are
+        reaped on ``read_deadline``; keep-alive re-parks earn the full
+        ``idle_timeout``."""
+        if self._stop.is_set():
+            self._drop(sock)
+            return
+        reap_after = self.read_deadline if fresh else self.idle_timeout
+        try:
+            sock.setblocking(False)
+            self._conns[sock.fileno()] = (sock, addr, time.monotonic(),
+                                          reap_after)
+            self._sel.register(sock, selectors.EVENT_READ, (sock, addr))
+        except (OSError, ValueError, KeyError):
+            self._drop(sock)
+
+    def _dispatch(self, data) -> None:
+        """A parked connection went readable: hand it to the pool."""
+        sock, addr = data
+        try:
+            self._sel.unregister(sock)
+        except (KeyError, ValueError):
+            pass
+        self._conns.pop(sock.fileno(), None)
+        if not self._pool.submit(lambda: self._serve_one(sock, addr)):
+            try:
+                sock.send(_SHED_503)
+            except OSError:
+                pass
+            self._drop(sock)
+
+    def _serve_one(self, sock: socket.socket, addr) -> None:
+        """Worker: parse and answer ONE request, then re-park or close.
+        The handler's socket timeout bounds a stalled mid-request
+        client, so a slowloris costs a worker at most read_deadline."""
+        try:
+            sock.setblocking(True)
+            handler = self._handler_cls(sock, addr, self)
+            keep = not handler.close_connection
+        except (ConnectionError, OSError, ValueError):
+            keep = False
+        except Exception:
+            logger.debug("http connection failed", exc_info=True)
+            keep = False
+        if keep and not self._stop.is_set():
+            self._ops.append((sock, addr))
+            self._wakeup()
+        else:
+            self._drop(sock)
+
+    def _sweep(self, now: float) -> None:
+        for fd, (sock, _addr, ts, reap_after) in list(self._conns.items()):
+            if now - ts > reap_after:
+                try:
+                    self._sel.unregister(sock)
+                except (KeyError, ValueError):
+                    pass
+                self._conns.pop(fd, None)
+                self._drop(sock)
+                if reap_after == self.idle_timeout:
+                    self.closed_idle += 1
+                else:
+                    self.closed_deadline += 1
+
+    @staticmethod
+    def _drop(sock: socket.socket) -> None:
+        try:
+            sock.close()
+        except OSError:
+            pass
 
     # -- routing -----------------------------------------------------------
     def route(self, method: str, path: str, query: dict, body):

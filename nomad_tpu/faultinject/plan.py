@@ -60,6 +60,9 @@ SITES = (
     "device.dispatch",    # a device placement dispatch starting
     "device.collect",     # blocking on a device dispatch's results
     "driver.start",       # a task driver starting a task
+    "mux.accept",         # the serving-plane event loop accepting a conn
+    "conn.read",          # bytes arriving on a multiplexed client conn
+    "watch.deliver",      # the watch fan-out waking a matured waiter
 )
 
 # Which match-predicate context each site's instrumentation supplies.
@@ -78,6 +81,12 @@ SITE_CONTEXT = {
     "device.dispatch": (),
     "device.collect": (),
     "driver.start": ("method",),
+    # Serving-plane edge sites: accept/read know nothing about the
+    # request yet (frames decode later), so they carry no predicates;
+    # watch.deliver passes the watch key's table name as ``method``.
+    "mux.accept": (),
+    "conn.read": (),
+    "watch.deliver": ("method",),
 }
 
 ACTIONS = ("error", "drop", "delay", "hang")

@@ -14,12 +14,13 @@ Wire shapes are the structs' dict forms; query options ride in the args map
 from __future__ import annotations
 
 import random
-import time
+import threading
 from typing import Optional
 
 from nomad_tpu import faultinject
 from nomad_tpu.structs import Allocation, Evaluation, Job, Node
 
+from . import mux
 from . import overload as overload_mod
 
 MAX_BLOCKING_WAIT = 300.0  # reference nomad/rpc.go:30-40
@@ -87,6 +88,16 @@ class Endpoints:
         point is that rejecting is radically cheaper than serving."""
         def admitted(args: dict):
             overload_mod.stamp_arrival(args)
+            if "_watch_fired" in args:
+                # A resumed parked blocking query was admitted when it
+                # arrived; it is NOT a new arrival.  Re-admitting here
+                # could shed an already-accepted request mid-wait with
+                # ErrOverloaded instead of the answered-with-current-
+                # state reply the blocking-query contract guarantees
+                # (and would double-fire the rpc.admit site per logical
+                # request).  stamp_arrival is idempotent, so the
+                # original envelope deadline survives the resume.
+                return handler(args)
             if faultinject.ACTIVE:
                 faultinject.fire_rpc("rpc.admit", method, args)
             ctrl = self.server.overload
@@ -123,7 +134,14 @@ class Endpoints:
                 addr = self.server.region_server(region)
                 fwd_args = overload_mod.restamp_forward(dict(args))
                 fwd_args["_region_forwarded"] = True
-                return self.server.conn_pool.call(addr, method, fwd_args)
+                # A forward can hold this dispatch worker for a whole
+                # blocking-query window (the remote side parks, WE
+                # can't): mark it blocking so the pool spawns bounded
+                # overflow instead of letting a handful of forwarded
+                # long-polls pin every worker and starve heartbeats.
+                with mux.blocking_section():
+                    return self.server.conn_pool.call(addr, method,
+                                                      fwd_args)
             return handler(args)
         return routed
 
@@ -148,37 +166,66 @@ class Endpoints:
             return None
         fwd_args = overload_mod.restamp_forward(dict(args))
         fwd_args["_forwarded"] = True
-        return self.server.conn_pool.call(tuple(leader), method, fwd_args)
+        # Same reasoning as the region forward: a leader-forwarded
+        # blocking query parks on the LEADER; this follower's worker
+        # waits it out synchronously, so mark the wait blocking and
+        # let the pool overflow (bounded) rather than pinning workers.
+        with mux.blocking_section():
+            return self.server.conn_pool.call(tuple(leader), method,
+                                              fwd_args)
 
     def _state(self):
         return self.server.fsm.state
 
     def _blocking(self, args: dict, table: str, run) -> dict:
         """Blocking-query wrapper: wait until the table index passes
-        min_query_index or the (jittered, capped) wait expires."""
+        min_query_index or the (jittered, capped) wait expires.
+
+        On the event-driven serving plane the wait is not a parked
+        thread: the handler raises ``mux.Parked`` carrying a watch-fan-
+        out subscription and the dispatch worker is freed; the request
+        re-enters this function (``_watch_fired`` stamped) when the
+        index advances or the TTL-wheel timeout fires, and answers with
+        current state either way — byte-identical responses to the
+        synchronous path (tests/test_blocking_query_port.py locks both
+        down).  Synchronous callers (in-proc agent RPC) park ONE shared
+        fan-out waiter and wait on a local event — registered once,
+        deregistered in ``finally``, so an abandoned wait can never
+        leak a registry entry."""
         min_index = int(args.get("min_query_index") or 0)
-        if min_index <= 0:
+        state = self._state()
+        fired = args.pop("_watch_fired", None)
+
+        def respond() -> dict:
             out = run()
             out["index"] = self._state().get_index(table)
             out["known_leader"] = self.server.has_leader()
             return out
+
+        if min_index <= 0 or fired is not None or \
+                state.get_index(table) > min_index:
+            return respond()
         wait = _jittered(float(args.get("max_query_time") or
                                MAX_BLOCKING_WAIT))
-        deadline = time.monotonic() + wait
-        while True:
-            index = self._state().get_index(table)
-            if index > min_index or time.monotonic() >= deadline:
-                out = run()
-                out["index"] = index
-                out["known_leader"] = self.server.has_leader()
-                return out
-            ev = self._state().watch.watch((table,))
-            # Re-check after registering to avoid a lost wakeup.
-            if self._state().get_index(table) > min_index:
-                self._state().watch.stop_watch((table,), ev)
-                continue
-            ev.wait(min(0.25, max(0.0, deadline - time.monotonic())))
-            self._state().watch.stop_watch((table,), ev)
+        # Deadline envelope (server/overload.py): never wait past the
+        # caller's remaining budget — a reply past it talks to nobody.
+        wait = overload_mod.remaining(
+            overload_mod.absolute_deadline(args), wait)
+        if mux.parking_enabled():
+            def _subscribe(resume):
+                token = state.watch.subscribe(
+                    (table,), resume, min_index=min_index, ttl=wait)
+                return lambda: state.watch.unsubscribe(token)
+            raise mux.Parked(_subscribe)
+        woke = threading.Event()
+        token = state.watch.subscribe((table,),
+                                      lambda timed_out: woke.set(),
+                                      min_index=min_index)
+        try:
+            woke.wait(wait)
+        finally:
+            state.watch.unsubscribe(token)
+        return respond()
 
     # -- Status -----------------------------------------------------------
     def status_ping(self, args: dict) -> dict:
@@ -354,8 +401,13 @@ class Endpoints:
         timeout = overload_mod.remaining(
             overload_mod.absolute_deadline(args),
             float(args.get("timeout") or 0.5))
-        ev, token = self.server.eval_broker.dequeue(
-            args["schedulers"], timeout)
+        # A broker long-poll from a wire worker holds this dispatch
+        # worker for its whole wait (the broker's condition wait can't
+        # park) — mark it blocking so the pool overflows (bounded)
+        # rather than letting remote dequeuers pin the plane.
+        with mux.blocking_section():
+            ev, token = self.server.eval_broker.dequeue(
+                args["schedulers"], timeout)
         return {"eval": ev.to_dict() if ev else None, "token": token}
 
     def eval_ack(self, args: dict) -> dict:
@@ -422,7 +474,10 @@ class Endpoints:
         deadline = overload_mod.absolute_deadline(args)
         plan.deadline = deadline
         future = self.server.plan_queue.enqueue(plan)
-        result = future.wait(overload_mod.remaining(deadline, 60.0))
+        # The commit wait holds this dispatch worker until the applier
+        # answers — blocking, same overflow reasoning as Eval.Dequeue.
+        with mux.blocking_section():
+            result = future.wait(overload_mod.remaining(deadline, 60.0))
         return {"result": result.to_dict() if result else None}
 
     # -- Alloc ------------------------------------------------------------

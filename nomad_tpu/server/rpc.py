@@ -1,4 +1,4 @@
-"""msgpack-RPC transport: TCP listener with 1-byte protocol demux.
+"""msgpack-RPC transport: event-driven TCP listener with 1-byte demux.
 
 Capability parity with /root/reference/nomad/rpc.go:20-158 + nomad/pool.go:
 the server's single TCP port serves multiple planes, demuxed by the first
@@ -14,6 +14,18 @@ queries never monopolize a connection.  ConnPool defaults to one mux
 session per peer; the 0x01 plane (one in-flight request per pooled
 connection) remains for simple clients.
 
+Serving is event-driven (server/mux.py): ONE selector thread owns every
+plaintext client socket and a bounded dispatch pool runs the handlers,
+so server resource usage is O(worker pools), not O(connected clients) —
+blocking queries park as watch-fan-out callbacks (``mux.Parked``), a
+stalled or slowloris client is reaped on a read deadline without ever
+touching a worker, and overflow (connection cap, dispatch queue) is
+shed with ``overloaded:`` errors the retry layer already classifies.
+The raft (0x02) and TLS (0x04) planes hand their sockets to dedicated
+threads — blocking I/O, O(peers) — because raft owns its socket
+wholesale and ``ssl`` wants a blocking handshake; TLS'd requests still
+ride the shared dispatch pool and can park.
+
 Frame format (both directions): 4-byte big-endian length + msgpack body.
 Request body:  {"seq": int, "method": "Service.Method", "args": {...}}
 Response body: {"seq": int, "error": str|None, "result": {...}}
@@ -21,8 +33,8 @@ Response body: {"seq": int, "error": str|None, "result": {...}}
 from __future__ import annotations
 
 import logging
+import queue
 import socket
-import socketserver
 import ssl
 import struct
 import threading
@@ -31,7 +43,11 @@ from typing import Callable, Optional
 import msgpack
 
 from nomad_tpu import faultinject
+from nomad_tpu.utils.retry import OVERLOADED_MARKER
 from nomad_tpu.utils.sync import Immutable
+
+from . import mux as mux_mod
+from .mux import DispatchPool, EdgeLoop, Parked, encode_frame  # noqa: F401
 
 logger = logging.getLogger("nomad_tpu.server.rpc")
 
@@ -42,9 +58,11 @@ RPC_TLS = 0x04
 
 MAX_FRAME = 128 * 1024 * 1024
 
-# Per-connection concurrency bound for the mux plane (the reference's
-# yamux accept backlog plays the same role).
-MUX_MAX_INFLIGHT = 128
+# The dispatch-plane liveness lane (front of the queue, never shed at
+# the dispatch bound) is THE SAME lane as the admission controller's:
+# one source of truth, or adding a liveness method to one layer would
+# silently strand it in the other.
+from .overload import HEARTBEAT_LANE as _LIVENESS_METHODS  # noqa: E402
 
 
 def server_tls_context(cert_file: str, key_file: str,
@@ -109,44 +127,132 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return buf
 
 
+class _LoopSink:
+    """Reply channel for requests that arrived on the event loop."""
+
+    __slots__ = ("loop", "conn")
+
+    def __init__(self, loop: EdgeLoop, conn) -> None:
+        self.loop = loop
+        self.conn = conn
+
+    def reply(self, payload: dict) -> None:
+        self.loop.send(self.conn, encode_frame(payload))
+
+    def done(self) -> None:
+        self.loop.request_done(self.conn)
+
+    def park(self, rec: dict) -> None:
+        self.loop.park(self.conn, rec)
+
+    def unpark(self, rec: dict) -> None:
+        self.loop.unpark(self.conn, rec)
+
+
+class _ThreadSink:
+    """Reply channel for TLS-plane connections (thread-served reader).
+
+    Replies ride a dedicated writer thread fed by an unbounded queue —
+    no lock is held across a socket send, and out-of-order replies
+    (parked long-polls resuming) interleave safely with fresh ones.
+    Parked records are cleaned up when the reader sees EOF, so a dead
+    TLS client deregisters its waiters exactly like a loop connection.
+    """
+
+    def __init__(self, sock) -> None:
+        self.sock = sock
+        self._outq: queue.Queue = queue.Queue()
+        self._plock = threading.Lock()
+        self._parked: dict = {}
+        self._closed = False
+        self._writer = threading.Thread(target=self._write_loop,
+                                        daemon=True,
+                                        name="rpc-tls-writer")
+        self._writer.start()
+
+    def _write_loop(self) -> None:
+        while True:
+            payload = self._outq.get()
+            if payload is None:
+                return
+            try:
+                send_frame(self.sock, payload)
+            except (ConnectionError, OSError, ssl.SSLError):
+                pass  # peer gone; the reader notices on its next recv
+
+    def reply(self, payload: dict) -> None:
+        self._outq.put(payload)
+
+    def done(self) -> None:
+        pass
+
+    def park(self, rec: dict) -> None:
+        with self._plock:
+            if not self._closed and not rec.get("done"):
+                self._parked[id(rec)] = rec
+                return
+        EdgeLoop._unsub(rec)
+
+    def unpark(self, rec: dict) -> None:
+        with self._plock:
+            self._parked.pop(id(rec), None)
+
+    def close(self) -> None:
+        """Reader EOF: deregister parked waiters, stop the writer."""
+        with self._plock:
+            self._closed = True
+            recs = list(self._parked.values())
+            self._parked.clear()
+        for rec in recs:
+            EdgeLoop._unsub(rec)
+        self._outq.put(None)
+        if self._writer is not threading.current_thread():
+            self._writer.join(2.0)
+
+
 class RPCServer:
-    """Threaded TCP listener demuxing nomad-RPC, raft and TLS streams."""
+    """Event-driven TCP listener demuxing nomad-RPC, raft and TLS streams.
+
+    One selector thread (``rpc-loop``) owns every plaintext client
+    socket; a bounded ``DispatchPool`` runs the handlers; blocking
+    queries park as watch-fan-out callbacks instead of pinning workers.
+    Public surface (register/register_service/set_raft_handler/start/
+    shutdown/address) is unchanged from the threaded implementation.
+    """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  tls_context: Optional[ssl.SSLContext] = None,
-                 require_tls: bool = False) -> None:
+                 require_tls: bool = False,
+                 dispatch_workers: int = mux_mod.DISPATCH_WORKERS,
+                 dispatch_queue: int = mux_mod.DISPATCH_QUEUE,
+                 max_conns: int = mux_mod.MAX_CONNS,
+                 idle_timeout: float = mux_mod.IDLE_TIMEOUT,
+                 read_deadline: float = mux_mod.READ_DEADLINE) -> None:
         self._handlers: dict = {}        # "Service.Method" -> callable
         self._raft_handler: Optional[Callable] = None
         self._tls_context = tls_context
         self._require_tls = require_tls and tls_context is not None
         self._lock = threading.Lock()
+        self._handoffs: set = set()      # raft/TLS threads; guarded
+        self._handoff_socks: set = set()  # their raw sockets; guarded
+        self._tls_sinks: set = set()     # live TLS reply sinks; guarded
+        self.dispatch_sheds = 0          # queue-full rejections; guarded
+        self.handoff_sheds = 0           # over-cap handoffs; guarded
+        self._read_deadline = read_deadline
 
-        outer = self
-        self._active: set = set()
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind((host, port))
+        listener.listen(512)
+        listener.setblocking(False)
+        self.address = listener.getsockname()  # (host, port)
 
-        class _Handler(socketserver.BaseRequestHandler):
-            def handle(self) -> None:
-                sock = self.request
-                with outer._lock:
-                    outer._active.add(sock)
-                try:
-                    outer._demux(sock, tls_ok=True)
-                except (ConnectionError, OSError, ssl.SSLError):
-                    pass
-                finally:
-                    with outer._lock:
-                        outer._active.discard(sock)
-                    try:
-                        sock.close()
-                    except OSError:
-                        pass
-
-        class _Server(socketserver.ThreadingTCPServer):
-            daemon_threads = True
-            allow_reuse_address = True
-
-        self._server = _Server((host, port), _Handler)
-        self.address = self._server.server_address  # (host, port)
+        self._pool = DispatchPool(dispatch_workers, dispatch_queue,
+                                  name="rpc-dispatch")
+        self._loop = EdgeLoop(listener, self, max_conns=max_conns,
+                              idle_timeout=idle_timeout,
+                              read_deadline=read_deadline,
+                              name="rpc-loop")
         self._thread: Optional[threading.Thread] = None
 
     # -- registration -----------------------------------------------------
@@ -167,174 +273,272 @@ class RPCServer:
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._server.serve_forever,
-                                        daemon=True, name="rpc-listener")
-        self._thread.start()
+        self._pool.start()
+        self._loop.start()
+        self._thread = self._loop._thread
 
     def shutdown(self) -> None:
-        self._server.shutdown()
-        self._server.server_close()
-        # serve_forever has returned once shutdown() unblocks; reap the
-        # listener thread so teardown leaves nothing running.
-        if self._thread is not None:
-            self._thread.join(2.0)
-        # Sever established connections too (long-poll/mux sessions would
-        # otherwise outlive the listener and talk to a dead server).
+        # Loop teardown severs every client socket (parked waiters
+        # deregister via each connection's close path), then the pool
+        # drains, then the raft/TLS threads get reaped.
+        self._loop.shutdown()
+        self._pool.shutdown()
         with self._lock:
-            active = list(self._active)
-            self._active.clear()
-        for sock in active:
+            sinks = list(self._tls_sinks)
+            handoffs = list(self._handoffs)
+            socks = list(self._handoff_socks)
+        for sink in sinks:
+            try:
+                sink.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        for sock in socks:
+            # Sever mid-handshake/raft handoff sockets too, or their
+            # threads would outlive the join below.
             try:
                 sock.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
+        for t in handoffs:
+            if t is not threading.current_thread():
+                t.join(2.0)
+
+    def stats(self) -> dict:
+        out = {"loop": self._loop.stats(), "pool": self._pool.stats()}
+        with self._lock:
+            out["dispatch_sheds"] = self.dispatch_sheds
+            out["tls_conns"] = len(self._tls_sinks)
+        return out
+
+    # -- EdgeLoop protocol --------------------------------------------------
+    def shed_payload(self) -> bytes:
+        return encode_frame({
+            "seq": 0,
+            "error": f"{OVERLOADED_MARKER} connection limit reached",
+            "result": None})
+
+    def on_plane(self, conn, byte: int) -> str:
+        if byte == RPC_TLS:
+            if self._tls_context is None:
+                logger.warning("TLS connection attempted but no TLS "
+                               "configured")
+                return "reject"
+            return "handoff"
+        if self._require_tls:
+            # TLS-required listeners reject plaintext planes outright:
+            # encryption/mTLS must not be bypassable on the same port.
+            logger.warning("rejecting non-TLS connection (%#x): TLS "
+                           "required", byte)
+            return "reject"
+        if byte in (RPC_NOMAD, RPC_MUX):
+            return "stream"
+        if byte == RPC_RAFT:
+            return "handoff" if self._raft_handler is not None \
+                else "reject"
+        logger.warning("unrecognized RPC byte: %#x", byte)
+        return "reject"
+
+    def on_frame(self, conn, obj) -> bool:
+        if not isinstance(obj, dict):
+            # Malformed frame: this peer doesn't speak the protocol;
+            # drop the connection rather than guess at a reply seq.
+            logger.warning("dropping connection: non-dict RPC frame "
+                           "(%s)", type(obj).__name__)
+            return False
+        sink = _LoopSink(self._loop, conn)
+        # The dispatch-plane liveness lane: heartbeats jump the queue
+        # AND its bound — shedding (or queueing) liveness during a
+        # long-poll wake storm would cause the TTL-expiry spiral the
+        # overload plane exists to prevent (overload.HEARTBEAT_LANE is
+        # the same lane one layer up).
+        front = obj.get("method") in _LIVENESS_METHODS
+        if not self._pool.submit(lambda: self._execute(sink, obj),
+                                 front=front):
+            # Dispatch queue full: shed with an explicit overloaded:
+            # error — one queue check and a pre-decoded frame, vs
+            # accepting work the pool cannot start.
+            with self._lock:
+                self.dispatch_sheds += 1
+            self._loop.send(conn, encode_frame({
+                "seq": obj.get("seq", 0),
+                "error": f"{OVERLOADED_MARKER} server dispatch queue "
+                         f"full",
+                "result": None}))
+            return True
+        conn.pending += 1
+        return True
+
+    # Raft/TLS handoff threads are O(peers) by design; this cap makes
+    # it a guarantee — an attacker looping "send 0x04, stall" must not
+    # mint unbounded threads past the event loop's max_conns cap.
+    MAX_HANDOFFS = 128
+
+    def handoff(self, sock: socket.socket, byte: int) -> None:
+        with self._lock:
+            if len(self._handoffs) >= self.MAX_HANDOFFS:
+                self.handoff_sheds += 1
+                over = True
+            else:
+                over = False
+        if over:
             try:
                 sock.close()
             except OSError:
                 pass
-
-    # -- serving ----------------------------------------------------------
-    def _demux(self, sock, tls_ok: bool) -> None:
-        """Dispatch one connection by its first byte; a TLS byte wraps the
-        stream and demuxes the inner byte once (no nested TLS)."""
-        first = sock.recv(1)
-        if not first:
             return
-        if self._require_tls and tls_ok and first[0] != RPC_TLS:
-            # TLS-required listeners reject plaintext planes outright:
-            # encryption/mTLS must not be bypassable on the same port.
-            logger.warning("rejecting non-TLS connection (%#x): TLS "
-                           "required", first[0])
+        t = threading.Thread(target=self._serve_handoff,
+                             args=(sock, byte), daemon=True,
+                             name="rpc-handoff")
+        with self._lock:
+            self._handoffs.add(t)
+            self._handoff_socks.add(sock)
+        t.start()
+
+    # -- raft / TLS planes (thread-served, O(peers)) -----------------------
+    def _serve_handoff(self, sock: socket.socket, byte: int) -> None:
+        try:
+            if byte == RPC_RAFT:
+                if self._raft_handler is not None:
+                    self._raft_handler(sock)
+                return
+            # TLS: blocking handshake, then the inner plane byte rides
+            # encrypted (reference rpc.go:73-117; no nested TLS).  The
+            # handshake + inner byte are read-deadline-bounded — a
+            # client that sends 0x04 and stalls costs this thread at
+            # most read_deadline, same as a plaintext slowloris.
+            sock.settimeout(self._read_deadline)
+            wrapped = self._tls_context.wrap_socket(sock,
+                                                    server_side=True)
+            inner = wrapped.recv(1)
+            if not inner:
+                return
+            wrapped.settimeout(None)  # established sessions may idle
+            if inner[0] == RPC_RAFT:
+                if self._raft_handler is not None:
+                    self._raft_handler(wrapped)
+            elif inner[0] in (RPC_NOMAD, RPC_MUX):
+                self._serve_tls_stream(wrapped)
+            else:
+                logger.warning("unrecognized RPC byte inside TLS: %#x",
+                               inner[0])
+        except (ConnectionError, OSError, ssl.SSLError):
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            with self._lock:
+                self._handoffs.discard(threading.current_thread())
+                self._handoff_socks.discard(sock)
+
+    def _serve_tls_stream(self, sock) -> None:
+        """Frame loop for one TLS connection: the reader blocks here
+        (one thread per TLS peer), but requests still run on the shared
+        dispatch pool and blocking queries still park — no per-request
+        threads, no parked workers."""
+        sink = _ThreadSink(sock)
+        with self._lock:
+            self._tls_sinks.add(sink)
+        try:
+            while True:
+                req = recv_frame(sock)
+                if req is None:
+                    return
+                if not isinstance(req, dict):
+                    logger.warning("dropping TLS connection: non-dict "
+                                   "frame (%s)", type(req).__name__)
+                    return
+                if not self._pool.submit(
+                        lambda r=req: self._execute(sink, r),
+                        front=req.get("method") in _LIVENESS_METHODS):
+                    with self._lock:
+                        self.dispatch_sheds += 1
+                    sink.reply({
+                        "seq": req.get("seq", 0),
+                        "error": f"{OVERLOADED_MARKER} server dispatch "
+                                 f"queue full",
+                        "result": None})
+        finally:
+            with self._lock:
+                self._tls_sinks.discard(sink)
+            sink.close()
+
+    # -- request execution -------------------------------------------------
+    def _execute(self, sink, req: dict, resumed: bool = False) -> None:
+        """Run one request on a dispatch worker and answer through
+        ``sink``.  A handler that would block raises ``Parked`` and the
+        request resumes — through this same path, ``resumed=True`` —
+        when the watch fan-out matures or times out."""
+        seq = req.get("seq", 0)
+        method = req.get("method", "")
+        args = req.get("args") or {}
+        if faultinject.ACTIVE and not resumed:
+            try:
+                faultinject.fire_rpc("rpc.recv", method, args)
+            except faultinject.FaultDropped:
+                # Injected lost frame: no reply at all — the caller
+                # sees only its own timeout, like wire loss.
+                sink.done()
+                return
+            except Exception as e:
+                sink.reply({"seq": seq, "error": str(e), "result": None})
+                sink.done()
+                return
+        handler = self._handlers.get(method)
+        if handler is None:
+            sink.reply({"seq": seq,
+                        "error": f"unknown method {method!r}",
+                        "result": None})
+            sink.done()
             return
-        if first[0] == RPC_NOMAD:
-            self._serve_rpc(sock)
-        elif first[0] == RPC_MUX:
-            self._serve_mux(sock)
-        elif first[0] == RPC_RAFT:
-            if self._raft_handler is not None:
-                self._raft_handler(sock)
-        elif first[0] == RPC_TLS and tls_ok:
-            if self._tls_context is None:
-                logger.warning("TLS connection attempted but no TLS "
-                               "configured")
-                return
-            wrapped = self._tls_context.wrap_socket(sock, server_side=True)
-            try:
-                self._demux(wrapped, tls_ok=False)
-            finally:
-                try:
-                    wrapped.close()
-                except OSError:
-                    pass
-        else:
-            logger.warning("unrecognized RPC byte: %#x", first[0])
+        try:
+            with mux_mod.parkable():
+                result = handler(args)
+        except Parked as parked:
+            self._park(sink, req, parked)
+            return
+        except Exception as e:  # error surface mirrors net/rpc
+            logger.debug("rpc %s failed: %s", method, e)
+            sink.reply({"seq": seq, "error": str(e), "result": None})
+            sink.done()
+            return
+        sink.reply({"seq": seq, "error": None, "result": result})
+        sink.done()
 
-    def _serve_rpc(self, sock: socket.socket) -> None:
-        while True:
-            req = recv_frame(sock)
-            if req is None:
-                return
-            if not isinstance(req, dict):
-                # Malformed frame: this peer doesn't speak the protocol;
-                # drop the connection rather than guess at a reply seq.
-                logger.warning("dropping connection: non-dict RPC frame "
-                               "(%s)", type(req).__name__)
-                return
-            seq = req.get("seq", 0)
-            method = req.get("method", "")
-            if faultinject.ACTIVE:
-                try:
-                    faultinject.fire_rpc("rpc.recv", method,
-                                         req.get("args") or {})
-                except faultinject.FaultDropped:
-                    # Injected lost frame: no reply at all — the caller
-                    # sees only its own timeout, like wire loss.
-                    continue
-                except Exception as e:
-                    send_frame(sock, {"seq": seq, "error": str(e),
-                                      "result": None})
-                    continue
-            handler = self._handlers.get(method)
-            if handler is None:
-                send_frame(sock, {"seq": seq,
-                                  "error": f"unknown method {method!r}",
-                                  "result": None})
-                continue
-            try:
-                result = handler(req.get("args") or {})
-                send_frame(sock, {"seq": seq, "error": None,
-                                  "result": result})
-            except Exception as e:  # error surface mirrors net/rpc
-                logger.debug("rpc %s failed: %s", method, e)
-                send_frame(sock, {"seq": seq, "error": str(e),
-                                  "result": None})
+    def _park(self, sink, req: dict, parked: Parked) -> None:
+        """Park one request: register the resume callback with the
+        handler's watch subscription and free the worker.  The record
+        lives on the connection so a dead client deregisters it."""
+        rec: dict = {"done": False, "unsub": None}
+        lock = threading.Lock()
 
+        def resume(timed_out: bool) -> None:
+            with lock:
+                if rec["done"]:
+                    return
+                rec["done"] = True
+            args = req.get("args")
+            if isinstance(args, dict):
+                args["_watch_fired"] = "timeout" if timed_out \
+                    else "change"
+            sink.unpark(rec)
+            if not self._pool.submit(
+                    lambda: self._execute(sink, req, resumed=True),
+                    urgent=True):
+                sink.done()  # pool stopped: shutdown owns cleanup
 
-    def _serve_mux(self, sock: socket.socket) -> None:
-        """Multiplexed plane (the reference's yamux, rpc.go:139-158, in
-        role): many logical request/response streams share one TCP
-        connection.  Each request runs in its own worker and replies are
-        written as they finish — keyed by ``seq``, possibly out of
-        order — so a 300s blocking query never stalls the connection's
-        other streams."""
-        wlock = threading.Lock()
-        gate = threading.Semaphore(MUX_MAX_INFLIGHT)
-
-        def worker(req) -> None:
-            try:
-                seq = req.get("seq", 0)
-                method = req.get("method", "")
-                if faultinject.ACTIVE:
-                    try:
-                        faultinject.fire_rpc("rpc.recv", method,
-                                             req.get("args") or {})
-                    except faultinject.FaultDropped:
-                        return  # injected lost frame: no reply (finally
-                        # still releases the in-flight gate)
-                    except Exception as e:
-                        resp = {"seq": seq, "error": str(e),
-                                "result": None}
-                        try:
-                            with wlock:
-                                send_frame(sock, resp)
-                        except (ConnectionError, OSError):
-                            pass
-                        return
-                handler = self._handlers.get(method)
-                if handler is None:
-                    resp = {"seq": seq,
-                            "error": f"unknown method {method!r}",
-                            "result": None}
-                else:
-                    try:
-                        resp = {"seq": seq, "error": None,
-                                "result": handler(req.get("args") or {})}
-                    except Exception as e:
-                        logger.debug("rpc %s failed: %s", method, e)
-                        resp = {"seq": seq, "error": str(e),
-                                "result": None}
-                try:
-                    with wlock:
-                        send_frame(sock, resp)
-                except (ConnectionError, OSError):
-                    pass  # peer gone; readers notice on their next recv
-            finally:
-                gate.release()
-
-        while True:
-            req = recv_frame(sock)
-            if req is None:
-                return
-            if not isinstance(req, dict):
-                # Validate BEFORE spawning: a worker dying on a malformed
-                # frame would never reply, leaving the caller blocked for
-                # its full timeout.  Drop the connection instead.
-                logger.warning("dropping mux connection: non-dict frame "
-                               "(%s)", type(req).__name__)
-                return
-            gate.acquire()
-            threading.Thread(target=worker, args=(req,),
-                             daemon=True).start()
+        try:
+            unsub = parked.subscribe(resume)
+        except Exception as e:
+            sink.reply({"seq": req.get("seq", 0), "error": str(e),
+                        "result": None})
+            sink.done()
+            return
+        with lock:
+            if not rec["done"]:
+                rec["unsub"] = unsub
+        sink.park(rec)
 
 
 class RPCError(Exception):
@@ -351,11 +555,18 @@ DEFAULT_CALL_TIMEOUT = 330.0  # > blocking-query max
 
 def _dial(address: tuple, plane: int,
           tls_context: Optional[ssl.SSLContext] = None,
-          server_hostname: str = "") -> socket.socket:
+          server_hostname: str = "",
+          connect_timeout: float = 330.0) -> socket.socket:
     """Connect and select a plane: optional outer TLS byte in the clear,
     handshake, then the inner plane byte rides encrypted (reference
     rpc.go:73-117)."""
-    sock = socket.create_connection(address, timeout=330)
+    sock = socket.create_connection(address, timeout=connect_timeout)
+    try:
+        # Frames are small and latency-sensitive (heartbeats, votes):
+        # never wait out Nagle + delayed-ACK.
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError:
+        pass
     if tls_context is not None:
         sock.sendall(bytes([RPC_TLS]))
         sock = tls_context.wrap_socket(
@@ -404,22 +615,60 @@ class _PooledConn:
 class MuxConn:
     """One multiplexed connection: concurrent callers share the socket,
     a reader thread routes replies to waiters by ``seq`` (the client
-    half of the 0x03 plane — the reference's yamux session)."""
+    half of the 0x03 plane — the reference's yamux session).
+
+    Writes ride ONE writer thread fed by an unbounded queue: no lock is
+    ever held across a socket send (a slow/large frame stalls only the
+    writer, never reply delivery or other callers' enqueues), and a
+    frame the writer fails to put on the wire answers its own waiter
+    with ``_SendError`` — "never left this host", safe to retry — while
+    marking the session broken for everyone after it."""
 
     def __init__(self, address: tuple,
                  tls_context: Optional[ssl.SSLContext] = None,
-                 server_hostname: str = "") -> None:
+                 server_hostname: str = "",
+                 connect_timeout: float = 330.0) -> None:
         self.sock: Immutable = _dial(address, RPC_MUX, tls_context,
-                                     server_hostname)
+                                     server_hostname,
+                                     connect_timeout=connect_timeout)
         self.sock.settimeout(None)  # reader blocks; callers use events
-        self._lock = threading.Lock()    # waiter table + seq state
-        self._wlock = threading.Lock()   # socket writes ONLY
+        self._lock = threading.Lock()    # waiter table + seq + broken
         self._seq = 0
-        self._waiters: dict = {}   # seq -> [event, response]
+        self._waiters: dict = {}   # seq -> [event, resp, exc] | {"cb": fn}
         self._broken: Optional[Exception] = None
+        self._outq: queue.Queue = queue.Queue()  # (seq, payload) | None
         self._reader = threading.Thread(target=self._read_loop,
                                         daemon=True, name="rpc-mux-read")
         self._reader.start()
+        self._writer = threading.Thread(target=self._write_loop,
+                                        daemon=True,
+                                        name="rpc-mux-write")
+        self._writer.start()
+
+    def _write_loop(self) -> None:
+        while True:
+            item = self._outq.get()
+            if item is None:
+                return
+            seq, payload = item
+            try:
+                send_frame(self.sock, payload)
+            except (ConnectionError, OSError, ssl.SSLError) as e:
+                self._send_failed(seq, e)
+
+    def _send_failed(self, seq: int, e: Exception) -> None:
+        err = _SendError(str(e))
+        with self._lock:
+            if self._broken is None:
+                self._broken = err
+            waiter = self._waiters.pop(seq, None)
+        if waiter is None:
+            return
+        if isinstance(waiter, list):
+            waiter[2] = err
+            waiter[0].set()
+        else:
+            self._finish_async(waiter, None, err)
 
     def _read_loop(self) -> None:
         err: Exception = ConnectionError("connection closed by server")
@@ -430,44 +679,96 @@ class MuxConn:
                     break
                 with self._lock:
                     waiter = self._waiters.pop(resp.get("seq"), None)
-                if waiter is not None:
+                if waiter is None:
+                    continue
+                if isinstance(waiter, list):   # sync caller
                     waiter[1] = resp
                     waiter[0].set()
+                else:                          # async callback waiter
+                    e = resp.get("error")
+                    self._finish_async(
+                        waiter, resp.get("result"),
+                        RPCError(e) if e else None)
         except (ConnectionError, OSError, ValueError) as e:
             err = e
         with self._lock:
             self._broken = err
             waiters, self._waiters = list(self._waiters.values()), {}
         for waiter in waiters:
-            waiter[0].set()
+            if isinstance(waiter, list):
+                waiter[0].set()
+            else:
+                self._finish_async(waiter, None, ConnectionError(str(err)))
+
+    @staticmethod
+    def _finish_async(waiter: dict, result, exc) -> None:
+        try:
+            waiter["cb"](result, exc)
+        except Exception:
+            logger.exception("async rpc callback failed")
+
+    def call_async(self, method: str, args: dict,
+                   on_done: Callable) -> Optional[int]:
+        """Fire one request without blocking: ``on_done(result, exc)``
+        runs exactly once — on the reader thread when the reply lands,
+        on the writer thread when the send fails, or on the canceller's
+        thread via :meth:`cancel_async`.  No per-call Event or timer is
+        allocated; callers multiplexing many in-flight requests (the
+        agent swarm) arm timeouts for the returned seq on their own TTL
+        wheel.  Returns None when the session is already broken
+        (``on_done`` already ran with the error)."""
+        with self._lock:
+            if self._broken is not None:
+                err: Optional[Exception] = _SendError(str(self._broken))
+                seq = None
+            else:
+                self._seq += 1
+                seq = self._seq
+                self._waiters[seq] = {"cb": on_done}
+                err = None
+        if err is not None:
+            self._finish_async({"cb": on_done}, None, err)
+            return None
+        self._outq.put((seq, {"seq": seq, "method": method,
+                              "args": args}))
+        return seq
+
+    def cancel_async(self, seq: int, exc: Optional[Exception] = None
+                     ) -> bool:
+        """Abandon an async call (its timeout expired): the callback
+        runs with ``exc`` unless the reply already won the race."""
+        with self._lock:
+            waiter = self._waiters.pop(seq, None)
+        if waiter is None or isinstance(waiter, list):
+            return False
+        self._finish_async(waiter, None,
+                           exc or TimeoutError(f"rpc seq {seq} timed out"))
+        return True
 
     def call(self, method: str, args: dict,
              timeout: Optional[float] = None):
-        waiter = [threading.Event(), None]
+        waiter = [threading.Event(), None, None]  # [event, resp, exc]
         # seq allocation + waiter registration under the state lock;
-        # the actual send under a separate write lock — a slow/large
-        # send must not block the reader thread from delivering other
-        # streams' completed responses (head-of-line liveness: raft
-        # heartbeats share sessions with bulk transfers).
+        # the actual send rides the writer thread — a slow/large send
+        # must not block the reader from delivering other streams'
+        # completed responses, nor other callers from enqueueing
+        # (head-of-line liveness: raft heartbeats share sessions with
+        # bulk transfers).
         with self._lock:
             if self._broken is not None:
                 raise _SendError(str(self._broken))
             self._seq += 1
             seq = self._seq
             self._waiters[seq] = waiter
-        try:
-            with self._wlock:
-                send_frame(self.sock, {"seq": seq, "method": method,
-                                       "args": args})
-        except (ConnectionError, OSError) as e:
-            with self._lock:
-                self._waiters.pop(seq, None)
-            raise _SendError(str(e)) from e
+        self._outq.put((seq, {"seq": seq, "method": method,
+                              "args": args}))
         if not waiter[0].wait(timeout if timeout is not None
                               else DEFAULT_CALL_TIMEOUT):
             with self._lock:
                 self._waiters.pop(seq, None)
             raise TimeoutError(f"rpc {method} timed out")
+        if waiter[2] is not None:  # the writer couldn't send it
+            raise waiter[2]
         resp = waiter[1]
         if resp is None:  # reader died
             with self._lock:
@@ -483,9 +784,21 @@ class MuxConn:
             return self._broken is not None
 
     def close(self) -> None:
-        # shutdown() (not just close) reliably wakes a blocked recv with
-        # EOF; the reader then exits and gets reaped, so a torn-down
-        # session never leaves a thread behind.
+        # shutdown() (not just close) reliably wakes a blocked recv AND
+        # a mid-send writer with EOF/EPIPE; both service threads then
+        # exit and get reaped, so a torn-down session never leaves a
+        # thread behind.  Frames still queued behind the sentinel are
+        # abandoned unsent (the writer returns at the sentinel without
+        # draining); their waiters are answered by the reader's
+        # teardown, which pops every registered waiter with a generic
+        # ConnectionError — NOT the writer-side _SendError path, so a
+        # caller racing close() cannot rely on the "never left this
+        # host" retry-safety signal for those frames.
+        with self._lock:
+            if self._broken is None:
+                # Callers racing close() fail fast instead of waiting
+                # out their timeout against a writer that already quit.
+                self._broken = _SendError("session closed")
         try:
             self.sock.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -494,8 +807,11 @@ class MuxConn:
             self.sock.close()
         except OSError:
             pass
+        self._outq.put(None)
         if self._reader is not threading.current_thread():
             self._reader.join(2.0)
+        if self._writer is not threading.current_thread():
+            self._writer.join(2.0)
 
 
 class ConnPool:

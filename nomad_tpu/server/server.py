@@ -76,6 +76,14 @@ class ServerConfig:
         self.enable_rpc: bool = False
         self.bind_addr: str = "127.0.0.1"
         self.rpc_port: int = 0      # 0 = ephemeral
+        # Event-driven serving plane (server/mux.py): one selector loop
+        # owns every client socket; a bounded pool runs handlers.
+        # Resource usage is O(these knobs), never O(connected clients).
+        self.rpc_dispatch_workers: int = 8
+        self.rpc_dispatch_queue: int = 1024
+        self.rpc_max_conns: int = 20000    # past it: shed ErrOverloaded
+        self.rpc_idle_timeout: float = 600.0
+        self.rpc_read_deadline: float = 30.0  # slowloris/partial-frame reap
         self.raft_mode: str = "inmem"   # "inmem" | "net"
         self.raft_peers: list = []      # [(host, port), ...]
         self.enable_gossip: bool = False
@@ -194,10 +202,16 @@ class Server:
         if self.config.enable_rpc or self.config.raft_mode == "net":
             from .endpoints import Endpoints
             from .rpc import RPCServer
-            self.rpc_server = RPCServer(self.config.bind_addr,
-                                        self.config.rpc_port,
-                                        tls_context=server_tls,
-                                        require_tls=self.config.tls_require)
+            self.rpc_server = RPCServer(
+                self.config.bind_addr,
+                self.config.rpc_port,
+                tls_context=server_tls,
+                require_tls=self.config.tls_require,
+                dispatch_workers=self.config.rpc_dispatch_workers,
+                dispatch_queue=self.config.rpc_dispatch_queue,
+                max_conns=self.config.rpc_max_conns,
+                idle_timeout=self.config.rpc_idle_timeout,
+                read_deadline=self.config.rpc_read_deadline)
             Endpoints(self).install(self.rpc_server)
             self.rpc_server.start()
 
@@ -455,6 +469,10 @@ class Server:
         # After revoke (which cleared the timers): reap the heartbeat
         # service threads so nothing fires into the torn-down server.
         self.heartbeats.shutdown()
+        # Watch fan-out last: the RPC teardown above already
+        # deregistered every parked long-poll; this reaps the shared
+        # timeout wheel and answers any straggler as timed out.
+        self.fsm.state.watch.shutdown()
 
     def _restore_eval_broker(self) -> None:
         """Broker is volatile; state is durable.  Re-enqueue all

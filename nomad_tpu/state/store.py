@@ -19,8 +19,10 @@ uses as its RefreshIndex-style fence (see nomad_tpu/models/fleet.py).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Iterable, Optional
 
+from nomad_tpu import faultinject
 from nomad_tpu.structs import (
     Allocation,
     Evaluation,
@@ -32,48 +34,235 @@ from nomad_tpu.structs import (
 TABLES = ("nodes", "jobs", "evals", "allocs")
 
 
+class _Waiter:
+    """One parked watch subscription (a callback, never a thread)."""
+
+    __slots__ = ("token", "key", "min_index", "deliver", "timed",
+                 "deadline")
+
+    def __init__(self, token: str, key, min_index: int, deliver,
+                 timed: bool, deadline: Optional[float]) -> None:
+        self.token = token
+        self.key = key
+        self.min_index = min_index
+        self.deliver = deliver   # deliver(timed_out: bool), exactly once
+        self.timed = timed       # True = armed on the timeout wheel
+        self.deadline = deadline  # absolute monotonic; None = untimed
+
+
 class StateWatch:
-    """Notify-on-change groups keyed by arbitrary hashable keys.
+    """Shared watch fan-out keyed by (key, min_index).
 
     Parity role: nomad/state/notify.go NotifyGroup — blocking queries
-    register an event on keys like ("allocs",) or ("alloc-node", node_id)
-    and are woken when a write touches the key.
+    register on keys like ("allocs",) or ("alloc-node", node_id) and are
+    woken when a write touches the key.
+
+    Beyond the reference (the event-driven serving plane): waiters are
+    *callbacks* in ONE shared registry instead of one parked
+    Event-holding thread each.  ``subscribe(key, deliver, min_index,
+    ttl)`` parks a callback that the single notifier drains when the
+    key's table index advances past ``min_index``; timeouts ride one
+    shared TTL wheel (server/ttlwheel.py) instead of per-waiter timers;
+    and every exit path — wakeup, timeout, unsubscribe, conn death —
+    removes the waiter, so an abandoned long-poll can never leak a
+    registry entry (``live_waiters()`` is the gauge; the regression
+    test churns abandoned polls and asserts it returns to zero).  The
+    legacy ``watch``/``stop_watch`` Event API rides the same registry.
+
+    The ``watch.deliver`` fault site fires per matured wakeup: ``drop``
+    leaves the waiter parked (a lost wakeup — the wheel timeout still
+    delivers later, so even injected loss cannot leak), ``delay``
+    stalls the notifier like a slow fan-out.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, index_of=None) -> None:
         self._lock = threading.Lock()
-        self._groups: dict = {}
+        self._waiters: dict = {}    # token -> _Waiter
+        self._by_key: dict = {}     # key -> {token: _Waiter}
+        self._seq = 0
+        self._wheel = None          # lazy: most stores never park timed waiters
+        self._index_of = index_of   # key -> current table index (lost-wakeup recheck)
+        # Counters, guarded by _lock.
+        self.delivered = 0          # matured wakeups delivered
+        self.timeouts = 0           # wheel-expired deliveries
+        self.dropped_wakeups = 0    # injected watch.deliver drops
+
+    # -- subscription ------------------------------------------------------
+    def subscribe(self, key, deliver, min_index: int = 0,
+                  ttl: Optional[float] = None) -> str:
+        """Park ``deliver(timed_out)`` until a write moves ``key`` past
+        ``min_index`` (0 = any touch) or ``ttl`` expires on the shared
+        wheel (None = caller owns the timeout and MUST unsubscribe).
+        Exactly-once: wakeup, timeout and unsubscribe race safely.  The
+        post-register index recheck closes the lost-wakeup window — a
+        write landing between the caller's check and this call delivers
+        immediately (possibly on the calling thread)."""
+        with self._lock:
+            self._seq += 1
+            token = f"w{self._seq}"
+            waiter = _Waiter(token, key, min_index, deliver,
+                             ttl is not None,
+                             time.monotonic() + ttl
+                             if ttl is not None else None)
+            self._waiters[token] = waiter
+            self._by_key.setdefault(key, {})[token] = waiter
+            if ttl is not None:
+                self._wheel_locked().arm(token, ttl)
+        if min_index > 0 and self._index_of is not None:
+            current = self._index_of(key)
+            if current > min_index:
+                popped = self._pop(token)
+                if popped is not None:
+                    with self._lock:
+                        self.delivered += 1
+                    popped.deliver(False)
+        return token
+
+    def unsubscribe(self, token: str) -> bool:
+        """Deregister; True when the waiter was still parked (its
+        callback will never fire)."""
+        return self._pop(token) is not None
 
     def watch(self, key) -> threading.Event:
+        """Legacy Event API: one event per caller, riding the shared
+        registry (no wheel entry — stop_watch/notify clean up)."""
         ev = threading.Event()
-        with self._lock:
-            self._groups.setdefault(key, set()).add(ev)
+        token = self.subscribe(key, lambda timed_out: ev.set())
+        ev._watch_token = token  # for stop_watch
         return ev
 
     def stop_watch(self, key, ev: threading.Event) -> None:
-        with self._lock:
-            group = self._groups.get(key)
-            if group is not None:
-                group.discard(ev)
-                if not group:
-                    self._groups.pop(key, None)
+        token = getattr(ev, "_watch_token", None)
+        if token is not None:
+            self.unsubscribe(token)
 
-    def notify(self, *keys) -> None:
+    # -- notification ------------------------------------------------------
+    def notify(self, *keys, index: Optional[int] = None) -> None:
+        """A write touched ``keys`` at ``index``: drain every matured
+        waiter (min_index 0, or index unknown, or index past
+        min_index).  Runs on the writer's thread, outside the store
+        lock; callbacks must be quick (set an event / re-enqueue a
+        dispatch)."""
+        matured: list = []
         with self._lock:
             for key in keys:
-                group = self._groups.pop(key, None)
-                if group:
-                    for ev in group:
-                        ev.set()
+                bucket = self._by_key.get(key)
+                if not bucket:
+                    continue
+                for token in list(bucket):
+                    waiter = bucket[token]
+                    if waiter.min_index and index is not None and \
+                            index <= waiter.min_index:
+                        continue
+                    matured.append(waiter)
+                    del bucket[token]
+                    self._waiters.pop(token, None)
+                if not bucket:
+                    self._by_key.pop(key, None)
+        for waiter in matured:
+            if faultinject.ACTIVE:
+                try:
+                    faultinject.fire("watch.deliver",
+                                     method=str(waiter.key[0]))
+                except Exception:
+                    # Injected lost wakeup: re-park the waiter — its
+                    # wheel timeout (or the caller's own wait) still
+                    # delivers, so loss degrades to latency, never a
+                    # stuck or leaked waiter.  Re-ARM timed waiters:
+                    # the original wheel entry may have fired into the
+                    # pop-to-re-park gap, and a timed waiter without a
+                    # timer would violate exactly that guarantee.
+                    with self._lock:
+                        self.dropped_wakeups += 1
+                        self._waiters[waiter.token] = waiter
+                        self._by_key.setdefault(waiter.key, {})[
+                            waiter.token] = waiter
+                        if waiter.timed:
+                            self._wheel_locked().arm(
+                                waiter.token,
+                                max(waiter.deadline -
+                                    time.monotonic(), 0.001))
+                    continue
+            self._deliver(waiter, timed_out=False)
 
     def notify_all(self) -> None:
-        """Wake every watcher — used when the whole world may have changed
-        (snapshot restore)."""
+        """Wake every watcher — used when the whole world may have
+        changed (snapshot restore)."""
         with self._lock:
-            groups, self._groups = self._groups, {}
-        for group in groups.values():
-            for ev in group:
-                ev.set()
+            waiters = list(self._waiters.values())
+            self._waiters.clear()
+            self._by_key.clear()
+        for waiter in waiters:
+            self._deliver(waiter, timed_out=False)
+
+    # -- internals ---------------------------------------------------------
+    def _wheel_locked(self):
+        if self._wheel is None:
+            # Lazy import: state must not import nomad_tpu.server at
+            # module load (fsm -> state would cycle); by first timed
+            # subscribe the server package is long imported.
+            from nomad_tpu.server.ttlwheel import TTLWheel
+            self._wheel = TTLWheel(self._on_timeout,
+                                   name="watch-timeout-wheel")
+        return self._wheel
+
+    def _pop(self, token: str) -> Optional[_Waiter]:
+        with self._lock:
+            waiter = self._waiters.pop(token, None)
+            if waiter is None:
+                return None
+            bucket = self._by_key.get(waiter.key)
+            if bucket is not None:
+                bucket.pop(token, None)
+                if not bucket:
+                    self._by_key.pop(waiter.key, None)
+            if waiter.timed and self._wheel is not None:
+                self._wheel.cancel(token)
+        return waiter
+
+    def _deliver(self, waiter: _Waiter, timed_out: bool) -> None:
+        with self._lock:
+            if timed_out:
+                self.timeouts += 1
+            else:
+                self.delivered += 1
+            if waiter.timed and not timed_out and self._wheel is not None:
+                self._wheel.cancel(waiter.token)
+        waiter.deliver(timed_out)
+
+    def _on_timeout(self, token: str) -> None:
+        """Wheel callback: the waiter's wait expired undelivered."""
+        waiter = self._pop(token)
+        if waiter is not None:
+            self._deliver(waiter, timed_out=True)
+
+    # -- introspection / lifecycle ----------------------------------------
+    def live_waiters(self) -> int:
+        """The leak gauge: parked waiters right now."""
+        with self._lock:
+            return len(self._waiters)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "live_waiters": len(self._waiters),
+                "delivered": self.delivered,
+                "timeouts": self.timeouts,
+                "dropped_wakeups": self.dropped_wakeups,
+            }
+
+    def shutdown(self) -> None:
+        """Stop the timeout wheel (server teardown); parked waiters are
+        delivered as timed out so no caller is left hanging."""
+        with self._lock:
+            wheel = self._wheel
+            waiters = list(self._waiters.values())
+            self._waiters.clear()
+            self._by_key.clear()
+        if wheel is not None:
+            wheel.stop()
+        for waiter in waiters:
+            self._deliver(waiter, timed_out=True)
 
 
 class _LineageToken:
@@ -217,7 +406,30 @@ class StateStore(_ReadMixin):
         self._gen_shared = False    # generation container shared w/ snapshot
         self._shared: set = set()   # table names shared with a snapshot
         self._idx_shared = set()    # secondary index names shared
-        self.watch = StateWatch()
+        # The watch's index resolver must NOT close a store<->watch
+        # reference cycle: the store teardown story is refcount-only
+        # (tests/test_gc_untrack.py), so the fan-out holds the store
+        # weakly and a dead store resolves to 0 (recheck no-ops).
+        import weakref
+        store_ref = weakref.ref(self)
+
+        def _index_of(key) -> int:
+            store = store_ref()
+            return store._watch_index(key) if store is not None else 0
+        self.watch = StateWatch(index_of=_index_of)
+
+    def _watch_index(self, key) -> int:
+        """Current table index behind a watch key (the fan-out's
+        lost-wakeup recheck).  Unkeyed/odd keys report the latest index
+        so a recheck can only over-deliver, never under-deliver."""
+        kind = key[0] if isinstance(key, tuple) and key else key
+        if kind in TABLES:
+            return self.get_index(kind)
+        table = {"node": "nodes", "job": "jobs", "eval": "evals",
+                 "alloc-node": "allocs"}.get(kind)
+        if table is not None:
+            return self.get_index(table)
+        return self.latest_index()
 
     # -- snapshot / restore ----------------------------------------------
     def snapshot(self) -> StateSnapshot:
@@ -300,7 +512,7 @@ class StateStore(_ReadMixin):
             new.modify_index = index
             table[new.id] = new
             self._bump("nodes", index)
-        self.watch.notify(("nodes",), ("node", node.id))
+        self.watch.notify(("nodes",), ("node", node.id), index=index)
 
     def delete_node(self, index: int, node_id: str) -> None:
         with self._lock:
@@ -309,7 +521,7 @@ class StateStore(_ReadMixin):
                 raise KeyError(f"node not found: {node_id}")
             del table[node_id]
             self._bump("nodes", index)
-        self.watch.notify(("nodes",), ("node", node_id))
+        self.watch.notify(("nodes",), ("node", node_id), index=index)
 
     def update_node_status(self, index: int, node_id: str,
                            status: str) -> None:
@@ -325,7 +537,7 @@ class StateStore(_ReadMixin):
             new.modify_index = index
             table[node_id] = new
             self._bump("nodes", index)
-        self.watch.notify(("nodes",), ("node", node_id))
+        self.watch.notify(("nodes",), ("node", node_id), index=index)
 
     def update_node_drain(self, index: int, node_id: str,
                           drain: bool) -> None:
@@ -339,7 +551,7 @@ class StateStore(_ReadMixin):
             new.modify_index = index
             table[node_id] = new
             self._bump("nodes", index)
-        self.watch.notify(("nodes",), ("node", node_id))
+        self.watch.notify(("nodes",), ("node", node_id), index=index)
 
     # -- jobs -------------------------------------------------------------
     def upsert_job(self, index: int, job: Job) -> None:
@@ -354,7 +566,7 @@ class StateStore(_ReadMixin):
             new.modify_index = index
             table[new.id] = new
             self._bump("jobs", index)
-        self.watch.notify(("jobs",), ("job", job.id))
+        self.watch.notify(("jobs",), ("job", job.id), index=index)
 
     def delete_job(self, index: int, job_id: str) -> None:
         with self._lock:
@@ -363,7 +575,7 @@ class StateStore(_ReadMixin):
                 raise KeyError(f"job not found: {job_id}")
             del table[job_id]
             self._bump("jobs", index)
-        self.watch.notify(("jobs",), ("job", job_id))
+        self.watch.notify(("jobs",), ("job", job_id), index=index)
 
     # -- evals ------------------------------------------------------------
     def upsert_evals(self, index: int, evals: list) -> None:
@@ -381,7 +593,7 @@ class StateStore(_ReadMixin):
                 table[new.id] = new
                 self._index_add(by_job, new.job_id, new.id)
             self._bump("evals", index)
-        self.watch.notify(("evals",))
+        self.watch.notify(("evals",), index=index)
 
     def delete_eval(self, index: int, eval_ids: list,
                     alloc_ids: list) -> None:
@@ -413,7 +625,7 @@ class StateStore(_ReadMixin):
                 self._log_alloc_change(index, removed)
         keys = [("evals",), ("allocs",)]
         keys += [("alloc-node", n) for n in set(touched_nodes)]
-        self.watch.notify(*keys)
+        self.watch.notify(*keys, index=index)
 
     # -- allocs -----------------------------------------------------------
     def upsert_allocs(self, index: int, allocs: list) -> None:
@@ -429,7 +641,7 @@ class StateStore(_ReadMixin):
             with self._lock:
                 self._writable_table("allocs")
                 self._bump("allocs", index)
-            self.watch.notify(("allocs",))
+            self.watch.notify(("allocs",), index=index)
             return
         self.upsert_allocs_batched([(index, allocs)])
 
@@ -444,6 +656,7 @@ class StateStore(_ReadMixin):
         the harness path passes per-plan indexes so sequential replays
         stay index-exact."""
         touched_nodes = []
+        last_index = 0  # highest index bumped; rides the watch notify
         # Buckets already copied within THIS call: _index_add/_remove
         # copy the shared bucket set on every touch (snapshot safety);
         # across a whole window that is O(bucket x allocs) churn for
@@ -501,9 +714,10 @@ class StateStore(_ReadMixin):
                     touched_nodes.append(new.node_id)
                 self._bump("allocs", index)
                 self._log_alloc_change(index, [a.id for a in allocs])
+                last_index = index
         keys = [("allocs",)] + [("alloc-node", n)
                                 for n in set(touched_nodes)]
-        self.watch.notify(*keys)
+        self.watch.notify(*keys, index=last_index)
 
     def update_alloc_from_client(self, index: int,
                                  alloc: Allocation) -> None:
@@ -522,7 +736,8 @@ class StateStore(_ReadMixin):
             table[new.id] = new
             self._bump("allocs", index)
             self._log_alloc_change(index, (alloc.id,))
-        self.watch.notify(("allocs",), ("alloc-node", alloc.node_id))
+        self.watch.notify(("allocs",), ("alloc-node", alloc.node_id),
+                          index=index)
 
 
 class StateRestore:

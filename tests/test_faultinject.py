@@ -82,6 +82,24 @@ class TestSpecAndRegistry:
         assert rules["device.collect"].secs == 0.01
         assert rules["raft.apply"].after == 2
 
+    def test_serving_plane_sites_registered(self):
+        """ISSUE 7 satellite: the edge chokepoints are first-class
+        sites with the right predicate contexts (12-site table)."""
+        from nomad_tpu.faultinject.plan import SITE_CONTEXT, SITES
+
+        assert len(SITES) == 12
+        for site in ("mux.accept", "conn.read", "watch.deliver"):
+            assert site in SITES
+        assert SITE_CONTEXT["mux.accept"] == ()
+        assert SITE_CONTEXT["conn.read"] == ()
+        assert SITE_CONTEXT["watch.deliver"] == ("method",)
+        # The grammar accepts table-name predicates on watch.deliver.
+        plan = FaultPlan.parse(
+            "mux.accept=error(count=1);conn.read=drop(p=0.1);"
+            "watch.deliver=drop(method=allocs)")
+        rules = {r.site: r for r in plan.rules()}
+        assert rules["watch.deliver"].method == "allocs"
+
     @pytest.mark.parametrize("bad", [
         "nope.site=error",               # unknown site
         "rpc.send=explode",              # unknown action
@@ -95,6 +113,9 @@ class TestSpecAndRegistry:
         "raft.apply=error(method=X)",    # site supplies no method ctx
         "device.collect=error(node=n)",  # site supplies no node ctx
         "heartbeat.deliver=drop(method=Node.Heartbeat)",  # node-only site
+        "mux.accept=error(method=X)",    # edge accept has no request ctx
+        "conn.read=drop(node=n-1)",      # bytes have no node identity
+        "watch.deliver=drop(node=n-1)",  # fan-out passes table as method
     ])
     def test_parse_rejects_malformed(self, bad):
         with pytest.raises(FaultSpecError):

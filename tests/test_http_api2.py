@@ -226,8 +226,7 @@ def test_blocking_query_returns_on_change(agent):
     t.start()
     # Event-driven: the query is parked once the store has a watcher on
     # the jobs table (was a fixed 0.2s sleep).
-    wait_until(lambda: ("jobs",) in
-               agent.server.fsm.state.watch._groups,
+    wait_until(lambda: agent.server.fsm.state.watch.live_waiters() > 0,
                msg="blocking query parked server-side")
     job, _ = _register(agent)
     t.join(timeout=10)

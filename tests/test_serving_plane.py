@@ -1,0 +1,832 @@
+"""Event-driven serving plane: mux loop, dispatch pool, watch fan-out,
+parked blocking queries, connection lifecycle, and the agent swarm.
+
+The structural claim under test everywhere: server resource usage is
+O(worker pools), not O(connected clients) — parked long-polls are
+registry entries, stalled clients are reaped without touching a worker,
+and overflow sheds with ``overloaded:`` instead of starving.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+
+import msgpack
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu import faultinject
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.server import mux as mux_mod
+from nomad_tpu.server.mux import DispatchPool, encode_frame
+from nomad_tpu.server.rpc import (
+    RPC_MUX,
+    ConnPool,
+    MuxConn,
+    RPCError,
+    RPCServer,
+)
+from nomad_tpu.state import StateStore
+from nomad_tpu.state.store import StateWatch
+from nomad_tpu.utils.retry import is_overloaded
+
+from tests.conftest import wait_until
+
+SERVING_THREAD_PREFIXES = ("rpc-loop", "rpc-dispatch")
+
+
+def _serving_threads() -> list:
+    return [t.name for t in threading.enumerate()
+            if t.name.startswith(SERVING_THREAD_PREFIXES)]
+
+
+# ---------------------------------------------------------------------------
+# Watch fan-out (state/store.StateWatch)
+# ---------------------------------------------------------------------------
+
+class TestWatchFanout:
+    def test_min_index_maturity(self):
+        w = StateWatch()
+        got = []
+        w.subscribe(("allocs",), lambda t: got.append(("a", t)),
+                    min_index=10)
+        w.subscribe(("allocs",), lambda t: got.append(("b", t)),
+                    min_index=20)
+        w.notify(("allocs",), index=10)   # advances past nobody
+        assert got == [] and w.live_waiters() == 2
+        w.notify(("allocs",), index=11)   # past 10, not past 20
+        assert got == [("a", False)] and w.live_waiters() == 1
+        w.notify(("allocs",), index=25)
+        assert ("b", False) in got and w.live_waiters() == 0
+
+    def test_notify_without_index_wakes_everyone_on_key(self):
+        w = StateWatch()
+        got = []
+        w.subscribe(("nodes",), lambda t: got.append(t), min_index=99)
+        w.notify(("nodes",))
+        assert got == [False] and w.live_waiters() == 0
+
+    def test_unsubscribe_prevents_delivery_and_empties_registry(self):
+        w = StateWatch()
+        got = []
+        token = w.subscribe(("jobs",), lambda t: got.append(t),
+                            min_index=1)
+        assert w.unsubscribe(token) is True
+        assert w.unsubscribe(token) is False  # idempotent
+        w.notify(("jobs",), index=5)
+        assert got == [] and w.live_waiters() == 0
+
+    def test_ttl_timeout_delivers_and_cleans_up(self):
+        w = StateWatch()
+        got = []
+        w.subscribe(("evals",), lambda t: got.append(t), min_index=1,
+                    ttl=0.1)
+        wait_until(lambda: got == [True], timeout=5,
+                   msg="wheel-driven timeout delivery")
+        assert w.live_waiters() == 0
+        assert w.stats()["timeouts"] == 1
+        w.shutdown()
+
+    def test_lost_wakeup_recheck_delivers_immediately(self):
+        s = StateStore()
+        s.upsert_node(50, mock.node())
+        got = []
+        s.watch.subscribe(("nodes",), lambda t: got.append(t),
+                          min_index=10)  # already past: deliver now
+        assert got == [False]
+        assert s.watch.live_waiters() == 0
+
+    def test_injected_deliver_drop_reparks_then_timeout_rescues(self):
+        """A watch.deliver drop is a lost wakeup, not a lost waiter:
+        the entry stays parked and the wheel timeout still answers."""
+        w = StateWatch()
+        got = []
+        w.subscribe(("allocs",), lambda t: got.append(t), min_index=1,
+                    ttl=0.5)
+        plan = faultinject.FaultPlan(seed=3).add(
+            "watch.deliver", "drop", count=1, method="allocs")
+        with faultinject.injected(plan):
+            w.notify(("allocs",), index=5)
+            assert got == [] and w.live_waiters() == 1
+            assert w.stats()["dropped_wakeups"] == 1
+            wait_until(lambda: got == [True], timeout=5,
+                       msg="timeout rescue after dropped wakeup")
+        assert w.live_waiters() == 0
+        w.shutdown()
+
+    def test_shutdown_answers_stragglers_as_timed_out(self):
+        w = StateWatch()
+        got = []
+        w.subscribe(("allocs",), lambda t: got.append(t), min_index=1,
+                    ttl=300.0)
+        w.shutdown()
+        assert got == [True] and w.live_waiters() == 0
+
+
+# ---------------------------------------------------------------------------
+# Dispatch pool
+# ---------------------------------------------------------------------------
+
+class TestDispatchPool:
+    def test_bound_sheds_and_urgent_bypasses(self):
+        pool = DispatchPool(workers=1, max_queue=1, name="t-dispatch")
+        release = threading.Event()
+        done = []
+        pool.start()
+        try:
+            assert pool.submit(lambda: release.wait(10))  # occupies worker
+            wait_until(lambda: pool.stats()["busy"] == 1,
+                       msg="worker busy")
+            assert pool.submit(lambda: done.append(1))    # fills queue
+            assert not pool.submit(lambda: done.append(2))  # shed
+            assert pool.stats()["rejected"] == 1
+            assert pool.submit(lambda: done.append(3), urgent=True)
+            release.set()
+            wait_until(lambda: sorted(done) == [1, 3],
+                       msg="queued + urgent work ran")
+        finally:
+            release.set()
+            pool.shutdown()
+
+    def test_shutdown_joins_workers(self):
+        pool = DispatchPool(workers=3, name="t-dispatch2")
+        pool.start()
+        threads = list(pool._threads)
+        assert all(t.is_alive() for t in threads)
+        pool.shutdown()
+        assert all(not t.is_alive() for t in threads)
+
+    def test_blocking_section_spawns_bounded_overflow(self):
+        """A worker parked in blocking() must not freeze the pool:
+        queued work runs on a temporary overflow worker, which exits
+        once the queue drains."""
+        pool = DispatchPool(workers=1, name="t-dispatch3")
+        pool.start()
+        release = threading.Event()
+        done = []
+
+        def long_op():
+            with pool.blocking():
+                release.wait(10)
+
+        try:
+            assert pool.submit(long_op)
+            wait_until(lambda: pool.stats()["blocked"] == 1,
+                       msg="worker parked in blocking section")
+            assert pool.submit(lambda: done.append(1))
+            wait_until(lambda: done == [1],
+                       msg="overflow worker ran the queued work")
+            assert pool.stats()["overflow_spawns"] >= 1
+            release.set()
+            wait_until(lambda: pool.stats()["overflow"] == 0,
+                       msg="overflow worker exited with the queue")
+        finally:
+            release.set()
+            pool.shutdown()
+
+    def test_blocking_section_finds_the_workers_pool(self):
+        """mux.blocking_section() delegates to the OWNING pool via the
+        worker threadlocal — the hook that keeps leader/region forwards
+        and wire Eval.Dequeue/Plan.Submit waits (which hold the worker
+        synchronously) from pinning the whole plane.  Off-pool it is a
+        no-op."""
+        pool = DispatchPool(workers=1, name="t-dispatch4")
+        pool.start()
+        release = threading.Event()
+        done = []
+
+        def forward_style_wait():
+            with mux_mod.blocking_section():
+                release.wait(10)
+
+        try:
+            assert pool.submit(forward_style_wait)
+            wait_until(lambda: pool.stats()["blocked"] == 1,
+                       msg="blocking_section marked the pool worker")
+            assert pool.submit(lambda: done.append(1))
+            wait_until(lambda: done == [1],
+                       msg="pool stayed live behind the blocked forward")
+        finally:
+            release.set()
+            pool.shutdown()
+        with mux_mod.blocking_section():  # off-pool: plain no-op
+            pass
+
+
+# ---------------------------------------------------------------------------
+# The RPC edge: parked queries, reaping, shedding, thread budget
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def srv():
+    s = Server(ServerConfig(num_schedulers=1, use_device_scheduler=False,
+                            enable_rpc=True, tune_gc=False))
+    s.establish_leadership()
+    yield s
+    s.shutdown()
+
+
+class TestServingPlane:
+    def test_parked_queries_free_the_worker(self):
+        """THE tentpole property: with ONE dispatch worker, many
+        blocking queries park while fresh requests keep being served —
+        a parked long-poll costs a registry entry, not the worker."""
+        s = Server(ServerConfig(num_schedulers=1,
+                                use_device_scheduler=False,
+                                enable_rpc=True, tune_gc=False,
+                                rpc_dispatch_workers=1))
+        s.establish_leadership()
+        pool = ConnPool()
+        try:
+            s.node_register(mock.node(0))
+            addr = s.rpc_address()
+            base = pool.call(addr, "Node.List", {})["index"]
+            results = []
+
+            def blocker():
+                results.append(pool.call(
+                    addr, "Node.List",
+                    {"min_query_index": base, "max_query_time": 15.0}))
+
+            threads = [threading.Thread(target=blocker)
+                       for _ in range(8)]
+            for t in threads:
+                t.start()
+            wait_until(
+                lambda: s.fsm.state.watch.live_waiters() == 8,
+                msg="8 blocking queries parked as fan-out waiters")
+            assert s.rpc_server._loop.parked_requests() == 8
+            # The single worker is free: a fresh request completes.
+            assert pool.call(addr, "Status.Ping", {}) == {}
+            # One write wakes all eight.
+            s.node_register(mock.node(1))
+            for t in threads:
+                t.join(10)
+                assert not t.is_alive()
+            assert len(results) == 8
+            assert all(r["index"] > base for r in results)
+            assert s.fsm.state.watch.live_waiters() == 0
+        finally:
+            pool.shutdown()
+            s.shutdown()
+
+    def test_blocking_query_timeout_answers_with_current_state(self, srv):
+        pool = ConnPool()
+        try:
+            srv.node_register(mock.node(0))
+            addr = srv.rpc_address()
+            base = pool.call(addr, "Node.List", {})
+            t0 = time.monotonic()
+            out = pool.call(addr, "Node.List",
+                            {"min_query_index": base["index"],
+                             "max_query_time": 0.4})
+            took = time.monotonic() - t0
+            assert 0.3 <= took < 5.0
+            assert out["index"] == base["index"]
+            assert out["nodes"] == base["nodes"]
+            # The timed-out waiter deregistered itself (wheel path).
+            wait_until(lambda: srv.fsm.state.watch.live_waiters() == 0,
+                       msg="timeout deregisters the waiter")
+        finally:
+            pool.shutdown()
+
+    def test_abandoned_long_poll_churn_leaves_registry_empty(self, srv):
+        """The watcher-leak regression (ISSUE satellite): clients that
+        park blocking queries and then die must deregister their
+        waiters via the connection close path — churn ends with a
+        clean registry and no stray connections."""
+        srv.node_register(mock.node(0))
+        addr = srv.rpc_address()
+        base_index = srv.fsm.state.get_index("nodes")
+        for _round in range(3):
+            socks = []
+            for i in range(10):
+                sk = socket.create_connection(addr, timeout=5)
+                sk.sendall(bytes([RPC_MUX]))
+                body = msgpack.packb(
+                    {"seq": 1, "method": "Node.List",
+                     "args": {"min_query_index": base_index,
+                              "max_query_time": 300.0}},
+                    use_bin_type=True)
+                sk.sendall(struct.pack(">I", len(body)) + body)
+                socks.append(sk)
+            wait_until(
+                lambda: srv.fsm.state.watch.live_waiters() == 10,
+                msg="10 long-polls parked")
+            for sk in socks:
+                sk.close()  # abandon them all
+            wait_until(
+                lambda: srv.fsm.state.watch.live_waiters() == 0,
+                msg="conn death deregisters every waiter")
+        assert srv.rpc_server._loop.parked_requests() == 0
+
+    def test_slowloris_partial_frame_is_reaped(self):
+        rpc = RPCServer(read_deadline=0.4)
+        rpc.register("T.ping", lambda args: {})
+        rpc.start()
+        try:
+            sk = socket.create_connection(rpc.address, timeout=5)
+            sk.sendall(bytes([RPC_MUX]))
+            sk.sendall(struct.pack(">I", 64))  # frame header, no body
+            sk.settimeout(5)
+            assert sk.recv(1) == b""  # server reaps the stalled conn
+            sk.close()
+            assert rpc._loop.stats()["closed_deadline"] >= 1
+            # The listener stays healthy.
+            pool = ConnPool()
+            assert pool.call(rpc.address, "T.ping", {}) == {}
+            pool.shutdown()
+        finally:
+            rpc.shutdown()
+
+    def test_pipelining_partial_tails_not_reaped_as_slowloris(self):
+        """A healthy connection streaming frames whose recv chunks keep
+        ending mid-header is making PROGRESS: the partial-frame stamp
+        must refresh on every parse round that completed frames, or
+        sustained pipelined traffic would accumulate toward the read
+        deadline and be reaped as a slowloris."""
+        rpc = RPCServer(read_deadline=0.5)
+        rpc.register("T.ping", lambda args: {})
+        rpc.start()
+        try:
+            sk = socket.create_connection(rpc.address, timeout=5)
+            sk.sendall(bytes([RPC_MUX]))
+            frames = [encode_frame({"seq": i, "method": "T.ping",
+                                    "args": {}}) for i in range(1, 40)]
+            stream = b"".join(frames)
+            step = len(frames[0]) + 2  # every chunk ends mid-header
+            sent = 0
+            t_end = time.monotonic() + 1.3  # well past read_deadline
+            while time.monotonic() < t_end and sent < len(stream):
+                sk.sendall(stream[sent:sent + step])
+                sent += step
+                time.sleep(0.1)  # sleep-ok: paced pipelining with progress every chunk
+            assert rpc._loop.stats()["closed_deadline"] == 0
+            sk.settimeout(5)
+            assert sk.recv(1)  # replies flowing — the conn is alive
+            sk.close()
+        finally:
+            rpc.shutdown()
+
+    def test_silent_connect_is_reaped_on_read_deadline(self):
+        """A connection that never completes a first frame — zero bytes,
+        or just the plane byte — is reaped on read_deadline, NOT parked
+        against max_conns for the whole idle_timeout: silent connects
+        must not be able to camp the cap and shed real clients."""
+        rpc = RPCServer(read_deadline=0.4, idle_timeout=60.0)
+        rpc.register("T.ping", lambda args: {})
+        rpc.start()
+        try:
+            mute = socket.create_connection(rpc.address, timeout=5)
+            plane_only = socket.create_connection(rpc.address, timeout=5)
+            plane_only.sendall(bytes([RPC_MUX]))
+            for sk in (mute, plane_only):
+                sk.settimeout(5)
+                assert sk.recv(1) == b""  # reaped well before idle
+                sk.close()
+            assert rpc._loop.stats()["closed_deadline"] >= 2
+            assert rpc._loop.stats()["closed_idle"] == 0
+        finally:
+            rpc.shutdown()
+
+    def test_idle_connection_is_reaped_but_parked_one_is_not(self):
+        rpc = RPCServer(idle_timeout=0.5)
+        release = threading.Event()
+        rpc.register("T.ping", lambda args: {})
+        rpc.start()
+        try:
+            idle = MuxConn(tuple(rpc.address))
+            assert idle.call("T.ping", {}) == {}
+            wait_until(lambda: idle.broken, timeout=10,
+                       msg="idle connection reaped")
+            assert rpc._loop.stats()["closed_idle"] >= 1
+            idle.close()
+        finally:
+            release.set()
+            rpc.shutdown()
+
+    def test_parked_long_poll_survives_idle_reaping(self, srv):
+        """A connection whose only activity is a parked long-poll is
+        NOT idle — the parked record pins it."""
+        srv.config.rpc_idle_timeout = 0.5
+        srv.rpc_server._loop.idle_timeout = 0.5
+        srv.node_register(mock.node(0))
+        addr = srv.rpc_address()
+        pool = ConnPool()
+        try:
+            base = pool.call(addr, "Node.List", {})["index"]
+            got = []
+
+            def blocker():
+                got.append(pool.call(
+                    addr, "Node.List",
+                    {"min_query_index": base, "max_query_time": 10.0}))
+
+            t = threading.Thread(target=blocker)
+            t.start()
+            wait_until(lambda: srv.fsm.state.watch.live_waiters() == 1,
+                       msg="long-poll parked")
+            time.sleep(1.2)  # sleep-ok: prove the conn outlives idle_timeout while parked
+            assert srv.fsm.state.watch.live_waiters() == 1
+            srv.node_register(mock.node(1))
+            t.join(10)
+            assert got and got[0]["index"] > base
+        finally:
+            pool.shutdown()
+
+    def test_resumed_parked_query_skips_readmission(self, srv):
+        """A blocking query admitted while the server was NORMAL must
+        NOT be re-admitted (and possibly shed) when its watch fires
+        after the server browned out mid-wait: admission is an arrival
+        decision, and the blocking-query contract promises an answer
+        with current state."""
+        from nomad_tpu.server.overload import OVERLOAD
+
+        pool = ConnPool()
+        try:
+            addr = srv.rpc_address()
+            # Bump the allocs index off zero so min_query_index parks.
+            srv.fsm.state.upsert_allocs(srv.raft.applied_index() + 10, [])
+            base = pool.call(addr, "Alloc.List", {})["index"]
+            got = []
+
+            def blocker():
+                got.append(pool.call(
+                    addr, "Alloc.List",
+                    {"min_query_index": base, "max_query_time": 15.0}))
+
+            t = threading.Thread(target=blocker)
+            t.start()
+            wait_until(lambda: srv.fsm.state.watch.live_waiters() == 1,
+                       msg="blocking query parked")
+            srv.overload.force_state(OVERLOAD)
+            # Sanity: a FRESH service-class read is shed right now...
+            with pytest.raises(RPCError) as err:
+                pool.call(addr, "Alloc.List", {})
+            assert is_overloaded(err.value)
+            # ...but the already-admitted parked one answers normally
+            # when the index advances.
+            srv.fsm.state.upsert_allocs(srv.raft.applied_index() + 50, [])
+            t.join(10)
+            assert not t.is_alive()
+            assert got and got[0]["index"] > base
+        finally:
+            srv.overload.force_state(None)
+            pool.shutdown()
+
+    def test_max_conns_sheds_with_overloaded_error(self):
+        rpc = RPCServer(max_conns=1)
+        rpc.register("T.ping", lambda args: {})
+        rpc.start()
+        try:
+            first = MuxConn(tuple(rpc.address))
+            assert first.call("T.ping", {}) == {}
+            # Conn #2 is over the cap: the server writes an
+            # overloaded: frame and closes — the client surfaces a
+            # transport-shaped, retryable failure.
+            with pytest.raises(Exception) as exc:
+                second = MuxConn(tuple(rpc.address))
+                try:
+                    second.call("T.ping", {}, timeout=2)
+                finally:
+                    second.close()
+            assert isinstance(exc.value,
+                              (ConnectionError, OSError, TimeoutError))
+            assert rpc._loop.stats()["conn_sheds"] >= 1
+            first.close()
+        finally:
+            rpc.shutdown()
+
+    def test_dispatch_queue_full_sheds_with_overloaded_error(self):
+        rpc = RPCServer(dispatch_workers=1, dispatch_queue=1)
+        release = threading.Event()
+        rpc.register("T.slow", lambda args: (release.wait(10), {})[1])
+        rpc.register("T.ping", lambda args: {})
+        rpc.start()
+        sess = MuxConn(tuple(rpc.address))
+        try:
+            slow_done = []
+
+            def slow_call():
+                slow_done.append(sess.call("T.slow", {}, timeout=15))
+
+            threads = [threading.Thread(target=slow_call)]
+            threads[0].start()  # occupies the single worker...
+            wait_until(lambda: rpc._pool.stats()["busy"] == 1,
+                       msg="worker busy")
+            threads.append(threading.Thread(target=slow_call))
+            threads[1].start()  # ...then one fills the queue
+            wait_until(lambda: rpc._pool.depth() >= 1,
+                       msg="pool saturated")
+            sheds = []
+            for _ in range(4):
+                try:
+                    sess.call("T.ping", {}, timeout=2)
+                except RPCError as e:
+                    sheds.append(e)
+            assert sheds and all(is_overloaded(e) for e in sheds)
+            release.set()
+            for t in threads:
+                t.join(10)
+            assert len(slow_done) == 2
+        finally:
+            release.set()
+            sess.close()
+            rpc.shutdown()
+
+    def test_thread_count_is_o_pool_not_o_clients(self, srv):
+        """30 connected clients: the serving plane still runs exactly
+        one loop thread + the configured dispatch workers."""
+        before = _serving_threads()
+        workers = srv.config.rpc_dispatch_workers
+        assert len(before) == workers + 1
+        conns = [MuxConn(tuple(srv.rpc_address())) for _ in range(30)]
+        try:
+            for c in conns:
+                assert c.call("Status.Ping", {}) == {}
+            wait_until(
+                lambda: srv.rpc_server._loop.open_conns() >= 30,
+                msg="30 clients connected")
+            assert _serving_threads() == before  # not one thread more
+        finally:
+            for c in conns:
+                c.close()
+
+    def test_shutdown_reaps_serving_threads_and_conns(self):
+        s = Server(ServerConfig(num_schedulers=1,
+                                use_device_scheduler=False,
+                                enable_rpc=True, tune_gc=False))
+        s.establish_leadership()
+        s.node_register(mock.node(0))
+        pool = ConnPool()
+        base = pool.call(s.rpc_address(), "Node.List", {})["index"]
+        errs = []
+
+        def blocker():
+            try:
+                pool.call(s.rpc_address(), "Node.List",
+                          {"min_query_index": base,
+                           "max_query_time": 30.0})
+            except Exception as e:
+                errs.append(e)
+
+        t = threading.Thread(target=blocker)
+        t.start()
+        wait_until(lambda: s.fsm.state.watch.live_waiters() == 1,
+                   msg="query parked before shutdown")
+        s.shutdown()
+        t.join(10)
+        assert not t.is_alive(), "parked caller must not hang shutdown"
+        pool.shutdown()
+        wait_until(lambda: not _serving_threads(), timeout=10,
+                   msg="serving-plane threads reaped")
+        assert s.fsm.state.watch.live_waiters() == 0
+
+
+class TestHTTPEdge:
+    def test_http_long_polls_do_not_freeze_the_plane(self):
+        """HTTP blocking queries wait synchronously (the in-proc RPC
+        path), so they park workers — the blocking() overflow must keep
+        the rest of the API answering while every base worker waits."""
+        import json
+        import urllib.request
+
+        from nomad_tpu.agent.agent import Agent, AgentConfig
+
+        agent = Agent(AgentConfig.dev())
+        host, port = agent.http.address
+        try:
+            # Seed the jobs table so ?index= actually parks.
+            job = mock.job()
+            req = urllib.request.Request(
+                f"http://{host}:{port}/v1/jobs",
+                data=json.dumps({"job": job.to_dict()}).encode(),
+                method="PUT")
+            urllib.request.urlopen(req, timeout=15).read()
+            cur = agent.server.fsm.state.get_index("jobs")
+            workers = agent.http._pool.workers
+
+            def poll():
+                urllib.request.urlopen(
+                    f"http://{host}:{port}/v1/jobs?index={cur}"
+                    f"&wait=10s", timeout=30).read()
+
+            threads = [threading.Thread(target=poll)
+                       for _ in range(workers + 2)]
+            for t in threads:
+                t.start()
+            wait_until(
+                lambda: agent.http._pool.stats()["blocked"] >= workers,
+                msg="every base HTTP worker parked in a long-poll")
+            t0 = time.monotonic()
+            out = urllib.request.urlopen(
+                f"http://{host}:{port}/v1/agent/self", timeout=10).read()
+            assert out and time.monotonic() - t0 < 5.0, \
+                "HTTP plane froze behind parked long-polls"
+            # Wake the polls and drain.
+            req = urllib.request.Request(
+                f"http://{host}:{port}/v1/jobs",
+                data=json.dumps({"job": mock.job().to_dict()}).encode(),
+                method="PUT")
+            urllib.request.urlopen(req, timeout=15).read()
+            for t in threads:
+                t.join(15)
+                assert not t.is_alive()
+        finally:
+            agent.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Fault sites on the edge
+# ---------------------------------------------------------------------------
+
+class TestEdgeFaultSites:
+    def test_mux_accept_error_refuses_the_connection(self):
+        rpc = RPCServer()
+        rpc.register("T.ping", lambda args: {})
+        rpc.start()
+        try:
+            plan = faultinject.FaultPlan(seed=1).add(
+                "mux.accept", "error", count=1)
+            with faultinject.injected(plan):
+                with pytest.raises((ConnectionError, OSError,
+                                    TimeoutError)):
+                    c = MuxConn(tuple(rpc.address))
+                    try:
+                        c.call("T.ping", {}, timeout=2)
+                    finally:
+                        c.close()
+                assert plan.fire_count("mux.accept") == 1
+                assert rpc._loop.stats()["accept_faults"] == 1
+            # Next connection is healthy.
+            c2 = MuxConn(tuple(rpc.address))
+            assert c2.call("T.ping", {}) == {}
+            c2.close()
+        finally:
+            rpc.shutdown()
+
+    def test_conn_read_drop_stalls_then_deadline_reaps(self):
+        """Dropped read bytes = wire loss: the request never completes
+        and the read deadline reaps the desynced connection."""
+        rpc = RPCServer(read_deadline=0.5)
+        rpc.register("T.ping", lambda args: {"ok": True})
+        rpc.start()
+        try:
+            plan = faultinject.FaultPlan(seed=1).add(
+                "conn.read", "drop", count=1)
+            with faultinject.injected(plan):
+                sess = MuxConn(tuple(rpc.address))
+                with pytest.raises((TimeoutError, ConnectionError,
+                                    OSError)):
+                    sess.call("T.ping", {}, timeout=1.5)
+                assert plan.fire_count("conn.read") == 1
+                wait_until(lambda: sess.broken, timeout=10,
+                           msg="desynced conn reaped by read deadline")
+                sess.close()
+            assert rpc._loop.stats()["read_faults"] == 1
+            assert rpc._loop.stats()["closed_deadline"] >= 1
+        finally:
+            rpc.shutdown()
+
+    def test_conn_read_error_severs_the_connection(self):
+        rpc = RPCServer()
+        rpc.register("T.ping", lambda args: {"ok": True})
+        rpc.start()
+        try:
+            plan = faultinject.FaultPlan(seed=1).add(
+                "conn.read", "error", count=1)
+            with faultinject.injected(plan):
+                sess = MuxConn(tuple(rpc.address))
+                with pytest.raises((ConnectionError, OSError,
+                                    TimeoutError)):
+                    sess.call("T.ping", {}, timeout=2)
+                sess.close()
+            assert rpc._loop.stats()["closed_error"] >= 1
+        finally:
+            rpc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Agent swarm (the client half of the 5d bench)
+# ---------------------------------------------------------------------------
+
+class TestAgentSwarm:
+    def test_swarm_beats_polls_and_tears_down_clean(self, srv):
+        from nomad_tpu.agent.swarm import AgentSwarm
+
+        before = set(t.name for t in threading.enumerate())
+        swarm = AgentSwarm(srv.rpc_address(), 40, conns=4, hb_conns=2,
+                           beat_interval=0.3, poll_wait=5.0, seed=7)
+        swarm.start(register_timeout=60)
+        try:
+            # First allocs write: every agent's long-poll parks.
+            srv.fsm.state.upsert_allocs(
+                srv.raft.applied_index() + 1000, [])
+            wait_until(
+                lambda: srv.fsm.state.watch.live_waiters() == 40,
+                timeout=15, msg="40 long-polls parked server-side")
+            wait_until(lambda: swarm.stats()["beats_ok"] >= 80,
+                       timeout=20, msg="heartbeats flowing")
+            delivered0 = srv.fsm.state.watch.stats()["delivered"]
+            srv.fsm.state.upsert_allocs(
+                srv.raft.applied_index() + 2000, [])
+            wait_until(
+                lambda: srv.fsm.state.watch.stats()["delivered"] >=
+                delivered0 + 40,
+                timeout=15, msg="fan-out wakes all 40 pollers")
+            wait_until(
+                lambda: swarm.stats()["poll_wakeups"] >= 80,
+                timeout=15, msg="both writes observed client-side")
+            assert swarm.stats()["beat_errors"] == 0
+            hb = srv.heartbeats.stats()
+            assert hb["expiries"] == 0, "no false TTL expiries"
+        finally:
+            swarm.stop()
+        wait_until(
+            lambda: not [t for t in threading.enumerate()
+                         if t.name not in before and
+                         t.name.startswith(("swarm-", "rpc-mux-read"))],
+            timeout=10, msg="swarm threads reaped")
+
+
+@pytest.mark.slow
+class TestSwarmChaosSoak:
+    def test_seeded_edge_faults_converge_with_no_leaks(self):
+        """The ISSUE's chaos soak: socket stalls/drops injected at the
+        new edge sites (mux.accept, conn.read, watch.deliver) while a
+        swarm heartbeats + long-polls and a real job schedules.  Must
+        converge: exactly-once placement, zero false expiries, zero
+        leaked threads/connections/waiters."""
+        from nomad_tpu.agent.swarm import AgentSwarm
+
+        before = set(t.name for t in threading.enumerate())
+        s = Server(ServerConfig(num_schedulers=2,
+                                use_device_scheduler=False,
+                                enable_rpc=True, tune_gc=False,
+                                rpc_read_deadline=1.0,
+                                heartbeat_seed=11))
+        s.establish_leadership()
+        swarm = AgentSwarm(s.rpc_address(), 120, conns=6, hb_conns=2,
+                           beat_interval=0.4, poll_wait=4.0, seed=11,
+                           node_factory=mock.node)
+        pool = ConnPool()
+        try:
+            swarm.start(register_timeout=120)
+            s.fsm.state.upsert_allocs(s.raft.applied_index() + 500, [])
+            wait_until(
+                lambda: s.fsm.state.watch.live_waiters() >= 100,
+                timeout=30, msg="swarm long-polls parked")
+            plan = faultinject.FaultPlan(seed=11)
+            plan.add("mux.accept", "error", count=2)
+            plan.add("conn.read", "drop", p=0.02, count=25)
+            plan.add("conn.read", "delay", p=0.02, count=25, secs=0.05)
+            plan.add("watch.deliver", "drop", count=5)
+            with faultinject.injected(plan):
+                from nomad_tpu.utils.retry import (RetryPolicy,
+                                                   transport_or_overload)
+                job = mock.job()
+                job.task_groups[0].count = 3
+                # Clients ride injected accept/read faults exactly like
+                # a dead socket: classified retryable, jittered retry.
+                out = RetryPolicy(
+                    base=0.05, max_delay=0.5, max_attempts=20,
+                    retryable=transport_or_overload,
+                    name="soak.register").call(
+                    lambda timeout=None: pool.call(
+                        s.rpc_address(), "Job.Register",
+                        {"job": job.to_dict()}, timeout=10))
+                assert out["eval_id"]
+                # Periodic writes keep the fan-out firing under faults.
+                for i in range(6):
+                    s.fsm.state.upsert_allocs(
+                        s.raft.applied_index() + 1000 + i, [])
+                    time.sleep(0.5)  # sleep-ok: paced fault-window writes
+                s.wait_for_evals([out["eval_id"]], timeout=30)
+                assert plan.fire_count() > 0, "the soak injected nothing"
+            # Convergence: exactly-once placement...
+            allocs = s.fsm.state.allocs_by_job(job.id)
+            assert len(allocs) == 3
+            assert len({a.node_id for a in allocs}) <= 3
+            assert all(a.node_id for a in allocs)
+            # ...zero false expiries (beats kept flowing)...
+            hb = s.heartbeats.stats()
+            assert hb["expiries"] == 0
+            not_ready = [n.id for n in s.fsm.state.nodes()
+                         if n.status != "ready"]
+            assert not_ready == []
+            # ...and the swarm rode the faults out.
+            wait_until(lambda: swarm.stats()["beats_ok"] > 200,
+                       timeout=30, msg="heartbeats recovered")
+        finally:
+            swarm.stop()
+            pool.shutdown()
+            s.shutdown()
+        # No leaked threads, connections, or waiters.
+        assert s.fsm.state.watch.live_waiters() == 0
+        wait_until(
+            lambda: not [t for t in threading.enumerate()
+                         if t.name not in before and t.name.startswith(
+                             ("rpc-", "swarm-", "watch-", "http-"))],
+            timeout=15, msg="no leaked serving/swarm threads")

@@ -235,6 +235,56 @@ class TestLintGate:
                        or "server/heartbeat" in e for e in allow), \
             "overload plane must not need allowlist entries"
 
+    def test_serving_plane_rides_the_gates(self):
+        """ISSUE 7 satellite: the event-driven serving plane —
+        selector mux + dispatch pool (server/mux.py), the rewritten
+        RPCServer/MuxConn (server/rpc.py), the watch fan-out
+        (state/store.py), the event-driven HTTP edge
+        (agent/http_server.py) and the agent swarm (agent/swarm.py) —
+        is inside every gate's scan set, strict-clean, with zero
+        allowlist entries of its own (the refactor RETIRED the
+        _serve_mux thread-leak and MuxConn._wlock blocking waivers)."""
+        from nomad_tpu.analysis.callgraph import CallGraph
+        from nomad_tpu.analysis import default_package_root
+
+        pkg = default_package_root()
+        graph = CallGraph.build(pkg)
+        for qual in (
+            "nomad_tpu.server.mux:EdgeLoop._run",
+            "nomad_tpu.server.mux:EdgeLoop._close",
+            "nomad_tpu.server.mux:DispatchPool.submit",
+            "nomad_tpu.server.mux:DispatchPool._run",
+            "nomad_tpu.server.rpc:RPCServer._execute",
+            "nomad_tpu.server.rpc:RPCServer._park",
+            "nomad_tpu.server.rpc:MuxConn.call_async",
+            "nomad_tpu.server.rpc:MuxConn._write_loop",
+            "nomad_tpu.state.store:StateWatch.subscribe",
+            "nomad_tpu.state.store:StateWatch.notify",
+            "nomad_tpu.agent.swarm:AgentSwarm._issue_poll",
+            "nomad_tpu.agent.http_server:HTTPServer._serve_one",
+        ):
+            assert qual in graph.functions, \
+                f"{qual} missing from the interprocedural graph"
+
+        allowlist = load_allowlist(default_allowlist_path())
+        gating, _allowed, _stale = partition_findings(
+            run_lint(strict=True), allowlist)
+        touching = [f for f in gating
+                    if "server/mux" in f.path or "agent/swarm" in f.path
+                    or "server/rpc" in f.path
+                    or "state/store" in f.path
+                    or "agent/http_server" in f.path]
+        assert touching == [], \
+            "serving plane must lint clean:\n" + \
+            "\n".join(f.render() for f in touching)
+        allow = load_allowlist(default_allowlist_path())
+        assert not any("server/mux" in e or "agent/swarm" in e
+                       for e in allow), \
+            "serving plane must not need allowlist entries"
+        assert not any("_serve_mux" in e or "_wlock" in e
+                       for e in allow), \
+            "the retired rpc.py waivers must stay retired"
+
     def test_fixed_sleep_ratchet_is_clean(self):
         """Every fixed time.sleep in the test tree is either converted
         to wait_until or carries a '# sleep-ok: why' justification —
