@@ -434,8 +434,17 @@ def bench_client_swarm(n_agents: int, window_s: float, note) -> dict:
     from nomad_tpu.server import Server, ServerConfig
 
     def serving_threads() -> list:
+        # Port-qualified names: count ONLY this server's serving
+        # threads — an earlier bench's husks must not fail the
+        # O(pool) structural assertion.
+        port = srv.rpc_address()[1]
+        # Exact loop name / dispatch prefix WITH the "-" separator: a
+        # bare f"rpc-dispatch:{port}" prefix would also match another
+        # server whose port has this one as a decimal prefix
+        # (4646 vs 46460).
         return [t.name for t in threading.enumerate()
-                if t.name.startswith(("rpc-loop", "rpc-dispatch"))]
+                if t.name == f"rpc-loop:{port}"
+                or t.name.startswith(f"rpc-dispatch:{port}-")]
 
     workers = 8
     srv = Server(ServerConfig(
@@ -785,6 +794,320 @@ def bench_overload_brownout(n_agents: int, window_s: float,
         srv.shutdown()
 
 
+def bench_failover(kills: int, jobs_per_kill: int, note) -> dict:
+    """Config 5e: rolling leader-kill failover on a durable 3-server
+    NetRaft cluster (the crash-recovery headline).
+
+    Each round starts a fresh 2-lane submission burst, then hard-kills
+    the current leader mid-burst via faultinject.crash.CrashHarness
+    (storage frozen + process shell abandoned — no graceful teardown of
+    any kind), so every kill lands with client writes in flight.
+    Measured per kill, from an independent probe writer issuing small
+    raft writes continuously on its own conn pool: recovery (kill ->
+    first client write committed by the new leader) and the full
+    client-visible write-unavailability window (last pre-kill ack ->
+    first post-kill ack); survivor election latency is recorded
+    separately as observed from inside the cluster.  The killed
+    node reboots from its own data_dir (snapshot threshold kept low so
+    some rejoins ride InstallSnapshot, others log replay) and must
+    catch up before the next round.  After the last round the cluster
+    must converge to identical stores with exactly-once placement and
+    ``committed_plan_loss == 0``: every client-acked write is present
+    in the final state — asserted, not just recorded.
+    """
+    import shutil
+    import socket
+    import tempfile
+    import threading
+
+    from nomad_tpu.faultinject.crash import CrashHarness
+    from nomad_tpu.server import Server, ServerConfig
+    from nomad_tpu.server.rpc import ConnPool
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def wait_for(pred, timeout: float, what: str, tick: float = 0.002):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            v = pred()
+            if v:
+                return v
+            time.sleep(tick)
+        raise AssertionError(f"config5e: timed out waiting for {what}")
+
+    def small_job():
+        job = mock.job()
+        job.constraints = []
+        job.task_groups = [
+            TaskGroup(name=f"tg-{g}", count=1,
+                      tasks=[Task(name="web", driver="exec",
+                                  resources=Resources(cpu=100,
+                                                      memory_mb=32))])
+            for g in range(2)]
+        return job
+
+    tmp = tempfile.mkdtemp(prefix="nomad-tpu-5e-")
+    ports = [free_port() for _ in range(3)]
+    peer_addrs = [("127.0.0.1", p) for p in ports]
+
+    def cfg(i: int) -> ServerConfig:
+        return ServerConfig(
+            data_dir=os.path.join(tmp, f"s{i}"), raft_mode="net",
+            rpc_port=ports[i], raft_peers=list(peer_addrs),
+            num_schedulers=1,
+            raft_election_timeout=(0.10, 0.20),
+            raft_heartbeat_interval=0.03,
+            raft_snapshot_threshold=64)
+
+    servers = {i: Server(cfg(i)) for i in range(3)}
+    alive = dict(servers)
+    harness = CrashHarness()
+    pool = ConnPool()
+    stop = threading.Event()
+    rr = [0]
+
+    def addr_fn():
+        targets = list(alive.values())
+        rr[0] += 1
+        return targets[rr[0] % len(targets)].rpc_address()
+
+    def submit_retry(method: str, args: dict, deadline: float = 120.0,
+                     timeout: float = 0.5):
+        end = time.monotonic() + deadline
+        while True:
+            try:
+                return pool.call(addr_fn(), method, args,
+                                 timeout=timeout)
+            except Exception:
+                if stop.is_set() or time.monotonic() >= end:
+                    raise
+                time.sleep(0.01)
+
+    def leader_of(timeout: float = 15.0):
+        def one_leader():
+            leaders = [s for s in alive.values() if s.raft.is_leader()]
+            return leaders[0] if len(leaders) == 1 else None
+        return wait_for(one_leader, timeout, "a single leader")
+
+    # Independent probe writer: small idempotent raft writes (re-upsert
+    # of one probe node) issued continuously through every kill.  The
+    # gap between the last ack before a kill's first failure and the
+    # first ack after it IS the client-visible unavailability window.
+    # The probe rides its OWN ConnPool: shared mux conns would queue
+    # its calls behind lane traffic and measure contention, not
+    # availability.
+    probe_node = mock.node(990)
+    probe_pool = ConnPool()
+    probe_log: list = []  # (t_start, t_end, ok)
+
+    def probe() -> None:
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                probe_pool.call(addr_fn(), "Node.Register",
+                                {"node": probe_node.to_dict()},
+                                timeout=0.25)
+                probe_log.append((t0, time.perf_counter(), True))
+            except Exception:
+                probe_log.append((t0, time.perf_counter(), False))
+            time.sleep(0.004)
+
+    jobs: list = []
+    acked: dict = {}
+    election_s: list = []
+    recovery_s: list = []
+    rejoin_s: list = []
+    kill_times: list = []
+    all_lanes: list = []
+    try:
+        leader_of()
+        for i in range(8):
+            submit_retry("Node.Register",
+                         {"node": mock.node(i).to_dict()})
+        prober = threading.Thread(target=probe, daemon=True,
+                                  name="bench-5e-probe")
+        prober.start()
+
+        def lane(lane_jobs: list) -> None:
+            for job in lane_jobs:
+                if stop.is_set():
+                    return
+                resp = submit_retry("Job.Register",
+                                    {"job": job.to_dict()})
+                acked[job.id] = resp.get("index", 0)
+
+        for kill in range(kills):
+            # Fresh burst every round, kill while it is in flight.
+            batch = [small_job() for _ in range(jobs_per_kill)]
+            jobs.extend(batch)
+            lanes = [threading.Thread(target=lane, args=(batch[i::2],),
+                                      daemon=True,
+                                      name=f"bench-5e-lane-{kill}-{i}")
+                     for i in range(2)]
+            all_lanes.extend(lanes)
+            for t in lanes:
+                t.start()
+
+            leader = leader_of()
+            victim = next(i for i, s in alive.items() if s is leader)
+            t_kill = time.perf_counter()
+            kill_times.append(t_kill)
+            harness.kill(leader)
+            del alive[victim]
+
+            # Survivors elect among themselves: time kill -> a single
+            # stable leader visible, BEFORE the canary write — the
+            # canary blocks on commit + retry backoff and would
+            # inflate the election number with commit latency.
+            new_leader = leader_of()
+            election_s.append(time.perf_counter() - t_kill)
+            assert new_leader is not leader
+
+            # The canary is a fresh committed write the reborn node
+            # must catch up to; recovery latency itself is derived
+            # from the probe writer's log after the run (the probe is
+            # the uncontended client — the canary shares the lanes'
+            # conn pool and would measure THEIR queueing).
+            canary = mock.node(200 + kill)
+            submit_retry("Node.Register", {"node": canary.to_dict()},
+                         timeout=0.25)
+
+            # The killed node reboots from its own disk and catches up
+            # (log replay or InstallSnapshot) before the next round.
+            t_boot = time.perf_counter()
+            reborn = harness.reboot(cfg(victim))
+            alive[victim] = reborn
+            wait_for(lambda: reborn.fsm.state.node_by_id(canary.id)
+                     is not None, 30.0, f"rejoin catch-up (kill {kill})")
+            rejoin_s.append(time.perf_counter() - t_boot)
+
+        for t in all_lanes:
+            t.join(150.0)
+        assert all(not t.is_alive() for t in all_lanes), "stuck lane"
+        assert set(acked) == {j.id for j in jobs}, "lost submissions"
+
+        # Quiesce the probe before the convergence checks: replicas
+        # can only digest identically once writes stop arriving (the
+        # last kill's post-kill acks landed long ago — the lanes'
+        # post-kill submissions all committed before their join).
+        stop.set()
+        prober.join(5.0)
+
+        leader = leader_of()
+        state = leader.fsm.state
+
+        def terminal() -> bool:
+            for job in jobs:
+                evals = state.evals_by_job(job.id)
+                if not evals or any(e.status not in
+                                    ("complete", "failed", "canceled")
+                                    for e in evals):
+                    return False
+            return True
+        wait_for(terminal, 90.0, "storm terminal after the kills",
+                 tick=0.02)
+
+        # committed_plan_loss: every client-acked write survived into
+        # the final converged store.
+        lost = [jid for jid in acked if state.job_by_id(jid) is None]
+        if state.node_by_id(probe_node.id) is None and \
+                any(ok for _, _, ok in probe_log):
+            lost.append(probe_node.id)
+        committed_plan_loss = len(lost)
+        assert committed_plan_loss == 0, f"committed writes lost: {lost}"
+
+        # Exactly-once placement: full coverage, zero duplicates.
+        duplicate_allocs = 0
+        placed = 0
+        for job in jobs:
+            expected = sum(tg.count for tg in job.task_groups)
+            live = [a for a in state.allocs_by_job(job.id)
+                    if not a.terminal_status()]
+            names = [a.name for a in live]
+            duplicate_allocs += len(names) - len(set(names))
+            assert len(live) == expected, \
+                f"job {job.id}: {len(live)} live allocs, want {expected}"
+            placed += len(live)
+        assert duplicate_allocs == 0
+
+        # Replicas converge to the same tables (changelogs differ
+        # legitimately across InstallSnapshot boundaries).
+        wait_for(lambda: len({s.fsm.state.fingerprint(
+            changelog_since=10**9) for s in alive.values()}) == 1,
+            30.0, "replica convergence", tick=0.02)
+
+        # Probe-log derived metrics, per kill: recovery = kill ->
+        # first post-kill ack (the new leader committed a client
+        # write); unavailability = last pre-kill ack -> first
+        # post-kill ack (the full client-visible write gap).
+        unavail_s: list = []
+        for t_kill in kill_times:
+            before = [t1 for _, t1, ok in probe_log
+                      if ok and t1 <= t_kill]
+            after = [t1 for _, t1, ok in probe_log
+                     if ok and t1 > t_kill]
+            if after:
+                recovery_s.append(after[0] - t_kill)
+                unavail_s.append(after[0] - (max(before) if before
+                                             else t_kill))
+        probe_ok = sum(1 for _, _, ok in probe_log if ok)
+        probe_failed = len(probe_log) - probe_ok
+        assert probe_ok > 0
+
+        row = {
+            "servers": 3,
+            "kills": kills,
+            "jobs": len(jobs),
+            "placed": placed,
+            "election_ms_p50": round(_p(election_s, 50), 1),
+            "election_ms_p99": round(_p(election_s, 99), 1),
+            "recovery_ms_p50": round(_p(recovery_s, 50), 1),
+            "recovery_ms_p99": round(_p(recovery_s, 99), 1),
+            "unavailability_ms_p50": round(_p(unavail_s, 50), 1),
+            "unavailability_ms_p99": round(_p(unavail_s, 99), 1),
+            "unavailability_ms_total":
+                round(sum(unavail_s) * 1000.0, 1),
+            "rejoin_catchup_ms_p50": round(_p(rejoin_s, 50), 1),
+            "rejoin_catchup_ms_p99": round(_p(rejoin_s, 99), 1),
+            "probe_writes_ok": probe_ok,
+            "probe_writes_failed": probe_failed,
+            "committed_plan_loss": committed_plan_loss,
+            "duplicate_allocs": duplicate_allocs,
+            "note": (f"{kills} rolling hard leader kills (CrashHarness: "
+                     "storage frozen, no graceful teardown) on a "
+                     "durable 3-server NetRaft cluster, each mid-"
+                     "submission-burst; from an uncontended probe "
+                     "writer: recovery = kill -> first client write "
+                     "committed by the new leader, unavailability = "
+                     "last pre-kill ack -> first post-kill ack; killed "
+                     "node reboots from its own data_dir and catches "
+                     "up (log replay or InstallSnapshot); "
+                     "committed_plan_loss and duplicate allocs must "
+                     "be ZERO"),
+        }
+        note(f"config5e failover: {kills} leader kills, election p50 "
+             f"{_p(election_s, 50):.0f}ms / p99 "
+             f"{_p(election_s, 99):.0f}ms, recovery (first new-leader "
+             f"commit) p50 {_p(recovery_s, 50):.0f}ms / p99 "
+             f"{_p(recovery_s, 99):.0f}ms, unavailability p50 "
+             f"{_p(unavail_s, 50):.0f}ms / p99 "
+             f"{_p(unavail_s, 99):.0f}ms, rejoin p99 "
+             f"{_p(rejoin_s, 99):.0f}ms, {placed} placed exactly-once, "
+             f"committed_plan_loss 0")
+        return row
+    finally:
+        stop.set()
+        pool.shutdown()
+        probe_pool.shutdown()
+        harness.reap(also=list(alive.values()))
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=10_000)
@@ -802,6 +1125,8 @@ def main() -> None:
                     help="measured 5d swarm window in seconds")
     ap.add_argument("--overload-window", type=float, default=6.0,
                     help="seconds of 5x offered overload in config 5c")
+    ap.add_argument("--failover-kills", type=int, default=6,
+                    help="rolling leader kills in config 5e")
     ap.add_argument("--depth", type=int, default=6)
     ap.add_argument("--repeats", type=int, default=3)
     ap.add_argument("--quick", action="store_true",
@@ -1194,6 +1519,16 @@ def main() -> None:
          f"group commit: {dev_commits} commits "
          f"({dev_committed / max(1, dev_commits):.1f} plans/commit, "
          f"{dev_fallbacks} conflict fallbacks)")
+
+    # --- config 5e: leader-kill failover (the durability headline) --------
+    # Rolling hard leader kills on a durable 3-server NetRaft cluster,
+    # each mid-submission-burst: recovery latency p50/p99, client-
+    # visible unavailability window, committed_plan_loss == 0 asserted.
+    # Runs BEFORE the 2k/10k-agent rows: election latency is timing-
+    # sensitive and must not measure their teardown load.
+    configs["5e_failover"] = bench_failover(
+        kills=3 if args.quick else args.failover_kills,
+        jobs_per_kill=2 if args.quick else 4, note=note)
 
     # --- config 5c: overload brownout (the robustness headline) ----------
     # A REAL server under 5x offered overload: admission sheds, TTL

@@ -22,6 +22,7 @@ from nomad_tpu.structs import (
     ALLOC_CLIENT_STATUS_RUNNING,
     ALLOC_DESIRED_STATUS_RUN,
     Allocation,
+    Task,
 )
 
 from nomad_tpu.utils.sync import CopySwap
@@ -31,6 +32,49 @@ from .driver.base import ExecContext
 from .task_runner import TASK_STATE_DEAD, TASK_STATE_RUNNING, TaskRunner
 
 logger = logging.getLogger("nomad_tpu.client.alloc_runner")
+
+
+class CorruptAllocState(ValueError):
+    """Persisted alloc state exists but cannot be decoded (torn write,
+    truncation, crash mid-save).  The RUNNER is unrecoverable locally;
+    the ALLOCATION is not — the server still knows it, and the client
+    degrades to re-fetching it from the first alloc watch and
+    re-attaching (task state persists separately, so a live task's
+    handle usually survives)."""
+
+
+def reclaim_orphan(alloc_id: str, alloc_root: str, state_dir: str,
+                   options: Optional[dict] = None) -> None:
+    """Kill-and-reclaim for an alloc the server is DONE with (terminal
+    or gone) whose local alloc state is torn (CorruptAllocState): the
+    alloc spec is unreadable, but each task's spec and driver handle
+    persist separately (``task-<name>.json``), so any still-live
+    process is re-attached by its handle and killed before the
+    directories are reclaimed — a torn state file must never leave an
+    orphan running forever."""
+    ctx = ExecContext(AllocDir(alloc_root), alloc_id, options=options)
+    try:
+        names = os.listdir(state_dir)
+    except OSError:
+        names = []
+    for name in names:
+        if not (name.startswith("task-") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(state_dir, name)) as fh:
+                task = Task.from_dict(json.load(fh)["task"])
+        except Exception:
+            continue  # the task file is torn too: no handle to open
+        tr = TaskRunner(ctx, task, state_dir=state_dir)
+        if tr.restore_state():
+            try:
+                tr.handle.kill()
+            except Exception:
+                logger.exception("orphan task %s kill failed", task.name)
+    import shutil
+
+    shutil.rmtree(state_dir, ignore_errors=True)
+    shutil.rmtree(alloc_root, ignore_errors=True)
 
 
 class AllocRunner:
@@ -87,12 +131,20 @@ class AllocRunner:
                 on_status: Optional[Callable] = None,
                 options: Optional[dict] = None
                 ) -> Optional["AllocRunner"]:
+        """Rebuild a runner from persisted state.  Returns None when no
+        state was persisted (nothing to restore); raises
+        :class:`CorruptAllocState` when state exists but is torn — the
+        caller must re-fetch the alloc from the server rather than
+        silently discarding a possibly-running allocation."""
+        path = os.path.join(state_dir, "state.json")
         try:
-            with open(os.path.join(state_dir, "state.json")) as fh:
+            with open(path) as fh:
                 data = json.load(fh)
-        except (OSError, ValueError):
+            alloc = Allocation.from_dict(data["alloc"])
+        except OSError:
             return None
-        alloc = Allocation.from_dict(data["alloc"])
+        except Exception as e:
+            raise CorruptAllocState(f"{path}: {e}") from e
         runner = cls(alloc, alloc_root, state_dir, on_status,
                      options=options)
         return runner

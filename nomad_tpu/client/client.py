@@ -27,7 +27,7 @@ from nomad_tpu.structs import (
 from nomad_tpu.utils.retry import Backoff, RetryPolicy
 from nomad_tpu.utils.sync import Immutable
 
-from .alloc_runner import AllocRunner
+from .alloc_runner import AllocRunner, CorruptAllocState, reclaim_orphan
 from .config import ClientConfig
 from .driver import BUILTIN_DRIVERS
 from .fingerprint import fingerprint_node
@@ -92,6 +92,13 @@ class Client:
 
         self.alloc_runners: dict = {}
         self._alloc_lock = threading.Lock()
+        # Allocs whose persisted state was corrupt at restore (torn
+        # write from a crash mid-save): the alloc dir is KEPT and the
+        # first alloc watch re-fetches the spec from the server; the
+        # fresh runner runs with restore=True so a still-live task
+        # re-attaches via its (separately persisted) handle instead of
+        # doubling.  Guarded by _alloc_lock after construction.
+        self._recover_alloc_ids: set = set()
         # Client-authoritative alloc updates awaiting delivery
         # (alloc id -> update dict, newest wins); flushed inline and
         # re-flushed after each successful heartbeat.
@@ -185,10 +192,22 @@ class Client:
             return
         for alloc_id in os.listdir(allocs_dir):
             state_dir = os.path.join(allocs_dir, alloc_id)
-            runner = AllocRunner.restore(
-                self._alloc_root(alloc_id), state_dir,
-                on_status=self._sync_alloc_status,
-                options=self.config.options)
+            try:
+                runner = AllocRunner.restore(
+                    self._alloc_root(alloc_id), state_dir,
+                    on_status=self._sync_alloc_status,
+                    options=self.config.options)
+            except CorruptAllocState as e:
+                # Torn local state (crash mid-save): the server still
+                # knows this alloc.  Keep the directories and re-fetch
+                # the spec from the first alloc watch — discarding it
+                # here would orphan a possibly-running task.
+                logger.warning(
+                    "client: alloc %s state is corrupt (%s); will "
+                    "re-fetch it from the server and re-attach",
+                    alloc_id, e)
+                self._recover_alloc_ids.add(alloc_id)
+                continue
             if runner is None:
                 continue
             if runner.alloc.terminal_status() or \
@@ -330,6 +349,7 @@ class Client:
         """Diff assigned allocs vs running runners
         (reference client/util.go:34-70 + client.go:650-728)."""
         assigned = {a.id: a for a in updated}
+        reclaim: list = []
         with self._alloc_lock:
             existing = dict(self.alloc_runners)
 
@@ -341,10 +361,27 @@ class Client:
                     threading.Thread(target=runner.destroy,
                                      daemon=True).start()
 
+            # A recovering (torn-state) alloc the server no longer
+            # lists at all — GC'd while the client was down: same
+            # semantics as the Removed branch, but there is no runner,
+            # so the persisted task handles drive the kill + reclaim.
+            for alloc_id in list(self._recover_alloc_ids):
+                if alloc_id not in assigned:
+                    self._recover_alloc_ids.discard(alloc_id)
+                    reclaim.append(alloc_id)
+
             for alloc in assigned.values():
                 runner = existing.get(alloc.id)
                 if runner is None:
+                    recover = alloc.id in self._recover_alloc_ids
+                    self._recover_alloc_ids.discard(alloc.id)
                     if alloc.terminal_status():
+                        if recover:
+                            # The server is done with it; the torn
+                            # state still names live task handles —
+                            # kill the orphan and reclaim, never just
+                            # forget it.
+                            reclaim.append(alloc.id)
                         continue
                     runner = AllocRunner(
                         alloc, self._alloc_root(alloc.id),
@@ -352,9 +389,31 @@ class Client:
                         on_status=self._sync_alloc_status,
                         options=self.config.options)
                     self.alloc_runners[alloc.id] = runner
-                    runner.run()
+                    # A re-fetched corrupt-state alloc runs the restore
+                    # path: task handles persist separately from alloc
+                    # state, so a live task re-attaches (exactly-once)
+                    # instead of starting a double.
+                    runner.run(restore=recover)
                 elif alloc.modify_index > runner.alloc.modify_index:
                     runner.update(alloc)
+        for alloc_id in reclaim:
+            self._reclaim_recover(alloc_id)
+
+    def _reclaim_recover(self, alloc_id: str) -> None:
+        """Background kill-and-reclaim of a corrupt-state alloc the
+        server is done with (reclaim_orphan re-attaches any live task
+        by its persisted handle first — blocking driver work stays off
+        the watch loop, like the Removed branch's destroy).  The
+        handle is retained: shutdown() joins it like every other
+        client thread."""
+        t = threading.Thread(
+            target=reclaim_orphan,
+            args=(alloc_id, self._alloc_root(alloc_id),
+                  self._alloc_state_dir(alloc_id)),
+            kwargs={"options": self.config.options},
+            daemon=True, name=f"alloc-reclaim-{alloc_id[:8]}")
+        t.start()
+        self._threads.append(t)
 
     def _sync_alloc_status(self, alloc: Allocation) -> None:
         """Dirty-sync client-authoritative fields to the server.  The

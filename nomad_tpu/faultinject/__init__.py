@@ -39,6 +39,8 @@ from typing import Optional
 from .plan import (  # noqa: F401  (public API re-exports)
     ACTIONS,
     SITES,
+    STORAGE_SITES,
+    FaultCrash,
     FaultDropped,
     FaultInjected,
     FaultPlan,
@@ -105,6 +107,19 @@ def fire(site: str, method: Optional[str] = None,
     if plan is None:
         return
     plan.fire(site, method=method, node=node)
+
+
+def crashed(path: Optional[str] = None) -> bool:
+    """True after a ``crash`` fault fired and before a CrashHarness
+    reboot: the simulated process is dead, so every storage site it
+    covers must refuse writes (the first torn record must stay the
+    LAST byte the process ever wrote).  ``path`` is the caller's
+    on-disk location — a crash rule scoped with a ``method`` path
+    prefix latches only the stores under that prefix (one server's
+    data_dir in a multi-server soak); an unscoped rule latches them
+    all."""
+    plan = _active
+    return plan is not None and plan.is_crashed(path)
 
 
 def fire_rpc(site: str, method: str, args) -> None:

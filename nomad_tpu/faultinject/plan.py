@@ -63,6 +63,21 @@ SITES = (
     "mux.accept",         # the serving-plane event loop accepting a conn
     "conn.read",          # bytes arriving on a multiplexed client conn
     "watch.deliver",      # the watch fan-out waking a matured waiter
+    "log.append",         # a raft log record about to be written
+    "log.fsync",          # a written raft log record about to be fsynced
+    "snapshot.persist",   # an FSM snapshot file being persisted
+    "meta.persist",       # raft term/vote metadata being persisted
+)
+
+# The durable-storage chokepoints (server/raft.py FileLogStore /
+# SnapshotStore / MetaStore).  They are the only sites where the
+# ``crash`` action is legal: a simulated power loss is meaningless at a
+# site with no bytes in flight to tear.
+STORAGE_SITES = (
+    "log.append",
+    "log.fsync",
+    "snapshot.persist",
+    "meta.persist",
 )
 
 # Which match-predicate context each site's instrumentation supplies.
@@ -87,9 +102,16 @@ SITE_CONTEXT = {
     "mux.accept": (),
     "conn.read": (),
     "watch.deliver": ("method",),
+    # Storage sites pass the store's on-disk path as ``method`` so a
+    # multi-server soak can target ONE server's data_dir with a
+    # ``method=/tmp/cluster/s1*`` prefix predicate.
+    "log.append": ("method",),
+    "log.fsync": ("method",),
+    "snapshot.persist": ("method",),
+    "meta.persist": ("method",),
 }
 
-ACTIONS = ("error", "drop", "delay", "hang")
+ACTIONS = ("error", "drop", "delay", "hang", "crash")
 
 DELAY_DEFAULT_SECS = 0.05
 HANG_DEFAULT_SECS = 300.0
@@ -101,6 +123,36 @@ class FaultInjected(Exception):
 
 class FaultDropped(ConnectionError):
     """An injected lost frame (transport-shaped, hence retryable)."""
+
+
+class FaultCrash(Exception):
+    """A simulated power loss at a durable-storage site.
+
+    The instrumented store reacts before propagating: it leaves the
+    file exactly as a mid-write power cut would — ``fraction`` of the
+    in-flight bytes durable (``mode="torn"``), or all of them with one
+    bit-rotted byte (``mode="corrupt"``) — marks itself dead (no
+    further writes may land: the process "died"), and latches the
+    owning plan's crash scope so every other storage site the fired
+    rule covers refuses writes too until a CrashHarness reboot resets
+    it (an unscoped rule covers the whole process; a ``method`` path
+    prefix confines the blast radius to one server's data_dir).  Both
+    knobs are drawn from the plan's seeded RNG, so one seed replays
+    one exact torn-byte layout.
+    """
+
+    def __init__(self, site: str, fraction: float, mode: str) -> None:
+        super().__init__(
+            f"injected crash at {site} (mode={mode}, "
+            f"fraction={fraction:.3f})")
+        self.site = site
+        self.fraction = fraction
+        self.mode = mode
+
+    def torn_length(self, total: int) -> int:
+        """How many of ``total`` in-flight bytes the power cut left
+        durable."""
+        return max(0, min(total, int(self.fraction * (total + 1))))
 
 
 class FaultSpecError(ValueError):
@@ -140,6 +192,11 @@ class FaultRule:
                 f"{', '.join(ACTIONS)}")
         if not 0.0 <= p <= 1.0:
             raise FaultSpecError(f"probability {p!r} outside [0, 1]")
+        if action == "crash" and site not in STORAGE_SITES:
+            raise FaultSpecError(
+                f"action 'crash' is only valid at the storage sites "
+                f"({', '.join(STORAGE_SITES)}); site {site!r} has no "
+                f"bytes in flight to tear")
         supplied = SITE_CONTEXT[site]
         for key, value in (("method", method), ("node", node)):
             if value is not None and key not in supplied:
@@ -197,6 +254,15 @@ class FaultPlan:
         self.seed = seed
         self._rules: dict = {}             # site -> [FaultRule]; guarded
         self.fires: list = []              # injections done; guarded
+        # Power-loss latch (guarded by _lock): each crash fire records
+        # the fired rule's path scope (its ``method`` prefix, or "" =
+        # everything when the rule was unscoped); while any scope is
+        # latched, storage sites whose path falls inside it refuse
+        # writes — the "dead process" writes nothing anywhere, but a
+        # rule aimed at ONE server's data_dir only freezes THAT
+        # server's stores.  CrashHarness.reboot() resets it for the
+        # reborn process.
+        self._crash_scopes: list = []
 
     def add(self, site: str, action: str, **kw) -> "FaultPlan":
         rule = FaultRule(site, action, **kw)
@@ -223,6 +289,22 @@ class FaultPlan:
             return all(r.count is not None and r.fired >= r.count
                        for r in rules) if rules else True
 
+    def is_crashed(self, path: Optional[str] = None) -> bool:
+        """Whether the power-loss latch covers ``path`` (a store's
+        on-disk location).  Without ``path``, any latched scope counts
+        — callers that can't say where they write must assume the
+        dead process is theirs."""
+        with self._lock:
+            return any(path is None or scope == ""
+                       or path.startswith(scope)
+                       for scope in self._crash_scopes)
+
+    def reset_crashed(self) -> None:
+        """A CrashHarness reboot: the dead process is gone, the reborn
+        one's stores may write again."""
+        with self._lock:
+            del self._crash_scopes[:]
+
     # -- consultation ------------------------------------------------------
     def fire(self, site: str, method: Optional[str] = None,
              node: Optional[str] = None) -> None:
@@ -247,6 +329,18 @@ class FaultPlan:
                 rule.fired += 1
                 if len(self.fires) < self.FIRES_CAP:
                     self.fires.append((site, rule.action, method, node))
+                if rule.action == "crash":
+                    # Seeded power loss: how much of the in-flight
+                    # write survives, and whether the tail is torn or
+                    # bit-rotted, are a function of (seed, order).
+                    # The latch inherits the rule's path scope: a
+                    # method=/data/s1* rule kills only s1's stores.
+                    self._crash_scopes.append(
+                        rule.method.rstrip("*") if rule.method else "")
+                    mode = "corrupt" if self._rng.random() < 0.25 \
+                        else "torn"
+                    exc = FaultCrash(site, self._rng.random(), mode)
+                    break
                 if rule.action == "error":
                     exc = FaultInjected(
                         f"injected error at {site}"

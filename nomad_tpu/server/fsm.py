@@ -60,6 +60,11 @@ class NomadFSM:
         self.timetable = TimeTable()
         self.eval_broker = eval_broker
         self.on_apply = on_apply  # hook: (index, msg_type, payload)
+        # Raw-entry hook: (index, entry_bytes) BEFORE decode/apply.
+        # The crash-recovery proofs record the applied history through
+        # it and byte-compare a rebooted store against a replay of the
+        # recorded committed prefix (tests/test_crash_recovery.py).
+        self.on_entry: Optional[Callable] = None
         self._handlers = {
             NODE_REGISTER_REQUEST: self._apply_node_register,
             NODE_DEREGISTER_REQUEST: self._apply_node_deregister,
@@ -76,6 +81,8 @@ class NomadFSM:
 
     # -- apply ------------------------------------------------------------
     def apply(self, index: int, entry: bytes):
+        if self.on_entry is not None:
+            self.on_entry(index, bytes(entry))
         msg_type, payload, ignorable = codec.decode(entry)
         self.timetable.witness(index, time.time())
         handler = self._handlers.get(msg_type)

@@ -289,10 +289,17 @@ class DispatchPool:
                     "dispatched": self.dispatched,
                     "rejected": self.rejected}
 
-    def shutdown(self, timeout: float = 2.0) -> None:
+    def sever(self) -> None:
+        """Crash path (Server.abandon): signal stop, join NOTHING —
+        busy workers die against severed sockets on their own time;
+        the suite-hygiene joins run later via shutdown()."""
         with self._cond:
             self._stopped = True
             self._cond.notify_all()
+
+    def shutdown(self, timeout: float = 2.0) -> None:
+        self.sever()
+        with self._cond:
             temps = list(self._temp_threads)
         for t in self._threads + temps:
             if t is not threading.current_thread():
@@ -392,6 +399,27 @@ class EdgeLoop:
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=self.name)
         self._thread.start()
+
+    def sever(self) -> None:
+        """Crash path (Server.abandon): stop the loop and sever every
+        socket immediately — peers see resets mid-frame even before
+        the loop thread is next scheduled — joining NOTHING.  Uses
+        socket.shutdown (not close) so no fd is reused under the
+        still-running selector; the loop's own _teardown closes the
+        fds on its way out."""
+        self._stop.set()
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        with self._conns_lock:
+            conns = list(self._conns.values())
+        for conn in conns:
+            try:
+                conn.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        self.wake()
 
     def shutdown(self, timeout: float = 2.0) -> None:
         self._stop.set()

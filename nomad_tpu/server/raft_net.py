@@ -22,7 +22,6 @@ nomad/leader.go:16-50 monitorLeadership).
 """
 from __future__ import annotations
 
-import json
 import logging
 import os
 import queue
@@ -39,7 +38,9 @@ from nomad_tpu.utils.sync import Immutable
 
 from .raft import (
     ApplyFuture,
+    CommittedDataLoss,
     FileLogStore,
+    MetaStore,
     SnapshotStore,
     resolve_snapshot_dir,
     unwrap_snapshot,
@@ -148,12 +149,12 @@ class NetRaft:
         # Durability (term/vote + snapshots + log), reloaded on boot.
         # All three handles are bound during construction and never
         # rebound; shutdown only calls the log store's idempotent close.
-        self._meta_path: Immutable = None
+        self._meta: Immutable = None
         self._log_store: Immutable = None
         self._snap_store: Immutable = None
         if data_dir:
             os.makedirs(f"{data_dir}/raft", exist_ok=True)
-            self._meta_path = f"{data_dir}/raft/meta.json"
+            self._meta = MetaStore(f"{data_dir}/raft/meta.json")
             self._load_meta()
             self._snap_store = SnapshotStore(resolve_snapshot_dir(data_dir))
             latest = self._snap_store.latest()
@@ -182,6 +183,12 @@ class NetRaft:
                     # from here): drop the stale suffix, last writer wins.
                     cut = index - self._log_base_index - 1
                     self._log = self._log[:cut]
+                if index > self._last_index() + 1:
+                    raise CommittedDataLoss(
+                        f"raft log for {data_dir}: committed entries "
+                        f"{self._last_index() + 1}..{index - 1} are "
+                        "missing between the snapshot restore point "
+                        "and the compacted log; refusing to boot")
                 if index == self._last_index() + 1:
                     self._log.append({"term": term, "index": index,
                                       "data": data})
@@ -217,28 +224,24 @@ class NetRaft:
 
     # -- persistence -------------------------------------------------------
     def _load_meta(self) -> None:
-        try:
-            with open(self._meta_path) as fh:
-                meta = json.load(fh)
+        meta = self._meta.load()
+        if meta is not None:
             self._term = meta.get("term", 0)
             voted = meta.get("voted_for")
             self._voted_for = tuple(voted) if voted else None
-        except FileNotFoundError:
-            pass
 
     def _save_meta(self) -> None:
-        if self._meta_path is None:
+        if self._meta is None:
             return
-        tmp = self._meta_path + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump({"term": self._term,
-                       "voted_for": list(self._voted_for)
-                       if self._voted_for else None}, fh)
-            fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp, self._meta_path)
+        self._meta.save({"term": self._term,
+                         "voted_for": list(self._voted_for)
+                         if self._voted_for else None})
 
     def _persist_entry(self, entry: dict) -> None:
+        """Durable append.  Raft discipline: callers persist BEFORE the
+        in-memory log moves, so a failed (or crashed) write leaves
+        memory and disk agreeing and the in-memory log can never run
+        ahead of what a reboot would replay."""
         if self._log_store is not None:
             self._log_store.append(entry["index"],
                                    {"t": entry["term"], "d": entry["data"]})
@@ -321,8 +324,14 @@ class NetRaft:
                 return future
             index = self._last_index() + 1
             record = {"term": self._term, "index": index, "data": entry}
+            try:
+                self._persist_entry(record)
+            except Exception as e:
+                # Disk death/crash: reject with NO state moved — the
+                # in-memory log must never run ahead of the durable one.
+                future.respond(0, None, e)
+                return future
             self._log.append(record)
-            self._persist_entry(record)
             self._futures[index] = future
             if not self._peers:
                 self._advance_commit()
@@ -402,17 +411,36 @@ class NetRaft:
                 state = self._state
                 deadline = self._election_deadline
             if state != LEADER and time.monotonic() >= deadline:
-                self._start_election()
+                try:
+                    self._start_election()
+                except Exception:
+                    # A node whose disk died (or crashed) cannot bump
+                    # its term durably and must not become a candidate;
+                    # the ticker survives to keep trying/heartbeating.
+                    logger.exception("election attempt failed")
             time.sleep(0.01)
 
     # -- elections ---------------------------------------------------------
     def _start_election(self) -> None:
         with self._lock:
+            # Candidacy requires a DURABLE term bump + self-vote before
+            # anything moves: an unpersisted term would leak through
+            # reply terms (deposing healthy leaders from a node that
+            # can't even vote durably) and a reboot would reopen the
+            # double-vote window.  On persist failure roll back, re-arm
+            # the timer (so the ticker retries at election cadence, not
+            # every tick), and stay a follower.
+            prev = (self._term, self._voted_for, self._state)
             self._state = CANDIDATE
             self._term += 1
-            term = self._term
             self._voted_for = self.address
-            self._save_meta()
+            try:
+                self._save_meta()
+            except Exception:
+                self._term, self._voted_for, self._state = prev
+                self._reset_election_timer()
+                raise
+            term = self._term
             self._leader = None
             self._reset_election_timer()
             peers = list(self._peers)
@@ -466,8 +494,14 @@ class NetRaft:
         # Commit a no-op so the new leader can advance commit_index
         # (current-term entry requirement).
         record = {"term": self._term, "index": nxt, "data": NOOP_ENTRY}
+        try:
+            self._persist_entry(record)
+        except Exception:
+            # A leader whose disk just died cannot commit anything; it
+            # keeps heartbeating (empty appends) until killed/replaced.
+            logger.exception("no-op persist failed at leadership gain")
+            return
         self._log.append(record)
-        self._persist_entry(record)
         if not self._peers:
             self._advance_commit()
         self._signal_replicators()
@@ -481,7 +515,15 @@ class NetRaft:
         if term > self._term:
             self._term = term
             self._voted_for = None
-            self._save_meta()
+            try:
+                self._save_meta()
+            except Exception:
+                # Memory moves anyway: refusing the observed higher
+                # term would keep deposing the new leader with stale
+                # replies.  Vote safety survives the durable lag
+                # because every GRANT persists (term, vote) and
+                # refuses when it can't (_handle_request_vote).
+                logger.exception("meta persist failed on step-down")
         self._reset_election_timer()
         if was_leader:
             self._notify_queue.put(False)
@@ -583,7 +625,13 @@ class NetRaft:
             future = self._futures.pop(index, None)
             if future is not None:
                 future.respond(index, response, error)
-        self._maybe_compact()
+        try:
+            self._maybe_compact()
+        except Exception:
+            # Compaction failure (disk death, injected crash) must not
+            # fail entries that already committed; the durable log
+            # keeps everything a snapshot would have covered.
+            logger.exception("raft log compaction failed")
 
     def _maybe_compact(self) -> None:
         if self._last_applied - self._log_base_index < \
@@ -626,8 +674,18 @@ class NetRaft:
                 (args["last_log_term"] == self._last_term() and
                  args["last_log_index"] >= self._last_index()))
             if up_to_date and self._voted_for in (None, candidate):
+                prev_vote = self._voted_for
                 self._voted_for = candidate
-                self._save_meta()
+                try:
+                    self._save_meta()
+                except Exception:
+                    # A vote that isn't durable must not be granted: a
+                    # reboot would forget it and could vote for a
+                    # different candidate in the same term (two
+                    # leaders).  Roll back and refuse.
+                    self._voted_for = prev_vote
+                    logger.exception("vote persist failed; refusing")
+                    return {"term": self._term, "granted": False}
                 self._reset_election_timer()
                 return {"term": self._term, "granted": True}
             return {"term": self._term, "granted": False}
@@ -664,8 +722,17 @@ class NetRaft:
                 if existing is None and e["index"] == \
                         self._last_index() + 1:
                     record = dict(e)
+                    try:
+                        self._persist_entry(record)
+                    except Exception:
+                        # A follower whose disk died must not ack
+                        # entries it cannot make durable (its match
+                        # index would count toward commitment).
+                        logger.exception(
+                            "follower persist failed at index %d",
+                            e["index"])
+                        return {"term": self._term, "success": False}
                     self._log.append(record)
-                    self._persist_entry(record)
 
             leader_commit = args.get("leader_commit", 0)
             if leader_commit > self._commit_index:
@@ -685,23 +752,38 @@ class NetRaft:
             index = args["last_included_index"]
             if index <= self._last_applied:
                 return {"term": self._term}
-            self.fsm.restore(bytes(args["data"]))
+            data = bytes(args["data"])
+            # Persist BEFORE any memory moves (the same discipline as
+            # every other persist site here): a follower that cannot
+            # make the installed snapshot durable must refuse it
+            # wholesale — advancing fsm/commit state it would not
+            # replay after a reboot is the one unrecoverable shape.
+            if self._snap_store is not None:
+                try:
+                    self._snap_store.save(
+                        index,
+                        msgpack.packb((args["last_included_term"], data),
+                                      use_bin_type=True))
+                except Exception:
+                    logger.exception(
+                        "snapshot install persist failed at index %d; "
+                        "refusing the install (leader retries)", index)
+                    return {"term": self._term}
+            self.fsm.restore(data)
             self._log = []
             self._log_base_index = index
             self._log_base_term = args["last_included_term"]
             self._commit_index = index
             self._last_applied = index
-            # Durably replace the local history: the pre-snapshot log is
-            # now incompatible with the installed state.
-            if self._snap_store is not None:
-                self._snap_store.save(
-                    index,
-                    msgpack.packb((args["last_included_term"],
-                                   bytes(args["data"])),
-                                  use_bin_type=True))
             if self._log_store is not None:
-                self._log_store.truncate()
-            self._snap_blob = bytes(args["data"])
+                try:
+                    self._log_store.truncate()
+                except Exception:
+                    # Contained: the snapshot IS durable and boot
+                    # replay skips the stale pre-snapshot entries.
+                    logger.exception(
+                        "log truncate after snapshot install failed")
+            self._snap_blob = data
             self._snap_index = index
             self._snap_term = args["last_included_term"]
             return {"term": self._term}

@@ -247,12 +247,16 @@ class RPCServer:
         listener.setblocking(False)
         self.address = listener.getsockname()  # (host, port)
 
+        # Port-qualified thread names: in multi-server processes
+        # (tests, benches, crash soaks) every serving thread is
+        # attributable to its server, and a census of ONE server's
+        # threads can't count another's (or a dead husk's).
         self._pool = DispatchPool(dispatch_workers, dispatch_queue,
-                                  name="rpc-dispatch")
+                                  name=f"rpc-dispatch:{self.address[1]}")
         self._loop = EdgeLoop(listener, self, max_conns=max_conns,
                               idle_timeout=idle_timeout,
                               read_deadline=read_deadline,
-                              name="rpc-loop")
+                              name=f"rpc-loop:{self.address[1]}")
         self._thread: Optional[threading.Thread] = None
 
     # -- registration -----------------------------------------------------
@@ -276,6 +280,28 @@ class RPCServer:
         self._pool.start()
         self._loop.start()
         self._thread = self._loop._thread
+
+    def sever(self) -> None:
+        """Crash-simulation teardown (Server.abandon): signal stop and
+        sever every socket the way a dead process's OS would, joining
+        NOTHING — in-flight handlers die against reset sockets on
+        their own time.  The suite-hygiene joins happen later when
+        CrashHarness.reap() runs the graceful shutdown()."""
+        self._loop.sever()
+        self._pool.sever()
+        with self._lock:
+            sinks = list(self._tls_sinks)
+            socks = list(self._handoff_socks)
+        for sink in sinks:
+            try:
+                sink.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        for sock in socks:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
 
     def shutdown(self) -> None:
         # Loop teardown severs every client socket (parked waiters

@@ -448,6 +448,48 @@ class Server:
     def is_leader(self) -> bool:
         return self._leader
 
+    def abandon(self) -> None:
+        """Crash simulation (faultinject/crash.py CrashHarness): drop
+        the server WITHOUT graceful teardown.  Storage must already be
+        frozen (``freeze_storage``) — this only models the OS reaping a
+        dead process: stop events are signalled so daemon threads wind
+        down on their own, listener and client sockets are severed
+        mid-frame, and nothing is flushed, snapshotted, persisted, or
+        responded.  The data_dir stays byte-exact as the crash left it.
+        ``CrashHarness.reap()`` does the suite-hygiene joins later."""
+        self._shutdown.set()
+        self._leader = False
+        for w in self.workers:
+            w.stop()
+        # Pop workers/pollers out of their blocking waits; in-memory
+        # only — the broker and plan queue of a dead process are gone
+        # anyway, and nothing here answers a client.
+        self.eval_broker.set_enabled(False)
+        self.plan_queue.set_enabled(False)
+        # Raft loops: signal, never join, never close the log store
+        # (a close is a graceful act; the store is already frozen).
+        stop = getattr(self.raft, "_stop", None)
+        if stop is not None:
+            stop.set()
+        for repl in list(getattr(self.raft, "_replicators", {}).values()):
+            repl.stop.set()
+            repl.wake.set()
+        notify_q = getattr(self.raft, "_notify_queue", None)
+        if notify_q is not None:
+            notify_q.put(None)
+        # Sever the network edge the way a dead process's OS would:
+        # every socket drops mid-frame; peers and clients see resets.
+        # NOT shutdown() — that joins the loop and dispatch workers and
+        # drains in-flight handlers, which is a graceful act; reap()
+        # runs the real shutdown() for suite hygiene later.
+        if self.rpc_server is not None:
+            self.rpc_server.sever()
+        self.conn_pool.shutdown()
+        self.raft_pool.shutdown()
+        gossip_stop = getattr(self.gossip, "_stop", None)
+        if gossip_stop is not None and hasattr(gossip_stop, "set"):
+            gossip_stop.set()  # no leave broadcast: crashes don't say bye
+
     def shutdown(self) -> None:
         self._shutdown.set()
         for w in self.workers:
@@ -499,7 +541,16 @@ class Server:
             updated.status_description = (
                 "evaluation reached delivery limit "
                 f"({self.config.eval_delivery_limit})")
-            self.apply_eval_update([updated], token)
+            try:
+                self.apply_eval_update([updated], token)
+            except Exception:
+                # A failed apply (no leader mid-transition, dead/
+                # crashed storage) must not kill the reaper thread:
+                # skip the ack so the eval redelivers and the next
+                # pass retries.
+                logger.warning("failed-eval reap could not commit; "
+                               "will retry", exc_info=True)
+                continue
             try:
                 self.eval_broker.ack(ev.id, token)
             except ValueError:

@@ -391,6 +391,47 @@ class _ReadMixin:
     def latest_index(self) -> int:
         return max(self._t.indexes.values(), default=0)
 
+    # -- identity ---------------------------------------------------------
+    def fingerprint(self, changelog_since: int = 0) -> str:
+        """Canonical digest of the full store: every table's objects
+        (sorted by id), the per-table raft indexes, and the alloc
+        changelog above ``changelog_since``.
+
+        Two stores that evolved through the same committed write
+        sequence digest identically; any divergence — a lost committed
+        write, a duplicated alloc, a drifted index — differs here.
+        The crash-recovery proofs byte-compare a rebooted store
+        against a replay of the recorded committed prefix with it
+        (``changelog_since`` skips entries a snapshot restore
+        legitimately compacted away: a restored store's changelog
+        starts empty)."""
+        import hashlib
+
+        import msgpack
+
+        t = self._t
+        # The changelog list object is shared across generations
+        # (append-only, see _Tables.alloc_log); bound it by this
+        # view's own allocs index so entries appended AFTER the view
+        # was taken never leak into its digest.
+        upto = t.indexes.get("allocs", 0)
+        payload = {
+            "indexes": {name: t.indexes.get(name, 0) for name in TABLES},
+            "tables": {
+                name: sorted(
+                    (obj.to_dict() for obj in t.tables[name].values()),
+                    key=lambda d: d.get("id", ""))
+                for name in TABLES
+            },
+            "changelog": [
+                (index, sorted(ids))
+                for index, ids in t.alloc_log
+                if changelog_since < index <= upto
+            ],
+        }
+        return hashlib.sha256(
+            msgpack.packb(payload, use_bin_type=True)).hexdigest()
+
 
 class StateSnapshot(_ReadMixin):
     """A frozen point-in-time view of the store (O(1) to create)."""
@@ -439,6 +480,13 @@ class StateStore(_ReadMixin):
             self._idx_shared = {"allocs_by_node", "allocs_by_job",
                                 "allocs_by_eval", "evals_by_job"}
             return StateSnapshot(self._t)
+
+    def fingerprint(self, changelog_since: int = 0) -> str:
+        # A live store digests a frozen generation: concurrent raft
+        # applies (a follower catching up while a soak compares
+        # replicas) must not mutate tables mid-iteration or tear the
+        # view.
+        return self.snapshot().fingerprint(changelog_since)
 
     def restore(self) -> "StateRestore":
         """Bulk-load rig used by FSM snapshot restore: stage into a fresh

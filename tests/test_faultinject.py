@@ -7,6 +7,7 @@ retry regressions the subsystem was built to catch.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 
@@ -84,10 +85,10 @@ class TestSpecAndRegistry:
 
     def test_serving_plane_sites_registered(self):
         """ISSUE 7 satellite: the edge chokepoints are first-class
-        sites with the right predicate contexts (12-site table)."""
+        sites with the right predicate contexts."""
         from nomad_tpu.faultinject.plan import SITE_CONTEXT, SITES
 
-        assert len(SITES) == 12
+        assert len(SITES) == 16
         for site in ("mux.accept", "conn.read", "watch.deliver"):
             assert site in SITES
         assert SITE_CONTEXT["mux.accept"] == ()
@@ -99,6 +100,60 @@ class TestSpecAndRegistry:
             "watch.deliver=drop(method=allocs)")
         rules = {r.site: r for r in plan.rules()}
         assert rules["watch.deliver"].method == "allocs"
+
+    def test_storage_sites_registered(self):
+        """ISSUE 8 satellite: the durable-storage chokepoints are
+        first-class sites (16-site table) with path predicates, and
+        the ``crash`` action is storage-only."""
+        from nomad_tpu.faultinject.plan import (
+            SITE_CONTEXT,
+            SITES,
+            STORAGE_SITES,
+        )
+
+        assert STORAGE_SITES == ("log.append", "log.fsync",
+                                 "snapshot.persist", "meta.persist")
+        for site in STORAGE_SITES:
+            assert site in SITES
+            # Stores pass their on-disk path as ``method`` so one
+            # server's data_dir is targetable in a cluster soak.
+            assert SITE_CONTEXT[site] == ("method",)
+        plan = FaultPlan.parse(
+            "seed=3;log.append=crash(count=1,after=2);"
+            "snapshot.persist=crash(method=/tmp/cluster/s1*)")
+        rules = {r.site: r for r in plan.rules()}
+        assert rules["log.append"].action == "crash"
+        assert rules["snapshot.persist"].method == "/tmp/cluster/s1*"
+        # Non-crash actions remain legal at storage sites (a plain
+        # slow disk is delay/error, not power loss).
+        FaultPlan.parse("log.fsync=delay(secs=0.01);meta.persist=error")
+
+    def test_crash_is_seeded_and_latches(self, tmp_path):
+        """The crash action draws its torn-byte layout from the plan's
+        seeded RNG (same seed = same bytes) and latches the plan so
+        every storage site refuses writes until reset."""
+        from nomad_tpu.faultinject import FaultCrash
+        from nomad_tpu.server.raft import FileLogStore, StorageDead
+
+        def torn_size(seed: int) -> int:
+            path = str(tmp_path / f"log-{seed}.bin")
+            store = FileLogStore(path)
+            plan = FaultPlan(seed=seed).add("log.append", "crash",
+                                            count=1)
+            with faultinject.injected(plan):
+                with pytest.raises(FaultCrash):
+                    store.append(1, b"payload-payload-payload")
+                assert plan.is_crashed()
+                assert faultinject.crashed()
+                with pytest.raises(StorageDead):
+                    store.append(2, b"more")
+            assert not faultinject.crashed()  # plan uninstalled
+            store.close()
+            return os.path.getsize(path)
+
+        assert torn_size(42) == torn_size(42)  # deterministic replay
+        sizes = {torn_size(s) for s in (1, 2, 3, 4, 5)}
+        assert len(sizes) > 1  # the offset really is seed-drawn
 
     @pytest.mark.parametrize("bad", [
         "nope.site=error",               # unknown site
@@ -116,6 +171,9 @@ class TestSpecAndRegistry:
         "mux.accept=error(method=X)",    # edge accept has no request ctx
         "conn.read=drop(node=n-1)",      # bytes have no node identity
         "watch.deliver=drop(node=n-1)",  # fan-out passes table as method
+        "rpc.send=crash",                # crash only at storage sites
+        "raft.apply=crash(count=1)",     # ditto: no bytes in flight
+        "log.append=crash(node=n-1)",    # stores pass path as method
     ])
     def test_parse_rejects_malformed(self, bad):
         with pytest.raises(FaultSpecError):

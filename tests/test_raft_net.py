@@ -156,11 +156,21 @@ def test_stale_reads_serve_locally_on_follower(pool):
 
 
 def test_leader_failover():
+    from nomad_tpu.structs import Evaluation, generate_uuid
+
     servers = make_cluster(3)
     try:
         leader = wait_for_leader(servers)
         node = mock.node()
         leader.node_register(node)
+        # A committed pending eval of a scheduler type no worker
+        # consumes: it must survive the failover INSIDE the new
+        # leader's broker (leadership-restore re-enqueue), not just in
+        # state.
+        parked_eval = Evaluation(
+            id=generate_uuid(), priority=50, type="exotic",
+            triggered_by="test", job_id="parked-job", status="pending")
+        leader.apply_eval_update([parked_eval])
 
         # Kill the leader: remaining two must elect a new one.
         survivors = [s for s in servers if s is not leader]
@@ -177,6 +187,18 @@ def test_leader_failover():
         wait_until(
             lambda: new_leader.fsm.state.node_by_id(node.id) is not None,
             msg="committed entry visible on new leader")
+        # ISSUE 8 satellite: post-failover leader bring-up actually
+        # repopulates the leader-only machinery on the NEW leader —
+        # HeartbeatManager.initialize re-arms every live node at the
+        # failover TTL, and the broker restore re-enqueues the
+        # committed pending eval.
+        wait_until(lambda: new_leader.heartbeats.active() >= 1,
+                   msg="heartbeat timers re-armed on new leader")
+        wait_until(
+            lambda: any(e.id == parked_eval.id
+                        for q in new_leader.eval_broker._ready.values()
+                        for *_prio, e in q._heap),
+            msg="pending eval restored into new leader's broker")
         # And the new leader can make progress.
         node2 = mock.node(2)
         new_leader.node_register(node2)

@@ -37,9 +37,19 @@ from tests.conftest import wait_until
 SERVING_THREAD_PREFIXES = ("rpc-loop", "rpc-dispatch")
 
 
-def _serving_threads() -> list:
+def _serving_threads(port=None) -> list:
+    """Serving-plane thread census; pass a server's rpc port to count
+    ONLY that server's threads (names are port-qualified, so husks
+    abandoned by the crash-recovery soaks can't pollute a census)."""
+    if port is None:
+        return [t.name for t in threading.enumerate()
+                if t.name.startswith(SERVING_THREAD_PREFIXES)]
+    # Exact loop name / dispatch prefix WITH the "-" separator: a bare
+    # f"rpc-dispatch:{port}" prefix would also match a server whose
+    # port has this one as a decimal prefix (4646 vs 46460).
     return [t.name for t in threading.enumerate()
-            if t.name.startswith(SERVING_THREAD_PREFIXES)]
+            if t.name == f"rpc-loop:{port}"
+            or t.name.startswith(f"rpc-dispatch:{port}-")]
 
 
 # ---------------------------------------------------------------------------
@@ -539,7 +549,8 @@ class TestServingPlane:
     def test_thread_count_is_o_pool_not_o_clients(self, srv):
         """30 connected clients: the serving plane still runs exactly
         one loop thread + the configured dispatch workers."""
-        before = _serving_threads()
+        port = srv.rpc_address()[1]
+        before = _serving_threads(port)
         workers = srv.config.rpc_dispatch_workers
         assert len(before) == workers + 1
         conns = [MuxConn(tuple(srv.rpc_address())) for _ in range(30)]
@@ -549,7 +560,7 @@ class TestServingPlane:
             wait_until(
                 lambda: srv.rpc_server._loop.open_conns() >= 30,
                 msg="30 clients connected")
-            assert _serving_threads() == before  # not one thread more
+            assert _serving_threads(port) == before  # not one more
         finally:
             for c in conns:
                 c.close()

@@ -285,6 +285,49 @@ class TestLintGate:
                        for e in allow), \
             "the retired rpc.py waivers must stay retired"
 
+    def test_crash_recovery_paths_ride_the_gates(self):
+        """ISSUE 8 satellite: the durability & crash-recovery plane —
+        CRC-framed FileLogStore (tail-scan, power-loss simulation),
+        checksummed SnapshotStore, MetaStore, and the CrashHarness —
+        is inside every gate's scan set, strict-clean, with zero
+        allowlist entries of its own."""
+        from nomad_tpu.analysis import (default_package_root,
+                                        load_allowlist)
+        from nomad_tpu.analysis.callgraph import CallGraph
+
+        pkg = default_package_root()
+        graph = CallGraph.build(pkg)
+        for qual in (
+            "nomad_tpu.server.raft:FileLogStore.append",
+            "nomad_tpu.server.raft:FileLogStore._scan_and_recover",
+            "nomad_tpu.server.raft:FileLogStore._power_loss",
+            "nomad_tpu.server.raft:FileLogStore._recover_tail",
+            "nomad_tpu.server.raft:SnapshotStore.save",
+            "nomad_tpu.server.raft:SnapshotStore._read_verified",
+            "nomad_tpu.server.raft:MetaStore.save",
+            "nomad_tpu.faultinject.crash:CrashHarness.kill",
+            "nomad_tpu.faultinject.crash:CrashHarness.reboot",
+            "nomad_tpu.faultinject.crash:freeze_storage",
+            "nomad_tpu.server.server:Server.abandon",
+            "nomad_tpu.state.store:_ReadMixin.fingerprint",
+        ):
+            assert qual in graph.functions, \
+                f"{qual} missing from the interprocedural graph"
+
+        allowlist = load_allowlist(default_allowlist_path())
+        gating, _allowed, _stale = partition_findings(
+            run_lint(strict=True), allowlist)
+        touching = [f for f in gating
+                    if "server/raft" in f.path
+                    or "faultinject/crash" in f.path]
+        assert touching == [], \
+            "crash-recovery plane must lint clean:\n" + \
+            "\n".join(f.render() for f in touching)
+        assert not any("faultinject/crash" in e or "_power_loss" in e
+                       or "_scan_and_recover" in e or "MetaStore" in e
+                       for e in allowlist), \
+            "crash-recovery plane must not need allowlist entries"
+
     def test_fixed_sleep_ratchet_is_clean(self):
         """Every fixed time.sleep in the test tree is either converted
         to wait_until or carries a '# sleep-ok: why' justification —
