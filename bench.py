@@ -186,9 +186,15 @@ def bench_pipelined_stream(h, jobs, depth: int = 6, repeats: int = 1):
 
 
 def bench_single_eval(h, job, scheduler: str, repeats: int):
-    """Best-of-N single-eval latency; returns (seconds, placed)."""
+    """Best-of-N single-eval latency; returns (seconds, placed).
+
+    One untimed warm eval first — the same cache-warm discipline the
+    stream rows apply (prep/jit caches are per job version x fleet
+    generation; the steady-state latency is the one the bar tracks,
+    not the one-off cold-cache build)."""
     recorder = _RecordOnlyPlanner()
     h.planner = recorder
+    h.process(scheduler, make_eval(job))  # warm
     best = float("inf")
     placed = 0
     for _ in range(repeats):
@@ -794,6 +800,180 @@ def bench_overload_brownout(n_agents: int, window_s: float,
         srv.shutdown()
 
 
+def bench_applier_saturation(n_submitters: int, submits_per: int,
+                             note) -> dict:
+    """Config 5f: the group-commit applier under submitter saturation
+    (ROADMAP item 2's bench half, on the columnar alloc contract).
+
+    A real leader commit pipeline — PlanQueue -> PlanApplier window
+    verify (ops/plan_conflict) -> ONE raft apply per window carrying
+    columnar slab references -> FSM batch decode -> batched store
+    upsert — driven by hundreds of concurrent submitter threads, each
+    running the worker protocol (broker enqueue/dequeue/token fence,
+    plan submit, future wait, ack).  Reports commits/sec, window
+    occupancy (plans per raft apply), and p50/p99 submit->respond
+    latency; asserts exactly-once placement and that group commit
+    actually amortized the serialized section (occupancy > 2).
+    """
+    import random
+    import threading
+
+    import numpy as np
+
+    from nomad_tpu.server.eval_broker import EvalBroker
+    from nomad_tpu.server.fsm import NomadFSM
+    from nomad_tpu.server.plan_apply import PlanApplier
+    from nomad_tpu.server.plan_queue import PlanQueue
+    from nomad_tpu.server.raft import InmemRaft
+    from nomad_tpu.structs import AllocMetric, Evaluation, Plan, codec
+    from nomad_tpu.structs.alloc_slab import AllocSlab
+    from nomad_tpu.structs.model import proto_of
+
+    broker = EvalBroker(nack_timeout=120.0)
+    fsm = NomadFSM(eval_broker=broker)
+    raft = InmemRaft(fsm)
+    queue = PlanQueue()
+    applier = PlanApplier(queue, broker, raft,
+                          state_fn=lambda: fsm.state, max_window=64)
+    broker.set_enabled(True)
+    queue.set_enabled(True)
+    applier.start()
+
+    n_nodes = 512
+    for i in range(n_nodes):
+        raft.apply(codec.encode(
+            codec.NODE_REGISTER_REQUEST,
+            {"node": mock.node(i).to_dict()})).wait()
+    node_ids = [n.id for n in fsm.state.nodes()]
+
+    # One tiny job template per submitter: 1 TG, 1 netless task with a
+    # 1-cpu ask so the whole storm fits the fleet (the row measures the
+    # commit section, not rejection churn).
+    metric_static, _ = proto_of(AllocMetric)
+    jobs = []
+    for k in range(n_submitters):
+        job = mock.job()
+        job.constraints = []
+        job.task_groups = [TaskGroup(
+            name="tg", count=1,
+            tasks=[Task(name="web", driver="exec",
+                        resources=Resources(cpu=1, memory_mb=1))])]
+        jobs.append(job)
+
+    def mk_plan(ev, token, job, node_id) -> Plan:
+        """One placement as a 1-row AllocSlab — the columnar contract
+        the schedulers emit, end-to-end through verify/wire/store."""
+        size = Resources(cpu=1, memory_mb=1)
+        slots = [(size, [("web", {"cpu": 1, "memory_mb": 1,
+                                  "disk_mb": 0, "iops": 0}, None)])]
+        slab = AllocSlab(
+            eval_id=ev.id, job=job, slots=slots,
+            metric_proto=dict(metric_static, nodes_evaluated=n_nodes),
+            groups=[0], ids=[generate_uuid()],
+            names=[f"{job.id}.tg[0]"], tgs=["tg"], scores=[1.0],
+            port_off=np.zeros(2, dtype=np.int64), n_rows=1)
+        slab.node_ids[0] = node_id
+        slab.ips[0] = ""
+        slab.devs[0] = ""
+        slab.seal(1)
+        plan = Plan(eval_id=ev.id, eval_token=token,
+                    priority=ev.priority)
+        plan.node_allocation[node_id] = [slab.alloc(0)]
+        return plan
+
+    total = n_submitters * submits_per
+    lats: list = [None] * total
+    errors: list = []
+    start_gate = threading.Event()
+
+    def submitter(k: int) -> None:
+        rng = random.Random(7000 + k)
+        start_gate.wait()
+        for i in range(submits_per):
+            try:
+                # Fresh job_id per eval keeps the broker's per-job
+                # serialization out of the measurement (the row is
+                # about the applier, not broker contention).
+                ev = Evaluation(
+                    id=generate_uuid(), priority=50, type="service",
+                    triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+                    job_id=generate_uuid())
+                broker.enqueue(ev, force=True)
+                got, token = broker.dequeue(["service"], timeout=60)
+                assert got is not None
+                plan = mk_plan(got, token, jobs[k],
+                               node_ids[rng.randrange(n_nodes)])
+                t0 = time.perf_counter()
+                future = queue.enqueue(plan)
+                result = future.wait(120)
+                lats[k * submits_per + i] = time.perf_counter() - t0
+                assert result is not None and \
+                    sum(len(v) for v in
+                        result.node_allocation.values()) == 1, result
+                broker.ack(got.id, token)
+            except Exception as e:  # pragma: no cover - bench guard
+                errors.append(repr(e))
+                return
+
+    threads = [threading.Thread(target=submitter, args=(k,),
+                                daemon=True, name=f"bench-5f-{k}")
+               for k in range(n_submitters)]
+    for t in threads:
+        t.start()
+    t0 = time.perf_counter()
+    start_gate.set()
+    for t in threads:
+        t.join(600.0)
+    wall = time.perf_counter() - t0
+    assert not errors, errors[:3]
+    assert all(not t.is_alive() for t in threads), "stuck submitter"
+
+    stats = applier.stats()
+    queue.set_enabled(False)
+    broker.set_enabled(False)
+    applier.join(10.0)
+
+    placed = len([a for a in fsm.state.allocs()
+                  if a.node_id and not a.terminal_status()])
+    # Exactly-once and fully committed: every submission landed one
+    # alloc, and group commit genuinely amortized the serialized
+    # section (more than two plans per raft apply at saturation).
+    assert placed == total, (placed, total)
+    assert stats["plans_committed"] == total, stats
+    assert stats["batch_occupancy"] > 2.0, stats
+    done_lats = [v for v in lats if v is not None]
+    row = {
+        "submitters": n_submitters,
+        "submissions": total,
+        "placed": placed,
+        "window_s": round(wall, 3),
+        "plans_per_sec": round(total / wall, 1),
+        "commits": stats["commits"],
+        "commits_per_sec": round(stats["commits"] / wall, 1),
+        "batch_occupancy": round(stats["batch_occupancy"], 2),
+        "max_window": 64,
+        "conflict_fallbacks": stats["conflict_fallbacks"],
+        "expired_drops": stats["expired_drops"],
+        "p50_submit_ms": round(_p(done_lats, 50), 2),
+        "p99_submit_ms": round(_p(done_lats, 99), 2),
+        "note": (f"{n_submitters} concurrent submitters through the "
+                 "real leader commit pipeline (broker token fence -> "
+                 "plan queue -> vectorized window verify -> ONE raft "
+                 "apply per window carrying columnar slab references "
+                 "-> FSM batch decode -> batched store upsert); "
+                 "exactly-once placement asserted, occupancy > 2 "
+                 "asserted (group commit amortizes the serialized "
+                 "section)"),
+    }
+    note(f"config5f applier saturation: {n_submitters} submitters x "
+         f"{submits_per} -> {total / wall:.0f} plans/s via "
+         f"{stats['commits'] / wall:.0f} commits/s (occupancy "
+         f"{stats['batch_occupancy']:.1f}, {stats['conflict_fallbacks']}"
+         f" fallbacks), p50 submit {_p(done_lats, 50):.1f}ms / p99 "
+         f"{_p(done_lats, 99):.1f}ms, {placed} placed exactly-once")
+    return row
+
+
 def bench_failover(kills: int, jobs_per_kill: int, note) -> dict:
     """Config 5e: rolling leader-kill failover on a durable 3-server
     NetRaft cluster (the crash-recovery headline).
@@ -1127,6 +1307,10 @@ def main() -> None:
                     help="seconds of 5x offered overload in config 5c")
     ap.add_argument("--failover-kills", type=int, default=6,
                     help="rolling leader kills in config 5e")
+    ap.add_argument("--submitters", type=int, default=256,
+                    help="concurrent submitter threads in config 5f")
+    ap.add_argument("--submits-per", type=int, default=24,
+                    help="plans each 5f submitter pushes")
     ap.add_argument("--depth", type=int, default=6)
     ap.add_argument("--repeats", type=int, default=3)
     ap.add_argument("--quick", action="store_true",
@@ -1235,6 +1419,33 @@ def main() -> None:
     lat_seq, placed_seq = bench_single_eval(h4, jobs4[0], "service",
                                           args.repeats)
     assert placed_dev == placed_seq == args.groups, (placed_dev, placed_seq)
+    # Recorded host-floor decomposition: per-stage wall of one host-
+    # executor eval (scheduler/pipeline.py stage timers).  This profile
+    # IS the `single_eval_ms` bar's baseline — the bar is the sum of
+    # these stages, not a number picked in a vacuum.  Measured HERE,
+    # adjacent to its object-contract twin below and BEFORE the stream
+    # phase heats the shared host — same interleaving discipline as
+    # the stream columns (load drift between measurement windows must
+    # not skew a recorded A/B).  The profile is a min-statistic over a
+    # ~2 ms eval, so extra repeats are near-free and cut the noise
+    # floor.
+    profile_reps = max(args.repeats, 6)
+    stage_ms = single_eval_stage_profile(h4, jobs4[0], profile_reps)
+    # Columnar-contract proof for the headline shape: the SAME eval
+    # through the legacy object contract must place byte-identically
+    # (the slab is a representation change, never a semantic one); the
+    # recorded latency/finish delta is the contract's share of the
+    # host floor.
+    from nomad_tpu.structs import alloc_slab
+    _columnar_was = alloc_slab.COLUMNAR
+    alloc_slab.COLUMNAR = False
+    try:
+        lat_obj, placed_obj = bench_single_eval(
+            h4, jobs4[0], "jax-binpack", args.repeats)
+        stage_obj = single_eval_stage_profile(h4, jobs4[0], profile_reps)
+    finally:
+        alloc_slab.COLUMNAR = _columnar_was
+    assert placed_obj == placed_dev, (placed_obj, placed_dev)
     # Stream throughput: the pipeline hides the round trip behind host
     # work, so evals/sec is bound by per-eval host time, not the RTT.
     # Device/sequential reps interleave so shared-host load drift can't
@@ -1249,11 +1460,6 @@ def main() -> None:
     # fused storm): per-eval compute is far below the RTT.
     kernel_s, est_bytes = device_kernel_stats(h4, jobs4[0])
     per_eval_s = dev_s / len(jobs4)
-    # Recorded host-floor decomposition: per-stage wall of one host-
-    # executor eval (scheduler/pipeline.py stage timers).  This profile
-    # IS the `single_eval_ms` bar's baseline — the bar is the sum of
-    # these stages, not a number picked in a vacuum.
-    stage_ms = single_eval_stage_profile(h4, jobs4[0], args.repeats)
     configs["4_binpack_10kn_x_1ktg"] = {
         "evals_per_sec": round(len(jobs4) / dev_s, 3),
         "seq_evals_per_sec": round(len(jobs4) / seq_s, 3),
@@ -1274,18 +1480,28 @@ def main() -> None:
         "host_executor": True,
         "device_fraction": 0.0,
         "stage_profile_ms": stage_ms,
+        "columnar_contract": True,
+        "placed": placed_dev,
+        "single_eval_object_path_ms": round(lat_obj * 1000.0, 1),
+        "object_stage_profile_ms": stage_obj,
         "bottleneck": ("per-eval host floor, measured per stage "
-                       "(stage_profile_ms): finish = native bulk "
-                       "finish (C alloc construction + port "
-                       "assignment, native/port_alloc.cpp), dispatch = "
-                       "host rounds kernel, begin = memoized "
-                       "reconcile/prep, submit = plan bookkeeping; "
-                       "re-evals pay ~0 prep (memoized per job "
-                       "version x fleet generation) and burst objects "
-                       "are GC-untracked; the executor policy keeps "
-                       "this shape host-side because one remote-TPU "
-                       "round trip (~100ms) exceeds the whole eval — "
-                       "the 4_device_pipelined row shows what the "
+                       "(stage_profile_ms): finish = columnar native "
+                       "finish (ports into the AllocSlab buffer + lazy "
+                       "SlabAllocs, native/port_alloc.cpp "
+                       "bulk_finish_cols), dispatch = host rounds "
+                       "kernel, begin = memoized reconcile/prep, "
+                       "submit = plan bookkeeping; re-evals pay ~0 "
+                       "prep (memoized per job version x fleet "
+                       "generation) and burst objects are GC-"
+                       "untracked; single_eval_object_path_ms / "
+                       "object_stage_profile_ms record the SAME eval "
+                       "through the legacy object contract (placed "
+                       "byte-identical, asserted) — the delta is the "
+                       "object contract's share of the host floor; "
+                       "the executor policy keeps this shape host-side "
+                       "because one remote-TPU round trip (~100ms) "
+                       "exceeds the whole eval — the "
+                       "4_device_pipelined row shows what the "
                        "forced-device pipeline does to the same "
                        "stream; the single_eval_ms bar is re-baselined "
                        "to this recorded profile (README Executor "
@@ -1297,6 +1513,11 @@ def main() -> None:
          f"single-eval {lat_dev * 1000:.0f}ms vs {lat_seq * 1000:.0f}ms "
          f"-> {lat_seq / lat_dev:.1f}x; per-eval host stages (ms): "
          f"{stage_ms}")
+    note(f"config4 columnar contract: single-eval "
+         f"{lat_dev * 1000:.1f}ms (finish {stage_ms.get('finish', 0)}"
+         f"ms) vs object path {lat_obj * 1000:.1f}ms (finish "
+         f"{stage_obj.get('finish', 0)}ms), placed byte-identical "
+         f"({placed_dev})")
     note(f"config4 hardware: one fenced device dispatch of this shape "
          f"costs {kernel_s * 1000:.0f}ms (remote-attach RTT; est HBM "
          f"traffic only {est_bytes / 1e9:.3f}GB after group dedup) vs "
@@ -1519,6 +1740,14 @@ def main() -> None:
          f"group commit: {dev_commits} commits "
          f"({dev_committed / max(1, dev_commits):.1f} plans/commit, "
          f"{dev_fallbacks} conflict fallbacks)")
+
+    # --- config 5f: applier saturation (the group-commit headline) --------
+    # Hundreds of concurrent submitters through the real leader commit
+    # pipeline on the columnar alloc contract: commits/sec, window
+    # occupancy, p99 submit->respond latency; exactly-once asserted.
+    configs["5f_applier_saturation"] = bench_applier_saturation(
+        32 if args.quick else args.submitters,
+        8 if args.quick else args.submits_per, note=note)
 
     # --- config 5e: leader-kill failover (the durability headline) --------
     # Rolling hard leader kills on a durable 3-server NetRaft cluster,

@@ -16,6 +16,7 @@
 
 #include <cstdint>
 #include <random>
+#include <vector>
 
 namespace {
 
@@ -249,6 +250,7 @@ struct Interned {
   PyObject* dunder_dict = nullptr;
   PyObject* proposed_allocs = nullptr;
   PyObject* binpack_suffix = nullptr;
+  PyObject* srow = nullptr;
   bool ok = false;
 };
 
@@ -282,6 +284,7 @@ Interned& interned() {
     s.dunder_dict = PyUnicode_InternFromString("__dict__");
     s.proposed_allocs = PyUnicode_InternFromString("proposed_allocs");
     s.binpack_suffix = PyUnicode_InternFromString(".binpack");
+    s.srow = PyUnicode_InternFromString("_srow");
     s.ok = true;
   }
   return s;
@@ -994,15 +997,309 @@ fail:
   return nullptr;
 }
 
-// bulk_finish_many(items) -> [(n_done, port_lcg, failed_map), ...]
+// ---------------------------------------------------------------------------
+// bulk_finish_cols: the columnar finish loop (the AllocSlab contract).
 //
-// items: list of bulk_finish argument TUPLES (built by
-// scheduler/jax_binpack.build_bulk_args), one per evaluation of a
-// drained pipeline window.  Runs every eval's finish loop in ONE
-// Python->C transition so the staged pipeline (scheduler/pipeline.py)
-// amortizes the native-call setup across the window instead of
-// re-entering the interpreter between evals.  Exactly equivalent to
-// calling bulk_finish per item — same code runs per eval.
+// Same control flow as bulk_finish's generic (coalesce_all=1) happy
+// path — identical per-node network state, identical LCG port stream,
+// identical bail conditions — but instead of constructing the full
+// Allocation object tree per placement it writes the assigned ports
+// into the slab's int32 buffer, fills the slab's node_id/ip/device
+// columns, and emits ONE small lazy SlabAlloc per row (a proto dict
+// copy + five scalar inserts; the heavy fields materialize from the
+// slab only at the client/API edge — nomad_tpu/structs/alloc_slab.py).
+// Bails (returning how far it got) at the first chosen-less placement,
+// complex network topology, or bandwidth divergence, exactly where the
+// object path handed control to the Python tail.
+//
+// bulk_finish_cols(chosen, group_l, uuids, names, tg_names,
+//                  slot_mbits, slot_ndyn, ports_buf,
+//                  nids_out, ips_out, devs_out, lazy_proto, alloc_cls,
+//                  nodes, node_net, net_base, base_fn, allocs_idx, ctx,
+//                  plan_nu, plan_na, port_lcg, min_port, max_port)
+//   -> (n_done, port_lcg)
+// ---------------------------------------------------------------------------
+PyObject* bulk_finish_cols(PyObject*, PyObject* args) {
+  PyObject *chosen, *group_l, *uuids, *names, *tg_names;
+  PyObject *slot_mbits, *slot_ndyn;
+  Py_buffer ports_buf;
+  PyObject *nids_out, *ips_out, *devs_out, *lazy_proto, *alloc_cls;
+  PyObject *nodes, *node_net, *net_base, *base_fn, *allocs_idx, *ctx,
+      *plan_nu, *plan_na;
+  long long lcg;
+  long min_port, max_port;
+  if (!PyArg_ParseTuple(
+          args, "OOOOOOOw*OOOOOOOOOOOOOLll", &chosen, &group_l, &uuids,
+          &names, &tg_names, &slot_mbits, &slot_ndyn, &ports_buf,
+          &nids_out, &ips_out, &devs_out, &lazy_proto, &alloc_cls,
+          &nodes, &node_net, &net_base, &base_fn, &allocs_idx, &ctx,
+          &plan_nu, &plan_na, &lcg, &min_port, &max_port)) {
+    return nullptr;
+  }
+  Interned& I = interned();
+  const long span = max_port - min_port;
+  Py_ssize_t P = PyList_GET_SIZE(chosen);
+  Py_ssize_t n_nodes = PyList_GET_SIZE(nodes);
+  int32_t* pbuf = static_cast<int32_t*>(ports_buf.buf);
+  Py_ssize_t poff = 0;
+  // Per-node caches for this call: st borrowed from node_net (the dict
+  // keeps it alive), node_id owned here — avoids a PyLong key build +
+  // dict probe per placement on the hot path.
+  std::vector<PyObject*> st_of(n_nodes, nullptr);
+  std::vector<PyObject*> nid_of(n_nodes, nullptr);  // owned
+  bool failed = false;
+  Py_ssize_t p = 0;
+  for (; p < P && !failed; p++) {
+    long ch = PyLong_AsLong(PyList_GET_ITEM(chosen, p));
+    if (ch == -1 && PyErr_Occurred()) {
+      failed = true;
+      break;
+    }
+    if (ch < 0 || ch >= n_nodes) break;  // tail owns failures/oddities
+    long g = PyLong_AsLong(PyList_GET_ITEM(group_l, p));
+    long ndyn = PyLong_AsLong(PyList_GET_ITEM(slot_ndyn, g));
+    long total_mbits = PyLong_AsLong(PyList_GET_ITEM(slot_mbits, g));
+    if (PyErr_Occurred()) {
+      failed = true;
+      break;
+    }
+
+    PyObject* st = st_of[ch];
+    PyObject* node_id = nid_of[ch];
+    if (st == nullptr) {
+      // First placement on this node: build the fast per-node network
+      // state exactly like the object path (shared with the Python
+      // tail through node_net).
+      PyObject* node = PyList_GET_ITEM(nodes, ch);
+      node_id = PyObject_GetAttr(node, I.id);
+      if (!node_id) {
+        failed = true;
+        break;
+      }
+      nid_of[ch] = node_id;  // owned for the rest of the call
+      PyObject* ch_key = PyLong_FromLong(ch);
+      if (!ch_key) {
+        failed = true;
+        break;
+      }
+      PyObject* base = nullptr;
+      int rc = node_base(net_base, base_fn, ch_key, node, &base);
+      if (rc < 0) {
+        Py_DECREF(ch_key);
+        failed = true;
+        break;
+      }
+      if (rc == 0) {  // complex topology: Python tail owns it
+        Py_DECREF(ch_key);
+        break;
+      }
+      PyObject* used = PySet_New(PyTuple_GET_ITEM(base, 0));
+      if (!used) {
+        Py_DECREF(ch_key);
+        failed = true;
+        break;
+      }
+      long bw = PyLong_AsLong(PyTuple_GET_ITEM(base, 1));
+      int busy;
+      {
+        PyObject* entry = PyDict_GetItemWithError(allocs_idx, node_id);
+        if (!entry && PyErr_Occurred()) {
+          Py_DECREF(used);
+          Py_DECREF(ch_key);
+          failed = true;
+          break;
+        }
+        busy = entry ? PyObject_IsTrue(entry) : 0;
+      }
+      if (busy == 0) {
+        int c1 = PyDict_Contains(plan_nu, node_id);
+        int c2 = c1 == 0 ? PyDict_Contains(plan_na, node_id) : c1;
+        if (c1 < 0 || c2 < 0) busy = -1;
+        else busy = (c1 > 0 || c2 > 0) ? 1 : 0;
+      }
+      if (busy < 0 ||
+          (busy && walk_proposed(ctx, node_id, used, &bw) < 0)) {
+        Py_DECREF(used);
+        Py_DECREF(ch_key);
+        failed = true;
+        break;
+      }
+      PyObject* bw_obj = PyLong_FromLong(bw);
+      st = bw_obj ? PyList_New(5) : nullptr;
+      if (!st) {
+        Py_XDECREF(bw_obj);
+        Py_DECREF(used);
+        Py_DECREF(ch_key);
+        failed = true;
+        break;
+      }
+      PyList_SET_ITEM(st, 0, used);    // steals
+      PyList_SET_ITEM(st, 1, bw_obj);  // steals
+      PyObject* avail = PyTuple_GET_ITEM(base, 2);
+      Py_INCREF(avail);
+      PyList_SET_ITEM(st, 2, avail);
+      PyObject* ipo = PyTuple_GET_ITEM(base, 3);
+      Py_INCREF(ipo);
+      PyList_SET_ITEM(st, 3, ipo);
+      PyObject* devo = PyTuple_GET_ITEM(base, 4);
+      Py_INCREF(devo);
+      PyList_SET_ITEM(st, 4, devo);
+      gc_untrack(used);
+      gc_untrack(st);
+      int rc2 = PyDict_SetItem(node_net, ch_key, st);
+      Py_DECREF(st);  // node_net holds it now
+      Py_DECREF(ch_key);
+      if (rc2 < 0) {
+        failed = true;
+        break;
+      }
+      st_of[ch] = st;  // borrowed from node_net for this call
+    }
+
+    long bw_used = PyLong_AsLong(PyList_GET_ITEM(st, 1));
+    long bw_avail = PyLong_AsLong(PyList_GET_ITEM(st, 2));
+    if (PyErr_Occurred()) {
+      failed = true;
+      break;
+    }
+    if (bw_used + total_mbits > bw_avail) break;  // divergence: tail
+
+    PyObject* used = PyList_GET_ITEM(st, 0);
+    bool port_fail = false;
+    for (long d = 0; d < ndyn && !port_fail; d++) {
+      lcg = (lcg * 1103515245LL + 12345LL) & 0x3FFFFFFFLL;
+      long port = min_port + (long)(lcg % span);
+      long tries = 0;
+      while (true) {
+        PyObject* po = PyLong_FromLong(port);
+        if (!po) {
+          port_fail = true;
+          break;
+        }
+        int hit = PySet_Contains(used, po);
+        if (hit < 0) {
+          Py_DECREF(po);
+          port_fail = true;
+          break;
+        }
+        if (!hit) {
+          int rc3 = PySet_Add(used, po);
+          Py_DECREF(po);
+          if (rc3 < 0) {
+            port_fail = true;
+            break;
+          }
+          pbuf[poff + d] = (int32_t)port;
+          break;
+        }
+        Py_DECREF(po);
+        port = min_port + (port - min_port + 1) % span;
+        if (++tries > span) {
+          PyErr_SetString(PyExc_RuntimeError,
+                          "dynamic port range exhausted");
+          port_fail = true;
+          break;
+        }
+      }
+    }
+    if (port_fail) {
+      failed = true;
+      break;
+    }
+    poff += ndyn;
+    if (total_mbits) {
+      PyObject* nb = PyLong_FromLong(bw_used + total_mbits);
+      if (!nb || PyList_SetItem(st, 1, nb) < 0) {  // steals nb
+        failed = true;
+        break;
+      }
+    }
+
+    // Slab columns: node id / ip / device for this row.
+    Py_INCREF(node_id);
+    PyObject* ipo = PyList_GET_ITEM(st, 3);
+    Py_INCREF(ipo);
+    PyObject* devo = PyList_GET_ITEM(st, 4);
+    Py_INCREF(devo);
+    if (PyList_SetItem(nids_out, p, node_id) < 0 ||  // steal; replaces None
+        PyList_SetItem(ips_out, p, ipo) < 0 ||
+        PyList_SetItem(devs_out, p, devo) < 0) {
+      failed = true;
+      break;
+    }
+
+    // The lazy alloc: proto copy + five scalar inserts.
+    PyObject* ad = PyDict_Copy(lazy_proto);
+    PyObject* srow = ad ? PyLong_FromSsize_t(p) : nullptr;
+    if (!srow ||
+        PyDict_SetItem(ad, I.id, PyList_GET_ITEM(uuids, p)) < 0 ||
+        PyDict_SetItem(ad, I.name, PyList_GET_ITEM(names, p)) < 0 ||
+        PyDict_SetItem(ad, I.task_group,
+                       PyList_GET_ITEM(tg_names, p)) < 0 ||
+        PyDict_SetItem(ad, I.node_id, node_id) < 0 ||
+        PyDict_SetItem(ad, I.srow, srow) < 0) {
+      Py_XDECREF(srow);
+      Py_XDECREF(ad);
+      failed = true;
+      break;
+    }
+    Py_DECREF(srow);
+    gc_untrack(ad);  // final: SlabAlloc.__dict__ (acyclic: the slab
+    //                  never points back at scheduler-path allocs)
+    PyObject* alloc = make_instance(alloc_cls, ad);
+    Py_DECREF(ad);
+    if (!alloc) {
+      failed = true;
+      break;
+    }
+    gc_untrack(alloc);
+
+    PyObject* lst = PyDict_GetItemWithError(plan_na, node_id);
+    if (!lst) {
+      if (PyErr_Occurred()) {
+        Py_DECREF(alloc);
+        failed = true;
+        break;
+      }
+      lst = PyList_New(0);
+      gc_untrack(lst);  // holds only (untracked) allocs
+      if (!lst || PyDict_SetItem(plan_na, node_id, lst) < 0) {
+        Py_XDECREF(lst);
+        Py_DECREF(alloc);
+        failed = true;
+        break;
+      }
+      Py_DECREF(lst);
+      lst = PyDict_GetItem(plan_na, node_id);
+    }
+    int rc4 = PyList_Append(lst, alloc);
+    Py_DECREF(alloc);
+    if (rc4 < 0) {
+      failed = true;
+      break;
+    }
+  }
+
+  for (PyObject* o : nid_of) Py_XDECREF(o);
+  PyBuffer_Release(&ports_buf);
+  if (failed) {
+    if (!PyErr_Occurred()) {
+      PyErr_SetString(PyExc_RuntimeError, "bulk_finish_cols failed");
+    }
+    return nullptr;
+  }
+  return Py_BuildValue("(nL)", p, lcg);
+}
+
+// bulk_finish_many(items) -> [(n_done, port_lcg), ...]
+//
+// items: list of bulk_finish_cols argument TUPLES (built by
+// scheduler/jax_binpack._finish_native_args), one per evaluation of a
+// drained pipeline window.  Runs every eval's columnar finish loop in
+// ONE Python->C transition so the staged pipeline
+// (scheduler/pipeline.py) amortizes the native-call setup across the
+// window instead of re-entering the interpreter between evals.
+// Exactly equivalent to calling bulk_finish_cols per item.
 PyObject* bulk_finish_many(PyObject* self, PyObject* args) {
   PyObject* items;
   if (!PyArg_ParseTuple(args, "O!", &PyList_Type, &items)) return nullptr;
@@ -1017,7 +1314,7 @@ PyObject* bulk_finish_many(PyObject* self, PyObject* args) {
                       "bulk_finish_many items must be argument tuples");
       return nullptr;
     }
-    PyObject* r = bulk_finish(self, item);
+    PyObject* r = bulk_finish_cols(self, item);
     if (!r) {
       Py_DECREF(out);
       return nullptr;
@@ -1034,8 +1331,11 @@ PyMethodDef methods[] = {
      "Add ports to a used-port set; returns True on any collision."},
     {"bulk_finish", bulk_finish, METH_VARARGS,
      "Scheduler finish-loop happy path: bulk alloc construction."},
+    {"bulk_finish_cols", bulk_finish_cols, METH_VARARGS,
+     "Columnar finish loop: ports into the AllocSlab buffer, lazy "
+     "SlabAllocs into the plan."},
     {"bulk_finish_many", bulk_finish_many, METH_VARARGS,
-     "bulk_finish over a window of evals in one native call."},
+     "bulk_finish_cols over a window of evals in one native call."},
     {"format_uuids", format_uuids, METH_VARARGS,
      "Format UUID strings from raw entropy bytes (16 per UUID)."},
     {nullptr, nullptr, 0, nullptr},
@@ -1054,7 +1354,7 @@ PyMODINIT_FUNC PyInit__nomad_native(void) {
   // Bumped on any signature/behavior change of an existing function so a
   // stale prebuilt .so (same names, old ABI) is detected by the loader
   // (nomad_tpu/utils/native.py) instead of crashing mid-eval.
-  if (PyModule_AddIntConstant(m, "ABI_VERSION", 5) < 0) {
+  if (PyModule_AddIntConstant(m, "ABI_VERSION", 6) < 0) {
     Py_DECREF(m);
     return nullptr;
   }

@@ -63,10 +63,22 @@ def alloc_vec(alloc: "Allocation") -> np.ndarray:
     allocations are replaced, never mutated (the store immutability
     contract, tests/test_state_store.py) — a new record is a new object
     with an empty cache; dataclasses.replace()-based copies don't carry
-    the cache either."""
-    vec = alloc.__dict__.get("_res_vec")
+    the cache either.
+
+    Slab-backed allocs (structs/alloc_slab.py) read the vector straight
+    from the slab's per-slot columns — shared read-only across the
+    slot's rows — without materializing ``resources``; an alloc whose
+    ``resources`` was already materialized (or reassigned) keeps the
+    object truth."""
+    d = alloc.__dict__
+    vec = d.get("_res_vec")
     if vec is None:
-        vec = alloc.__dict__["_res_vec"] = _res_vector(alloc.resources)
+        slab = d.get("_slab")
+        if slab is not None and "resources" not in d:
+            vec = slab.vec(d["_srow"])
+        else:
+            vec = _res_vector(alloc.resources)
+        d["_res_vec"] = vec
     return vec
 
 
@@ -250,12 +262,21 @@ def _net_row(alloc: Allocation):
     alloc under the same immutability contract as ``alloc_vec`` (store
     objects are replaced, never mutated) — the plan verifier reads the
     row once per verify and once per window fold."""
-    row = alloc.__dict__.get("_net_row")
+    d = alloc.__dict__
+    row = d.get("_net_row")
     if row is not None:
         return row[0]
-    row = (_net_row_build(alloc),)
-    alloc.__dict__["_net_row"] = row
-    return row[0]
+    slab = d.get("_slab")
+    if slab is not None and "task_resources" not in d:
+        # Columnar fast path: ports/mbits/(ip, device) straight from
+        # the slab columns — no task_resources materialization.  The
+        # slab builds exactly what _net_row_build would compute on the
+        # materialized row (single-network offers by construction).
+        built = slab.net_row(d["_srow"])
+    else:
+        built = _net_row_build(alloc)
+    d["_net_row"] = (built,)
+    return built
 
 
 def _net_row_build(alloc: Allocation):
@@ -736,7 +757,7 @@ class UsageMirror:
                 ni = index_of.get(alloc.node_id, -1)
                 if ni < 0:
                     continue
-                usage[ni] += _res_vector(alloc.resources)
+                usage[ni] += alloc_vec(alloc)
                 if alloc.job_id == job_id:
                     jc_dense[ni] += 1
         return FleetView(statics=statics, usage=usage,

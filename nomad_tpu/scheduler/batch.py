@@ -381,27 +381,36 @@ class BatchEvalRunner:
 
         from .jax_binpack import _native_bulk
 
-        slab = generate_uuids(sum(len(place) for _, place, *_ in lanes))
+        uuid_slab = generate_uuids(
+            sum(len(place) for _, place, *_ in lanes))
         states = []
         nargs = []
         off = 0
         for sched, place, args, chosen, scores in lanes:
             fs = sched._finish_prepare(place, args, chosen, scores,
-                                       slab[off:off + len(place)])
+                                       uuid_slab[off:off + len(place)])
             off += len(place)
             states.append(fs)
             nargs.append(sched._finish_native_args(fs))
         native = _native_bulk()
+        # Columnar lanes (fs.slab set) batch through ONE
+        # bulk_finish_many call; legacy object lanes (columnar contract
+        # disabled) and mixed windows fall back to per-lane calls.
         if native is not None and hasattr(native, "bulk_finish_many") \
-                and len(lanes) > 1 and all(a is not None for a in nargs):
+                and len(lanes) > 1 and all(a is not None for a in nargs) \
+                and all(fs.slab is not None for fs in states):
             outs = native.bulk_finish_many(nargs)
             for (sched, *_rest), fs, out in zip(lanes, states, outs):
                 sched._finish_consume_native(fs, out)
         else:
             for (sched, *_rest), fs, a in zip(lanes, states, nargs):
                 if a is not None:
-                    sched._finish_consume_native(
-                        fs, native.bulk_finish(*a))
+                    if fs.slab is not None:
+                        sched._finish_consume_native(
+                            fs, native.bulk_finish_cols(*a))
+                    else:
+                        sched._finish_consume_native(
+                            fs, native.bulk_finish(*a))
         for (sched, *_rest), fs in zip(lanes, states):
             sched._finish_python_tail(fs)
 

@@ -53,6 +53,11 @@ from nomad_tpu.structs import (
     allocs_fit,
     generate_uuids,
 )
+from nomad_tpu.structs.alloc_slab import (
+    AllocSlab,
+    SlabAlloc,
+    columnar_enabled,
+)
 from nomad_tpu.structs.model import MAX_DYNAMIC_PORT, MIN_DYNAMIC_PORT
 
 from .generic import GenericScheduler
@@ -253,8 +258,14 @@ class DeviceArgs:
                  # fast_all = every slot takes the O(1) network path;
                  # group_l = group_idx[:n_place].tolist(); slots_c is a
                  # one-element holder lazily filled with the native
-                 # bulk-finish slot table (built on first finish).
-                 "fast_all", "group_l", "slots_c",
+                 # bulk-finish slot table (built on first finish);
+                 # col_meta is the columnar twin — a one-element holder
+                 # for (names, tg_names, slot_mbits, slot_ndyn,
+                 # slot_has, port_off), the per-job-version constants of
+                 # the AllocSlab contract (built on first columnar
+                 # finish; shared read-only across the job's slabs —
+                 # AllocSlab.patch_row copies before mutating).
+                 "fast_all", "group_l", "slots_c", "col_meta",
                  # dev_const: lazily filled device copies of the
                  # dispatch-constant arrays (asks/distinct/counts or
                  # group_idx/valid), shared through the prep cache so a
@@ -276,7 +287,10 @@ class _FinishState:
     window into one C call."""
 
     __slots__ = ("place", "args", "chosen_l", "scores_l", "uuids",
-                 "alloc_proto", "metric_proto", "failed_tg", "start_p")
+                 "alloc_proto", "metric_proto", "failed_tg", "start_p",
+                 # Columnar contract: the AllocSlab the native phase
+                 # fills (None = legacy object-emitting native path).
+                 "slab")
 
 
 class FastPlacementMixin:
@@ -831,7 +845,7 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
             rounds_eligible=eligible,
             fast_all=all(np_[0] for np_ in net_plans),
             group_l=group_idx[:len(place)].tolist(), slots_c=[None],
-            dev_const={})
+            col_meta=[None], dev_const={})
         # Keyed on the fleet GENERATION, not the statics object: a strong
         # statics ref here would pin evicted generations (device
         # feasibility buffers included) for as long as the job lives.
@@ -857,8 +871,13 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
         fs = self._finish_prepare(place, args, chosen, scores, uuids)
         nargs = self._finish_native_args(fs)
         if nargs is not None:
-            self._finish_consume_native(
-                fs, _native_bulk().bulk_finish(*nargs))
+            native = _native_bulk()
+            if fs.slab is not None:
+                self._finish_consume_native(
+                    fs, native.bulk_finish_cols(*nargs))
+            else:
+                self._finish_consume_native(
+                    fs, native.bulk_finish(*nargs))
         self._finish_python_tail(fs)
 
     def _finish_prepare(self, place: list, args: DeviceArgs,
@@ -893,12 +912,16 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
                               job_id=self.job.id, job=self.job)
         fs.failed_tg = {}
         fs.start_p = 0
+        fs.slab = None
         return fs
 
     def _finish_native_args(self, fs: "_FinishState") -> "tuple | None":
-        """bulk_finish argument tuple for this eval's native happy-path
-        prefix, or None when the native path can't take it (extension
-        absent, or a slot needs the exact NetworkIndex)."""
+        """Native argument tuple for this eval's happy-path prefix —
+        columnar (bulk_finish_cols + an AllocSlab, ``fs.slab`` set) by
+        default, the legacy object-emitting bulk_finish tuple when the
+        columnar contract is disabled — or None when the native path
+        can't take it (extension absent, or a slot needs the exact
+        NetworkIndex)."""
         args = fs.args
         native = _native_bulk()
         if native is None or not args.fast_all:
@@ -912,18 +935,82 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
                 (args.sizes[g], args.net_plans[g][1])
                 for g in range(args.n_groups))
             args.slots_c[0] = slots_c
-        return build_bulk_args(
-            self, fs.place, args.group_l, fs.chosen_l, fs.scores_l,
-            fs.uuids, slots_c, fs.alloc_proto, fs.metric_proto,
-            1,  # coalesce_all: generic TG placements interchangeable
-            self._port_lcg)
+        if not columnar_enabled() or \
+                not hasattr(native, "bulk_finish_cols"):
+            return build_bulk_args(
+                self, fs.place, args.group_l, fs.chosen_l, fs.scores_l,
+                fs.uuids, slots_c, fs.alloc_proto, fs.metric_proto,
+                1,  # coalesce_all: generic TG placements interchangeable
+                self._port_lcg)
+        meta = args.col_meta[0]
+        if meta is None:
+            # Per-job-version constants of the columnar contract:
+            # per-row names, per-slot network totals, and the prefix
+            # offsets into the flat port column.  The place list is
+            # identity-stable per job version (util.diff_allocs
+            # cache_fresh), so these ride the prep cache like slots_c.
+            place = fs.place
+            names = [m.name for m in place]
+            tg_names = [m.task_group.name for m in place]
+            slot_mbits = []
+            slot_ndyn = []
+            slot_has = []
+            for _size, tasks in slots_c:
+                mb = nd = 0
+                any_net = False
+                for _t, _rp, net_c in tasks:
+                    if net_c is not None:
+                        any_net = True
+                        mb += net_c[0]
+                        nd += len(net_c[2])
+                slot_mbits.append(mb)
+                slot_ndyn.append(nd)
+                slot_has.append(any_net)
+            port_off = np.zeros(len(place) + 1, dtype=np.int64)
+            if place:
+                np.cumsum(np.asarray(slot_ndyn, dtype=np.int64)[
+                    np.asarray(args.group_l, dtype=np.int64)],
+                    out=port_off[1:])
+            meta = (names, tg_names, slot_mbits, slot_ndyn, slot_has,
+                    port_off)
+            args.col_meta[0] = meta
+        names, tg_names, slot_mbits, slot_ndyn, slot_has, port_off = meta
+        slab = AllocSlab(
+            eval_id=self.eval.id, job=self.job, slots=slots_c,
+            metric_proto=fs.metric_proto, groups=args.group_l,
+            ids=fs.uuids, names=names, tgs=tg_names,
+            scores=fs.scores_l, port_off=port_off,
+            n_rows=len(fs.place),
+            slot_mbits=slot_mbits, slot_has_net=slot_has)
+        fs.slab = slab
+        lazy_proto = {
+            "eval_id": self.eval.id, "job_id": self.job.id,
+            "job": self.job,
+            "desired_status": ALLOC_DESIRED_STATUS_RUN,
+            "client_status": ALLOC_CLIENT_STATUS_PENDING,
+            "_slab": slab,
+        }
+        return (fs.chosen_l, args.group_l, fs.uuids, names, tg_names,
+                slot_mbits, slot_ndyn, slab.ports, slab.node_ids,
+                slab.ips, slab.devs, lazy_proto, SlabAlloc,
+                self._statics.nodes, self._node_net,
+                self._statics.net_base, self._net_base_for,
+                self.state.allocs_node_index(), self.ctx,
+                self.plan.node_update, self.plan.node_allocation,
+                self._port_lcg, MIN_DYNAMIC_PORT, MAX_DYNAMIC_PORT)
 
     def _finish_consume_native(self, fs: "_FinishState",
                                result: tuple) -> None:
-        """Fold one native bulk_finish result (n_done, lcg, failed map)
-        back into the finish state.  fmap stays empty under generic
-        semantics: the C loop bails on a task group's first chosen-less
-        placement so the Python tail can rescue or explain it."""
+        """Fold one native finish result back into the finish state.
+        Columnar path: (n_done, lcg) — the slab seals its happy prefix.
+        Object path: (n_done, lcg, failed map); fmap stays empty under
+        generic semantics — the C loop bails on a task group's first
+        chosen-less placement so the Python tail can rescue or explain
+        it."""
+        if fs.slab is not None:
+            fs.start_p, self._port_lcg = result
+            fs.slab.seal(fs.start_p)
+            return
         fs.start_p, self._port_lcg, fmap = result
         fs.failed_tg.update(fmap)
 

@@ -26,6 +26,12 @@ from nomad_tpu.structs import (
     Node,
     codec,
 )
+from nomad_tpu.structs.alloc_slab import (
+    AllocSlab,
+    SlabWireEncoder,
+    decode_alloc_list,
+    decode_slabs,
+)
 from nomad_tpu.structs.codec import (
     ALLOC_CLIENT_UPDATE_REQUEST,
     ALLOC_UPDATE_REQUEST,
@@ -49,6 +55,12 @@ SNAP_JOB = 2
 SNAP_EVAL = 3
 SNAP_ALLOC = 4
 SNAP_INDEX = 5
+# Columnar extension (no reference analogue): one record carrying a
+# whole AllocSlab family — shared job/slot templates encoded once, per-
+# row scalar deltas (indexes, client merges) riding alongside.  Restore
+# rebuilds lazy SlabAllocs that digest byte-identically to the object
+# encoding (structs/alloc_slab.py).
+SNAP_ALLOC_SLAB = 6
 
 
 class NomadFSM:
@@ -144,7 +156,13 @@ class NomadFSM:
         return None
 
     def _apply_alloc_update(self, index: int, payload: dict):
-        allocs = [Allocation.from_dict(a) for a in payload["alloc"]]
+        """Scheduler-authoritative upsert.  Entries are per-alloc dicts
+        or columnar [slab, row, delta] references (the group-commit
+        applier's columnar wire, structs/alloc_slab.py); either way the
+        store receives Allocation objects — slab rows as lazy
+        SlabAllocs whose heavy fields never materialize on this path."""
+        slabs = decode_slabs(payload)
+        allocs = decode_alloc_list(payload["alloc"], slabs)
         self.state.upsert_allocs(index, allocs)
         return None
 
@@ -153,9 +171,13 @@ class NomadFSM:
         accepted alloc sets, upserted in eval order under one store
         lock (state/store.py upsert_allocs_batched) — final state is
         byte-identical to one ALLOC_UPDATE_REQUEST per plan in order.
-        All allocs are constructed BEFORE any state moves so a malformed
-        sub-plan rejects the entry with the store untouched."""
-        items = [(index, [Allocation.from_dict(a) for a in sub["alloc"]])
+        Sub-plans share one columnar slab table (an eval's placements
+        decode as lazy SlabAllocs straight from the columns — no object
+        materialization between the wire and the store).  All allocs
+        are constructed BEFORE any state moves so a malformed sub-plan
+        rejects the entry with the store untouched."""
+        slabs = decode_slabs(payload)
+        items = [(index, decode_alloc_list(sub["alloc"], slabs))
                  for sub in payload["plans"]]
         self.state.upsert_allocs_batched(items)
         return None
@@ -185,8 +207,22 @@ class NomadFSM:
             rec(SNAP_JOB, job.to_dict())
         for ev in snap.evals():
             rec(SNAP_EVAL, ev.to_dict())
-        for alloc in snap.allocs():
-            rec(SNAP_ALLOC, alloc.to_dict())
+        # Allocs: slab-backed rows serialize as COLUMNS — one shared
+        # record per slab family (job + slot templates once) plus the
+        # per-row scalar deltas the store stamped (indexes, client
+        # merges).  Everything else keeps the per-alloc dict record.
+        enc = SlabWireEncoder()
+        by_slab: dict = {}  # slab table index -> [[row_pos, delta], ...]
+        for entry in enc.encode_list(list(snap.allocs())):
+            if isinstance(entry, dict):
+                rec(SNAP_ALLOC, entry)
+            else:
+                delta = entry[2] if len(entry) > 2 else {}
+                by_slab.setdefault(entry[0], []).append(
+                    [entry[1], delta])
+        for si, wire in enumerate(enc.slabs_wire()):
+            rec(SNAP_ALLOC_SLAB, {"slab": wire,
+                                  "rows": by_slab.get(si, [])})
         return buf.getvalue()
 
     def restore(self, blob: bytes) -> None:
@@ -211,6 +247,12 @@ class NomadFSM:
                 restore.eval_restore(Evaluation.from_dict(payload))
             elif kind == SNAP_ALLOC:
                 restore.alloc_restore(Allocation.from_dict(payload))
+            elif kind == SNAP_ALLOC_SLAB:
+                slab = AllocSlab.from_wire(payload["slab"])
+                for row, delta in payload["rows"]:
+                    restore.alloc_restore(
+                        slab.alloc_with(row, **delta) if delta
+                        else slab.alloc(row))
             else:
                 raise ValueError(f"unrecognized snapshot record {kind}")
         for table, index in indexes.items():

@@ -458,19 +458,27 @@ class PlanApplier:
         # (the FSM's batched upsert preserves last-writer-wins order, so
         # final state is byte-identical to per-plan applies in eval
         # order).  A single committer keeps today's wire format.
+        # Columnar contract: slab-backed allocs ride the log as
+        # [slab, row, delta] references against one shared column
+        # record per slab (the job dict crosses the wire ONCE per slab,
+        # not once per alloc) — structs/alloc_slab.SlabWireEncoder;
+        # plain allocs keep the per-alloc dict encoding.
         from nomad_tpu.ops.plan_conflict import _accepted_allocs
+        from nomad_tpu.structs.alloc_slab import (
+            encode_alloc_update,
+            encode_plan_batch,
+        )
 
         alloc_lists = [_accepted_allocs(result)
                        for _pending, result in committers]
         if len(committers) == 1:
             entry = codec.encode(
                 codec.ALLOC_UPDATE_REQUEST,
-                {"alloc": [a.to_dict() for a in alloc_lists[0]]})
+                encode_alloc_update(alloc_lists[0]))
         else:
             entry = codec.encode(
                 codec.PLAN_BATCH_APPLY_REQUEST,
-                {"plans": [{"alloc": [a.to_dict() for a in allocs]}
-                           for allocs in alloc_lists]})
+                encode_plan_batch(alloc_lists))
         try:
             future = self.raft.apply(entry)
         except Exception as e:

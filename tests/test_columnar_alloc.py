@@ -1,0 +1,643 @@
+"""Columnar alloc contract (structs/alloc_slab.py): lazy SlabAlloc
+materialization, the columnar raft wire, snapshot encoding, and
+byte-parity between the slab path and the legacy object path.
+
+The invariant everything here pins: a world that evolved through
+columnar slabs digests (store fingerprint, per-alloc to_dict) EXACTLY
+like one that evolved through the object contract — the slab is a
+representation change, never a semantic one.
+"""
+from __future__ import annotations
+
+import gc
+import weakref
+
+import msgpack
+import pytest
+
+import nomad_tpu.mock as mock
+import nomad_tpu.scheduler.jax_binpack as jb
+import nomad_tpu.structs.alloc_slab as alloc_slab
+from nomad_tpu.scheduler import Harness
+from nomad_tpu.server.fsm import SNAP_ALLOC_SLAB, NomadFSM
+from nomad_tpu.state.store import StateStore
+from nomad_tpu.structs import (
+    ALLOC_DESIRED_STATUS_STOP,
+    EVAL_TRIGGER_JOB_REGISTER,
+    Allocation,
+    Evaluation,
+    NetworkResource,
+    Resources,
+    SlabAlloc,
+    Task,
+    TaskGroup,
+    codec,
+)
+from nomad_tpu.structs.alloc_slab import (
+    AllocSlab,
+    decode_alloc_list,
+    decode_slabs,
+    encode_alloc_update,
+    encode_plan_batch,
+    slab_ref,
+)
+
+pytestmark = pytest.mark.skipif(
+    jb._native_bulk() is None, reason="native extension unavailable")
+
+
+def make_eval(job):
+    return Evaluation(id=f"ev-{job.id}", priority=job.priority,
+                      type="service",
+                      triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+                      job_id=job.id)
+
+
+def _job(n_groups=6, count=2):
+    job = mock.job()
+    job.task_groups = [
+        TaskGroup(
+            name=f"tg-{g}", count=count,
+            tasks=[
+                Task(name="web", driver="exec",
+                     resources=Resources(
+                         cpu=100, memory_mb=64,
+                         networks=[NetworkResource(
+                             mbits=5, dynamic_ports=["http", "admin"])])),
+                Task(name="sidecar", driver="exec",
+                     resources=Resources(cpu=50, memory_mb=32)),
+            ])
+        for g in range(n_groups)]
+    return job
+
+
+def _deterministic(monkeypatch):
+    counter = {"n": 0}
+
+    def fake_uuids(n):
+        base = counter["n"]
+        counter["n"] += n
+        return [f"u-{base + i:08d}" for i in range(n)]
+
+    monkeypatch.setattr(jb, "generate_uuids", fake_uuids)
+    monkeypatch.setattr("nomad_tpu.structs.generate_uuids", fake_uuids)
+    monkeypatch.setattr(jb, "_randrange", lambda n: 987654321 % n)
+
+    # Frozen clock: metrics.allocation_time is wall-clock-derived and
+    # would differ between the two contract runs (the fingerprint
+    # digests it).
+    class _FrozenTime:
+        perf_counter = staticmethod(lambda: 0.0)
+
+    monkeypatch.setattr(jb, "time", _FrozenTime)
+    # The failed-alloc path stamps allocation_time through the stack's
+    # own clock (scheduler/stack.py) — freeze it too so contended runs
+    # (exhausted placements carry real metrics) digest identically.
+    import nomad_tpu.scheduler.stack as stack
+    monkeypatch.setattr(stack, "time", _FrozenTime)
+
+
+_WORLD_CACHE: dict = {}
+
+
+def _world(n_nodes=12, n_jobs=3):
+    """One shared node/job prototype set per shape — both contract runs
+    must see the SAME world (mock ids are random per construction)."""
+    key = (n_nodes, n_jobs)
+    world = _WORLD_CACHE.get(key)
+    if world is None:
+        nodes = [mock.node(i) for i in range(n_nodes)]
+        jobs = []
+        for j in range(n_jobs):
+            job = _job()
+            job.id = f"job-{j}"
+            job.name = f"job-{j}"
+            jobs.append(job)
+        world = _WORLD_CACHE[key] = (nodes, jobs)
+    return world
+
+
+def _run_storm(monkeypatch, columnar: bool, n_nodes=12, n_jobs=3):
+    """One deterministic eval stream through the jax-binpack scheduler;
+    returns (harness, plans)."""
+    _deterministic(monkeypatch)
+    monkeypatch.setattr(alloc_slab, "COLUMNAR", columnar)
+    nodes, jobs = _world(n_nodes, n_jobs)
+    h = Harness()
+    for n in nodes:
+        h.state.upsert_node(h.next_index(), n.copy())
+    plans = []
+    for job in jobs:
+        h.state.upsert_job(h.next_index(), job.copy())
+        h.process("jax-binpack", make_eval(job))
+        plans.append(h.plans[-1])
+    return h, plans
+
+
+def _norm(plan):
+    out = {}
+    for node_id, allocs in plan.node_allocation.items():
+        rows = []
+        for a in allocs:
+            d = a.to_dict()
+            d["metrics"]["allocation_time"] = 0.0
+            rows.append(d)
+        out[node_id] = rows
+    return out
+
+
+def _plan_allocs(plan):
+    return [a for allocs in plan.node_allocation.values()
+            for a in allocs]
+
+
+# ---------------------------------------------------------------------------
+# 1. scheduler-level parity: columnar vs object contract
+# ---------------------------------------------------------------------------
+
+class TestSchedulerParity:
+    def test_columnar_plans_byte_identical_to_object_path(
+            self, monkeypatch):
+        with monkeypatch.context() as m:
+            _h1, obj_plans = _run_storm(m, columnar=False)
+        with monkeypatch.context() as m:
+            _h2, col_plans = _run_storm(m, columnar=True)
+        assert [_norm(p) for p in obj_plans] == \
+            [_norm(p) for p in col_plans]
+        # The columnar run really rode slabs (not a silent fallback).
+        assert all(type(a) is SlabAlloc
+                   for p in col_plans for a in _plan_allocs(p))
+        assert all(type(a) is Allocation
+                   for p in obj_plans for a in _plan_allocs(p))
+
+    def test_columnar_store_fingerprint_parity(self, monkeypatch):
+        """Apply both recordings to fresh stores with identical
+        indexes: alloc set, per-table indexes and the full store digest
+        must be byte-identical."""
+        with monkeypatch.context() as m:
+            _h1, obj_plans = _run_storm(m, columnar=False)
+        with monkeypatch.context() as m:
+            _h2, col_plans = _run_storm(m, columnar=True)
+        stores = []
+        for plans in (obj_plans, col_plans):
+            s = StateStore()
+            s.upsert_allocs_batched(
+                [(5000 + i, _plan_allocs(p))
+                 for i, p in enumerate(plans)])
+            stores.append(s)
+        s_obj, s_col = stores
+        assert s_obj.get_index("allocs") == s_col.get_index("allocs")
+        assert sorted(a.id for a in s_obj.allocs()) == \
+            sorted(a.id for a in s_col.allocs())
+        assert s_obj.fingerprint() == s_col.fingerprint()
+
+    def test_verify_window_does_not_materialize_slab_rows(
+            self, monkeypatch):
+        """The vectorized window verify consumes slab columns: after a
+        full evaluate_window pass the plan's slab allocs still have no
+        heavy fields in their dicts."""
+        from nomad_tpu.ops.plan_conflict import evaluate_window
+
+        with monkeypatch.context() as m:
+            h, plans = _run_storm(m, columnar=True)
+            snap = h.state.snapshot()
+            outcomes = evaluate_window(snap, plans)
+        assert len(outcomes) == len(plans)
+        for p in plans:
+            for a in _plan_allocs(p):
+                for heavy in ("resources", "task_resources", "metrics"):
+                    assert heavy not in a.__dict__, \
+                        f"window verify materialized {heavy}"
+
+
+class _RecordingPlanner:
+    """VerifyingPlanner wrapper recording every plan verdict — the
+    rejection/partial-accept stream the contended parity rig
+    byte-compares between the two contracts."""
+
+    def __init__(self, harness):
+        from nomad_tpu.scheduler.harness import VerifyingPlanner
+
+        self.inner = VerifyingPlanner(harness)
+        self.verdicts: list = []
+
+    def _record(self, plan, result):
+        self.verdicts.append((
+            plan.eval_id,
+            _norm_result(result),
+            bool(result.refresh_index),
+        ))
+
+    def submit_plans(self, plans):
+        out = self.inner.submit_plans(plans)
+        for plan, (result, _state) in zip(plans, out):
+            self._record(plan, result)
+        return out
+
+    def submit_plan(self, plan):
+        result, state = self.inner.submit_plan(plan)
+        self._record(plan, result)
+        return result, state
+
+    def update_eval(self, ev):
+        self.inner.update_eval(ev)
+
+    def create_eval(self, ev):
+        self.inner.create_eval(ev)
+
+
+def _norm_result(result):
+    out = {}
+    for node_id, allocs in result.node_allocation.items():
+        rows = []
+        for a in allocs:
+            d = a.to_dict()
+            d["metrics"]["allocation_time"] = 0.0
+            rows.append(d)
+        out[node_id] = rows
+    return out
+
+
+class TestContendedStormParity:
+    """ISSUE 9 rig: a REAL contended fused storm (BatchEvalRunner
+    through leader verify semantics) replayed through both contracts —
+    alloc set, rejections, per-table indexes, and the store fingerprint
+    byte-compared (extends the test_plan_batch.py recorded-storm and
+    test_state_store_port.py batched-parity patterns)."""
+
+    def _storm(self, monkeypatch, columnar: bool):
+        from nomad_tpu.scheduler.batch import BatchEvalRunner
+
+        _deterministic(monkeypatch)
+        monkeypatch.setattr(alloc_slab, "COLUMNAR", columnar)
+        # 6 nodes under 8 jobs x 4 TGs x count 2 at cpu=600: the later
+        # evals over-commit the fleet, so the verifying planner emits
+        # the full verdict spectrum (accepts, partial accepts with a
+        # refresh, rejections) — not just the happy path.
+        key = ("contended", 6, 8)
+        world = _WORLD_CACHE.get(key)
+        if world is None:
+            nodes = [mock.node(i) for i in range(6)]
+            jobs = []
+            for j in range(8):
+                job = mock.job()
+                job.id = f"storm-job-{j}"
+                job.name = f"storm-job-{j}"
+                job.task_groups = [
+                    TaskGroup(
+                        name=f"tg-{g}", count=2,
+                        tasks=[Task(
+                            name="web", driver="exec",
+                            resources=Resources(
+                                cpu=600, memory_mb=256,
+                                networks=[NetworkResource(
+                                    mbits=5,
+                                    dynamic_ports=["http"])]))])
+                    for g in range(4)]
+                jobs.append(job)
+            world = _WORLD_CACHE[key] = (nodes, jobs)
+        nodes, jobs = world
+        h = Harness()
+        for n in nodes:
+            h.state.upsert_node(h.next_index(), n.copy())
+        for job in jobs:
+            h.state.upsert_job(h.next_index(), job.copy())
+        h.planner = _RecordingPlanner(h)
+        evals = [Evaluation(id=f"storm-ev-{j.id}", priority=50,
+                            type=j.type,
+                            triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+                            job_id=j.id) for j in jobs]
+        BatchEvalRunner(h.state.snapshot(), h,
+                        state_refresh=h.snapshot).process(evals)
+        return h
+
+    def test_storm_replay_byte_parity(self, monkeypatch):
+        with monkeypatch.context() as m:
+            h_obj = self._storm(m, columnar=False)
+        with monkeypatch.context() as m:
+            h_col = self._storm(m, columnar=True)
+
+        # The verdict stream: same plans, same accepted portions, same
+        # rejections/refreshes, in the same order.
+        v_obj = h_obj.planner.verdicts
+        v_col = h_col.planner.verdicts
+        assert len(v_obj) == len(v_col)
+        assert v_obj == v_col
+        assert any(refresh for _e, _n, refresh in v_obj), \
+            "storm produced no contention — the rig lost its teeth"
+        assert h_obj.planner.inner.conflicts == \
+            h_col.planner.inner.conflicts
+
+        # Alloc set + per-table indexes + full store digest.
+        assert sorted(a.id for a in h_obj.state.allocs()) == \
+            sorted(a.id for a in h_col.state.allocs())
+        for table in ("allocs", "nodes", "jobs", "evals"):
+            assert h_obj.state.get_index(table) == \
+                h_col.state.get_index(table), table
+        assert h_obj.state.fingerprint() == h_col.state.fingerprint()
+        # And the columnar run genuinely rode slabs.
+        assert any(type(a) is SlabAlloc for a in h_col.state.allocs())
+
+
+# ---------------------------------------------------------------------------
+# 2. lazy materialization semantics
+# ---------------------------------------------------------------------------
+
+class TestLazyMaterialization:
+    def _one_alloc(self, monkeypatch):
+        h, plans = _run_storm(monkeypatch, columnar=True, n_jobs=1)
+        allocs = _plan_allocs(plans[0])
+        assert allocs
+        return allocs[0]
+
+    def test_fields_materialize_on_read_and_round_trip(
+            self, monkeypatch):
+        a = self._one_alloc(monkeypatch)
+        assert "task_resources" not in a.__dict__
+        d = a.to_dict()  # materializes through the properties
+        assert "task_resources" in a.__dict__
+        twin = Allocation.from_dict(d)
+        assert twin.to_dict() == d
+        # Ports in the offer match the slab's column slice.
+        slab, r = a.__dict__["_slab"], a.__dict__["_srow"]
+        ports = [p for tr in a.task_resources.values()
+                 for n in tr.networks for p in n.reserved_ports]
+        o0, o1 = int(slab.port_off[r]), int(slab.port_off[r + 1])
+        assert ports == slab.ports[o0:o1].tolist()
+
+    def test_slab_vec_and_net_row_match_materialized_truth(
+            self, monkeypatch):
+        from nomad_tpu.models.fleet import (_net_row_build, _res_vector,
+                                            alloc_vec, _net_row)
+
+        a = self._one_alloc(monkeypatch)
+        vec = alloc_vec(a)          # columnar fast path (unmaterialized)
+        row = _net_row(a)
+        assert "task_resources" not in a.__dict__
+        # Materialize and recompute the object truth.
+        assert list(vec) == list(_res_vector(a.resources))
+        assert row == _net_row_build(a)
+
+    def test_copy_preserves_slab_backing(self, monkeypatch):
+        a = self._one_alloc(monkeypatch)
+        c = a.copy()
+        assert type(c) is SlabAlloc
+        assert c.__dict__["_slab"] is a.__dict__["_slab"]
+        assert "task_resources" not in c.__dict__
+        assert c.to_dict() == a.to_dict()
+
+    def test_heavy_assignment_flags_row_off_the_columnar_wire(
+            self, monkeypatch):
+        a = self._one_alloc(monkeypatch)
+        assert slab_ref(a) is not None
+        c = a.copy()
+        c.task_resources = {}
+        assert slab_ref(c) is None, \
+            "a mutated heavy field must disable slab-reference encoding"
+        assert slab_ref(a) is not None, "flag must not leak to siblings"
+
+    def test_eviction_copy_rides_wire_as_scalar_delta(self, monkeypatch):
+        a = self._one_alloc(monkeypatch)
+        ev = a.copy()
+        ev.desired_status = ALLOC_DESIRED_STATUS_STOP
+        ev.desired_description = "alloc not needed"
+        ref = slab_ref(ev)
+        assert ref is not None
+        _slab, _r, delta = ref
+        assert delta == {"desired_status": ALLOC_DESIRED_STATUS_STOP,
+                         "desired_description": "alloc not needed"}
+
+    def test_refcount_reclaims_materialized_family(self, monkeypatch):
+        """No cycles: dropping the plan frees allocs AND slab with gc
+        disabled, even after materialization and wire caching."""
+        h, plans = _run_storm(monkeypatch, columnar=True, n_jobs=1)
+        allocs = _plan_allocs(plans[0])
+        slab = allocs[0].__dict__["_slab"]
+        slab.alloc(0)  # populate the decode cache too
+        refs = [weakref.ref(a) for a in allocs] + [weakref.ref(slab)]
+        was = gc.isenabled()
+        gc.disable()
+        try:
+            del allocs, slab
+            h.plans.clear()
+            for p in plans:
+                p.node_allocation.clear()
+            del plans, h
+            assert all(r() is None for r in refs), \
+                "slab family survived refcount-only teardown"
+        finally:
+            if was:
+                gc.enable()
+
+
+# ---------------------------------------------------------------------------
+# 3. the columnar wire
+# ---------------------------------------------------------------------------
+
+class TestColumnarWire:
+    def test_plan_batch_wire_round_trip_byte_parity(self, monkeypatch):
+        h, plans = _run_storm(monkeypatch, columnar=True)
+        alloc_lists = [_plan_allocs(p) for p in plans]
+        payload = encode_plan_batch(alloc_lists)
+        # Full msgpack round trip, exactly like the raft log.
+        payload = msgpack.unpackb(
+            msgpack.packb(payload, use_bin_type=True),
+            raw=False, strict_map_key=False)
+        slabs = decode_slabs(payload)
+        for sub, want in zip(payload["plans"], alloc_lists):
+            got = decode_alloc_list(sub["alloc"], slabs)
+            assert [a.to_dict() for a in got] == \
+                [a.to_dict() for a in want]
+
+    def test_wire_smaller_than_object_encoding(self, monkeypatch):
+        h, plans = _run_storm(monkeypatch, columnar=True)
+        alloc_lists = [_plan_allocs(p) for p in plans]
+        col = msgpack.packb(encode_plan_batch(alloc_lists),
+                            use_bin_type=True)
+        obj = msgpack.packb(
+            {"plans": [{"alloc": [a.to_dict() for a in allocs]}
+                       for allocs in alloc_lists]},
+            use_bin_type=True)
+        assert len(col) < len(obj) // 2, (len(col), len(obj))
+
+    def test_fsm_apply_columnar_vs_object_entries(self, monkeypatch):
+        """Two FSMs, one fed the columnar PLAN_BATCH entry, one the
+        object encoding of the same window: identical fingerprints."""
+        h, plans = _run_storm(monkeypatch, columnar=True)
+        alloc_lists = [_plan_allocs(p) for p in plans]
+        e_col = codec.encode(codec.PLAN_BATCH_APPLY_REQUEST,
+                             encode_plan_batch(alloc_lists))
+        e_obj = codec.encode(
+            codec.PLAN_BATCH_APPLY_REQUEST,
+            {"plans": [{"alloc": [a.to_dict() for a in allocs]}
+                       for allocs in alloc_lists]})
+        f_col, f_obj = NomadFSM(), NomadFSM()
+        f_col.apply(100, e_col)
+        f_obj.apply(100, e_obj)
+        assert f_col.state.fingerprint() == f_obj.state.fingerprint()
+
+    def test_alloc_update_payload_back_compat(self):
+        """A legacy all-dict ALLOC_UPDATE payload (client updates, old
+        log entries) still decodes."""
+        a = Allocation(id="a1", node_id="n1", job_id="j1",
+                       resources=Resources(cpu=10))
+        fsm = NomadFSM()
+        fsm.apply(7, codec.encode(codec.ALLOC_UPDATE_REQUEST,
+                                  {"alloc": [a.to_dict()]}))
+        assert fsm.state.alloc_by_id("a1") is not None
+
+
+# ---------------------------------------------------------------------------
+# 4. slab cache invalidation
+# ---------------------------------------------------------------------------
+
+class TestCacheInvalidation:
+    def _slab(self, monkeypatch):
+        h, plans = _run_storm(monkeypatch, columnar=True, n_jobs=1)
+        payload = msgpack.unpackb(
+            msgpack.packb(
+                encode_alloc_update(_plan_allocs(plans[0])),
+                use_bin_type=True),
+            raw=False, strict_map_key=False)
+        return decode_slabs(payload)[0]
+
+    def test_alloc_cached_then_invalidated_by_patch_row(
+            self, monkeypatch):
+        slab = self._slab(monkeypatch)
+        a1 = slab.alloc(0)
+        assert slab.alloc(0) is a1, "canonical row objects are cached"
+        old_node = a1.node_id
+        slab.patch_row(0, node_id="moved-node")
+        a2 = slab.alloc(0)
+        assert a2 is not a1, \
+            "a patched row must not serve the stale cached object"
+        assert a2.node_id == "moved-node"
+        # The already-handed-out object keeps its snapshot (store
+        # immutability semantics), it just stops being served.
+        assert a1.node_id == old_node
+
+    def test_alloc_with_is_never_cached(self, monkeypatch):
+        slab = self._slab(monkeypatch)
+        a = slab.alloc_with(0, create_index=9, modify_index=9)
+        assert a.create_index == 9
+        assert slab.alloc(0) is not a
+        assert slab.alloc(0).create_index == 0
+
+    def test_patch_row_rejects_non_scalar_columns(self, monkeypatch):
+        slab = self._slab(monkeypatch)
+        with pytest.raises(KeyError):
+            slab.patch_row(0, task_resources={})
+
+    def test_patch_row_does_not_leak_into_sibling_slabs(
+            self, monkeypatch):
+        """Scheduler-built slabs alias names/tgs (and groups) to the
+        per-job-version col_meta cache, shared read-only with every
+        sibling slab of the same job version — patch_row must
+        copy-on-write, not rewrite a sibling's canonical rows through
+        the shared list.  (Today the plan memo collapses same-version
+        finishes onto one slab, so the aliasing is latent; this pins
+        the seam's contract for the first caller that isn't.)"""
+        h, plans = _run_storm(monkeypatch, columnar=True, n_jobs=1)
+        proto = _plan_allocs(plans[0])[0].__dict__["_slab"]
+        import numpy as np
+
+        def sibling():
+            s = AllocSlab(
+                eval_id=proto.eval_id, job=proto.job,
+                slots=proto.slots, metric_proto=proto.metric_proto,
+                groups=proto.groups,        # shared, like col_meta
+                ids=list(proto.ids), names=proto.names,   # shared
+                tgs=proto.tgs,              # shared
+                scores=list(proto.scores),
+                port_off=np.asarray(proto.port_off), n_rows=proto.n)
+            s.node_ids = list(proto.node_ids)
+            s.ips = list(proto.ips)
+            s.devs = list(proto.devs)
+            s.seal(proto.n)
+            return s
+        slab_a, slab_b = sibling(), sibling()
+        assert slab_a.names is slab_b.names, \
+            "precondition: siblings share the col_meta names column"
+        before = slab_b.names[0]
+        slab_a.patch_row(0, name="patched-name", task_group="patched-tg")
+        assert slab_a.names[0] == "patched-name"
+        assert slab_b.names[0] == before, \
+            "patch_row leaked through the shared col_meta column"
+        assert slab_b.alloc(0).name == before
+        assert slab_a.alloc(0).name == "patched-name"
+        # Second patch mutates the now-private columns in place.
+        slab_a.patch_row(1, name="second-patch")
+        assert slab_b.names[1] == proto.names[1]
+
+
+# ---------------------------------------------------------------------------
+# 5. columnar FSM snapshots
+# ---------------------------------------------------------------------------
+
+class TestColumnarSnapshot:
+    def _fsm_with_storm(self, monkeypatch):
+        h, plans = _run_storm(monkeypatch, columnar=True)
+        fsm = NomadFSM()
+        alloc_lists = [_plan_allocs(p) for p in plans]
+        fsm.apply(100, codec.encode(codec.PLAN_BATCH_APPLY_REQUEST,
+                                    encode_plan_batch(alloc_lists)))
+        return fsm, alloc_lists
+
+    def test_snapshot_round_trips_fingerprint_identical(
+            self, monkeypatch):
+        fsm, _ = self._fsm_with_storm(monkeypatch)
+        want = fsm.state.fingerprint(changelog_since=10 ** 9)
+        blob = fsm.snapshot()
+        # The snapshot actually used columnar records.
+        unpacker = msgpack.Unpacker(raw=False, strict_map_key=False)
+        unpacker.feed(blob)
+        kinds = [k for k, _p in unpacker]
+        assert SNAP_ALLOC_SLAB in kinds
+        fsm.restore(blob)
+        # The restored store's allocs are slab-backed and still lazy
+        # (checked BEFORE the digest below materializes them).
+        assert any("_slab" in a.__dict__ and
+                   "task_resources" not in a.__dict__
+                   for a in fsm.state.allocs())
+        assert fsm.state.fingerprint(changelog_since=10 ** 9) == want
+
+    def test_snapshot_smaller_than_object_encoding(self, monkeypatch):
+        """The snapshot-size tax: columnar records must beat per-alloc
+        dicts (which re-serialize the whole job per alloc)."""
+        fsm, alloc_lists = self._fsm_with_storm(monkeypatch)
+        col_blob = fsm.snapshot()
+
+        # Twin world, same final state, forced through the OBJECT wire
+        # (per-alloc dicts all the way): fingerprints match, so the
+        # size delta is pure representation.
+        twin = NomadFSM()
+        twin.apply(100, codec.encode(
+            codec.PLAN_BATCH_APPLY_REQUEST,
+            {"plans": [{"alloc": [a.to_dict() for a in allocs]}
+                       for allocs in alloc_lists]}))
+        assert twin.state.fingerprint() == fsm.state.fingerprint()
+        obj_blob = twin.snapshot()
+        assert len(col_blob) < len(obj_blob) // 2, \
+            (len(col_blob), len(obj_blob))
+        # Both restore to the same world.
+        f1, f2 = NomadFSM(), NomadFSM()
+        f1.restore(col_blob)
+        f2.restore(obj_blob)
+        assert f1.state.fingerprint(changelog_since=10 ** 9) == \
+            f2.state.fingerprint(changelog_since=10 ** 9)
+
+    def test_client_merged_rows_keep_their_updates(self, monkeypatch):
+        """A row the client merged (task_states) snapshots through the
+        delta channel and round-trips its update."""
+        fsm, _ = self._fsm_with_storm(monkeypatch)
+        some = next(iter(fsm.state.allocs()))
+        upd = some.copy()
+        upd.client_status = "running"
+        upd.task_states = {"web": {"state": "running"}}
+        fsm.state.update_alloc_from_client(200, upd)
+        want = fsm.state.fingerprint(changelog_since=10 ** 9)
+        fsm.restore(fsm.snapshot())
+        assert fsm.state.fingerprint(changelog_since=10 ** 9) == want
+        back = fsm.state.alloc_by_id(some.id)
+        assert back.client_status == "running"
+        assert back.task_states == {"web": {"state": "running"}}

@@ -35,7 +35,10 @@ pytestmark = pytest.mark.skipif(not HAS_NATIVE,
                                 reason="native extension unavailable")
 
 
-def _run_eval(n_nodes=32, n_groups=8):
+def _run_eval(n_nodes=32, n_groups=8, columnar=True, monkeypatch=None):
+    if not columnar:
+        import nomad_tpu.structs.alloc_slab as alloc_slab
+        monkeypatch.setattr(alloc_slab, "COLUMNAR", False)
     h = Harness()
     for i in range(n_nodes):
         h.state.upsert_node(h.next_index(), mock.node(i))
@@ -59,8 +62,28 @@ def _run_eval(n_nodes=32, n_groups=8):
     return h, plan, allocs
 
 
-def test_native_allocs_untracked():
+def test_native_allocs_untracked_columnar():
+    """Columnar contract: the native loop emits ONE untracked
+    SlabAlloc + dict per placement; the heavy fields do not even exist
+    until an API-edge consumer reads them (and then materialize as
+    ordinary Python objects reclaimed by refcount — see the test
+    below)."""
     h, plan, allocs = _run_eval()
+    for a in allocs:
+        assert not gc.is_tracked(a), "SlabAlloc should be GC-untracked"
+        assert not gc.is_tracked(a.__dict__)
+        d = a.__dict__
+        assert "_slab" in d
+        for heavy in ("resources", "task_resources", "metrics",
+                      "task_states"):
+            assert heavy not in d, \
+                f"{heavy} materialized on the scheduling hot path"
+
+
+def test_native_allocs_untracked_object_path(monkeypatch):
+    """Legacy object contract (columnar disabled): the C loop builds
+    the full object tree, every piece untracked."""
+    h, plan, allocs = _run_eval(columnar=False, monkeypatch=monkeypatch)
     for a in allocs:
         assert not gc.is_tracked(a), "Allocation should be GC-untracked"
         assert not gc.is_tracked(a.__dict__)
@@ -77,10 +100,18 @@ def test_native_allocs_untracked():
 def test_refcount_reclaims_without_collector():
     """The acyclicity proof: with gc disabled, dropping the plan frees
     every alloc (weakrefs die) — no cycle passes through the untracked
-    objects, so nothing can leak."""
+    objects, so nothing can leak.  Heavy fields are materialized first
+    so the lazily-built objects (and the slab they hang off) are part
+    of the proof."""
     h, plan, allocs = _run_eval()
+    slabs = {id(a.__dict__["_slab"]): a.__dict__["_slab"]
+             for a in allocs if "_slab" in a.__dict__}
     refs = [weakref.ref(a) for a in allocs]
     refs += [weakref.ref(a.metrics) for a in allocs]
+    refs += [weakref.ref(tr) for a in allocs
+             for tr in a.task_resources.values()]
+    refs += [weakref.ref(s) for s in slabs.values()]
+    del slabs
     was_enabled = gc.isenabled()
     gc.disable()
     try:

@@ -328,6 +328,48 @@ class TestLintGate:
                        for e in allowlist), \
             "crash-recovery plane must not need allowlist entries"
 
+    def test_columnar_paths_ride_the_gates(self):
+        """ISSUE 9 satellite: the columnar alloc contract — the
+        AllocSlab/SlabAlloc module (structs/alloc_slab.py), the
+        scheduler's columnar native-args path, the slab-aware fleet
+        readers, and the FSM's columnar wire decode — is inside every
+        gate's scan set, strict-clean, with zero allowlist entries of
+        its own."""
+        from nomad_tpu.analysis import default_package_root
+        from nomad_tpu.analysis.callgraph import CallGraph
+
+        pkg = default_package_root()
+        graph = CallGraph.build(pkg)
+        for qual in (
+            "nomad_tpu.structs.alloc_slab:AllocSlab.wire",
+            "nomad_tpu.structs.alloc_slab:AllocSlab.from_wire",
+            "nomad_tpu.structs.alloc_slab:AllocSlab.task_resources_of",
+            "nomad_tpu.structs.alloc_slab:AllocSlab.patch_row",
+            "nomad_tpu.structs.alloc_slab:SlabAlloc.copy",
+            "nomad_tpu.structs.alloc_slab:SlabWireEncoder.encode_list",
+            "nomad_tpu.structs.alloc_slab:_slab_fill",
+            "nomad_tpu.structs.alloc_slab:slab_ref",
+            "nomad_tpu.structs.alloc_slab:decode_alloc_list",
+            "nomad_tpu.scheduler.jax_binpack:"
+            "JaxBinPackScheduler._finish_native_args",
+            "nomad_tpu.server.fsm:NomadFSM._apply_alloc_update",
+            "nomad_tpu.models.fleet:alloc_vec",
+            "nomad_tpu.models.fleet:_net_row",
+        ):
+            assert qual in graph.functions, \
+                f"{qual} missing from the interprocedural graph"
+
+        allowlist = load_allowlist(default_allowlist_path())
+        gating, _allowed, _stale = partition_findings(
+            run_lint(strict=True), allowlist)
+        touching = [f for f in gating if "alloc_slab" in f.path]
+        assert touching == [], \
+            "columnar contract must lint clean:\n" + \
+            "\n".join(f.render() for f in touching)
+        assert not any("alloc_slab" in e or "SlabAlloc" in e
+                       for e in allowlist), \
+            "columnar contract must not need allowlist entries"
+
     def test_fixed_sleep_ratchet_is_clean(self):
         """Every fixed time.sleep in the test tree is either converted
         to wait_until or carries a '# sleep-ok: why' justification —
