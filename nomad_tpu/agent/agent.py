@@ -15,6 +15,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from nomad_tpu import faultinject
+from nomad_tpu.obs import trace as trace_mod
 from nomad_tpu.client import Client, ClientConfig
 from nomad_tpu.server import Server, ServerConfig
 from nomad_tpu.server.endpoints import Endpoints
@@ -55,6 +56,11 @@ class InprocRPC:
         fn = self._methods.get(method)
         if fn is None:
             raise ValueError(f"unknown method {method!r}")
+        if trace_mod.ENABLED:
+            # Same trace envelope + client span as ConnPool.call: the
+            # colocated agent edge is an edge all the same.
+            with trace_mod.client_call(method, args) as args:
+                return fn(args)
         return fn(args)
 
 
@@ -240,7 +246,40 @@ class Agent:
 
         self.http = HTTPServer(self, self.config.bind_addr,
                                self.config.http_port)
+        # Registry BEFORE start(): the instant the port accepts, a
+        # retry-until-up monitor may hit /v1/agent/metrics — it must
+        # find obs_registry already assigned.
+        self._setup_obs_registry()
         self.http.start()
+
+    def _setup_obs_registry(self) -> None:
+        """Agent-level providers (obs/registry.py): the HTTP edge and
+        the client's runner census ride beside the server's registry in
+        /v1/agent/metrics."""
+        from nomad_tpu.obs import MetricsRegistry
+
+        reg = MetricsRegistry()
+        if self.http is not None:
+            reg.register("http", self.http.stats)
+        if self.client is not None:
+            reg.register("client", lambda: {
+                "allocs": len(self.client.alloc_runners)})
+        self.obs_registry = reg
+
+    def metrics_payload(self) -> dict:
+        """The /v1/agent/metrics document: every registry this process
+        owns (agent + colocated server + process singletons) flattened
+        to ``nomad.*`` keys, plus the in-memory telemetry sink."""
+        from nomad_tpu.obs import REGISTRY
+        from nomad_tpu.utils.metrics import metrics
+
+        extra = [REGISTRY]
+        if self.server is not None:
+            extra.append(self.server.obs_registry)
+        return {
+            "providers": self.obs_registry.snapshot(extra=extra),
+            "inmem": metrics.inmem.snapshot(),
+        }
 
     # -- RPC from HTTP layer ------------------------------------------------
     def rpc(self, method: str, args: dict):

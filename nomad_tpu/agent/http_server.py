@@ -501,6 +501,14 @@ class HTTPServer:
         if parts == ["agent", "self"]:
             return 200, {"config": vars(agent.config),
                          "stats": agent.stats()}, None
+        if parts == ["agent", "metrics"]:
+            # The unified metrics registry (obs/registry.py): every
+            # stats() provider in the process flattened to nomad.*
+            # keys + the in-mem telemetry sink.  Always mounted (not
+            # behind enable_debug): metrics are the production
+            # monitoring surface, like the reference's /v1/agent/self
+            # stats block, and carry no secrets.
+            return 200, agent.metrics_payload(), None
         if parts == ["agent", "monitor"]:
             # Recent agent log lines from the in-process ring
             # (reference command/agent/log_writer.go: the monitor's

@@ -151,6 +151,11 @@ class AgentSwarm:
         self.poll_timeouts = 0
         self.poll_errors = 0
         self.register_errors = 0
+        # Unified metrics registry (obs/registry.py): a live swarm is
+        # a process-wide load source worth one nomad.swarm.* provider;
+        # stop() deregisters it.
+        from nomad_tpu.obs import REGISTRY
+        self._obs_token = REGISTRY.register("swarm", self.stats)
 
     # -- async call plumbing ------------------------------------------------
     def _call_async(self, chan: _Chan, method: str, args: dict,
@@ -264,6 +269,8 @@ class AgentSwarm:
 
     def stop(self) -> None:
         self._stopped.set()
+        from nomad_tpu.obs import REGISTRY
+        REGISTRY.deregister(self._obs_token)
         self._wheel.stop()
         for chan in self._chans + self._hb_chans:
             chan.close()

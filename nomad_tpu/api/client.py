@@ -192,6 +192,13 @@ class APIClient:
         data, _ = self.raw("GET", "/v1/agent/monitor", params)
         return data.get("lines", []), int(data.get("offset", 0))
 
+    def agent_metrics(self) -> dict:
+        """The unified metrics document (/v1/agent/metrics):
+        ``providers`` = flattened nomad.* registry gauges, ``inmem`` =
+        the in-memory telemetry sink's counters/gauges/samples."""
+        data, _ = self.get("/v1/agent/metrics")
+        return data
+
     def agent_members(self) -> list:
         data, _ = self.get("/v1/agent/members")
         return data.get("members", [])

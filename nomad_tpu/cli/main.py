@@ -146,6 +146,15 @@ def main(argv=None) -> int:
     p.add_argument("-follow", action="store_true",
                    help="poll for new lines until interrupted")
     sub.add_parser("agent-info", help="agent diagnostics")
+    p = sub.add_parser(
+        "metrics", help="dump the agent's unified metrics registry "
+                        "(/v1/agent/metrics: every component stats() "
+                        "as nomad.* gauges + the in-mem sink)")
+    p.add_argument("-json", dest="as_json", action="store_true",
+                   help="raw JSON document instead of the flat listing")
+    p.add_argument("-filter", default="",
+                   help="only keys containing this substring "
+                        "(e.g. 'broker', 'applier')")
     sub.add_parser("version", help="print version")
 
     p = sub.add_parser(
@@ -610,6 +619,35 @@ def cmd_monitor(args) -> int:
         return 0
 
 
+def cmd_metrics(args) -> int:
+    """Dump the unified metrics registry (obs/registry.py) from a live
+    agent: flat ``key = value`` lines sorted by key (the key grammar is
+    ``nomad.<provider>.<path...>``), or the raw JSON document with
+    -json.  The in-mem sink's counters and sample summaries ride along
+    under ``counters.*`` / ``samples.*``."""
+    from nomad_tpu.obs.registry import flatten
+
+    client = APIClient(args.address)
+    doc = client.agent_metrics()
+    if args.as_json:
+        print(json.dumps(doc, indent=2, default=str))
+        return 0
+    # ONE flattening grammar (obs/registry.flatten) for the inmem doc
+    # too: counters.<key>, gauges.<key>, samples.<key>.<stat>.
+    flat = dict(doc.get("providers") or {})
+    flat.update(flatten(doc.get("inmem") or {}))
+    shown = 0
+    for key in sorted(flat):
+        if args.filter and args.filter not in key:
+            continue
+        print(f"{key} = {flat[key]}")
+        shown += 1
+    if args.filter and not shown:
+        print(f"no metric keys contain {args.filter!r}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def cmd_version(args) -> int:
     print(f"nomad-tpu v{__version__}")
     return 0
@@ -737,6 +775,7 @@ COMMANDS = {
     "client-config": cmd_client_config,
     "monitor": cmd_monitor,
     "agent-info": cmd_agent_info,
+    "metrics": cmd_metrics,
     "version": cmd_version,
     "lint": cmd_lint,
 }

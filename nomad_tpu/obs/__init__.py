@@ -1,0 +1,22 @@
+"""Trace & telemetry plane.
+
+Three cooperating pieces (README "Observability"):
+
+- :mod:`nomad_tpu.obs.trace` — per-eval distributed tracing: a span
+  recorder (lock-cheap per-thread buffers, bounded global ring,
+  seedable ids, monotonic-only timestamps) plus the ``_trace`` RPC
+  envelope, exportable as Chrome-trace/Perfetto JSON.
+- :mod:`nomad_tpu.obs.registry` — the unified metrics registry turning
+  every component ``stats()`` into ``nomad.<provider>.<path>`` gauges,
+  served at ``/v1/agent/metrics`` and via ``nomad-tpu metrics``.
+- :mod:`nomad_tpu.obs.flight` — the flight recorder: on breaker-open,
+  overload entry, or a stall-watchdog trip, dump span ring + thread
+  stacks + metrics snapshot to a bounded on-disk incident file.
+
+Layering: obs imports nothing from nomad_tpu outside ``utils`` — every
+other subsystem may import obs without cycles.
+"""
+from . import flight, registry, trace  # noqa: F401
+from .registry import REGISTRY, MetricsRegistry, flatten  # noqa: F401
+from .trace import TRACE_KEY, Tracer, tracing  # noqa: F401
+from .flight import FlightRecorder, StallWatchdog  # noqa: F401

@@ -1,0 +1,129 @@
+"""Unified metrics registry: every ``stats()`` dict, one namespace.
+
+The runtime grew 14 per-component ``stats()`` providers (applier,
+broker, overload, heartbeat, edge loop, dispatch pool, plan queue,
+breaker, runner, store watch, swarm, ...) that were never exported —
+each bench and test hand-collected the ones it knew about.  The
+registry turns them into one tree:
+
+- ``register(name, fn)`` parks a zero-argument provider returning a
+  (possibly nested) dict; ``snapshot()`` calls every provider and
+  flattens the result into dotted gauges — key grammar
+  ``nomad.<provider>.<path...>`` with nested dicts joined by dots and
+  non-numeric leaves stringified (they publish as labels, not gauges).
+- ``publish(metrics)`` pushes the numeric leaves as gauges into a
+  ``utils/metrics.Metrics`` fanout (in-memory sink + optional statsd),
+  so the existing telemetry plumbing (SIGUSR1 dump, statsd) sees the
+  same numbers with no second producer.
+- A provider that raises is reported under ``nomad.<name>.error``
+  instead of wedging the snapshot — a torn-down component must never
+  take the metrics plane with it (same discipline as
+  ``OverloadController.pressure``).
+
+Instances are cheap and owned: each ``Server`` builds its own (its
+providers close over live components and die with it); the module-
+global :data:`REGISTRY` carries process-wide singletons only (the
+device circuit breaker, a live agent swarm).  ``snapshot(extra=...)``
+merges other registries so the agent's ``/v1/agent/metrics`` endpoint
+serves server + process registries as one document.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+PREFIX = "nomad"
+
+
+def flatten(tree: dict, prefix: str = "") -> dict:
+    """Nested dict -> {"a.b.c": leaf}.  Lists/tuples are summarized by
+    length (a gauge), everything non-numeric is stringified."""
+    out: dict = {}
+    for key, val in tree.items():
+        path = f"{prefix}.{key}" if prefix else str(key)
+        if isinstance(val, dict):
+            out.update(flatten(val, path))
+        elif isinstance(val, (list, tuple)):
+            out[f"{path}.len"] = len(val)
+        elif isinstance(val, bool):
+            out[path] = int(val)
+        elif isinstance(val, (int, float)):
+            out[path] = val
+        else:
+            out[path] = str(val)
+    return out
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._providers: dict = {}   # token -> (name, fn)
+        self._seq = 0
+
+    # -- wiring ------------------------------------------------------------
+    def register(self, name: str, fn: Callable[[], dict]) -> str:
+        """Park a provider; returns a deregistration token.  Names are
+        unique — re-registering a live name replaces it (a restarted
+        component supersedes its predecessor)."""
+        with self._lock:
+            self._seq += 1
+            token = f"p{self._seq}"
+            for tok, (got, _fn) in list(self._providers.items()):
+                if got == name:
+                    del self._providers[tok]
+            self._providers[token] = (name, fn)
+            return token
+
+    def deregister(self, token: str) -> bool:
+        with self._lock:
+            return self._providers.pop(token, None) is not None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._providers.clear()
+
+    def providers(self) -> list:
+        with self._lock:
+            return sorted(name for name, _fn in self._providers.values())
+
+    # -- reading -----------------------------------------------------------
+    def snapshot(self, extra: Optional[list] = None) -> dict:
+        """One flattened ``{dotted_key: value}`` document over every
+        provider (plus the providers of any ``extra`` registries).
+        Providers run OUTSIDE the registry lock — they read other
+        components' locks and must not nest under ours."""
+        with self._lock:
+            providers = list(self._providers.values())
+        if extra:
+            for reg in extra:
+                with reg._lock:
+                    providers.extend(reg._providers.values())
+        out: dict = {}
+        for name, fn in providers:
+            base = f"{PREFIX}.{name}"
+            try:
+                stats = fn()
+            except Exception as e:
+                out[f"{base}.error"] = f"{type(e).__name__}: {e}"
+                continue
+            if not isinstance(stats, dict):
+                out[f"{base}.error"] = "provider returned non-dict"
+                continue
+            out.update(flatten(stats, base))
+        return out
+
+    def publish(self, metrics, extra: Optional[list] = None) -> int:
+        """Push every numeric leaf as a gauge into a utils/metrics
+        fanout; returns the number of gauges set."""
+        snap = self.snapshot(extra=extra)
+        n = 0
+        for key, val in snap.items():
+            if isinstance(val, (int, float)):
+                metrics.set_gauge(key, float(val))
+                n += 1
+        return n
+
+
+# Process-wide singletons only (device breaker, live swarms); component
+# registries are per-owner and die with their owner.
+REGISTRY = MetricsRegistry()

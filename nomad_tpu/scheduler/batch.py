@@ -20,6 +20,7 @@ import numpy as np
 
 from typing import Callable, Optional
 
+from nomad_tpu.obs import trace as trace_mod
 from nomad_tpu.structs import (
     EVAL_STATUS_COMPLETE,
     EVAL_STATUS_FAILED,
@@ -38,6 +39,29 @@ from .util import set_status
 # with no configuration (parallel/mesh.py; single-chip dispatch is
 # untouched).
 _MESH_CACHE: dict = {}
+
+
+def _tnow() -> float:
+    """Tracer-epoch now, 0.0 when tracing is off (obs/trace.py)."""
+    t = trace_mod.tracer()
+    return t.now() if t is not None else 0.0
+
+
+def _lane_spans(name: str, scheds, t0: float, t1: float, **tags) -> None:
+    """One span per lane sharing the window's [t0, t1] — fused stages
+    (dispatch, finish, submit) run once for the whole window, and every
+    member eval's tree records the window it rode (the shared
+    timestamps make the fusion visible in the exported trace)."""
+    tracer = trace_mod.tracer() if trace_mod.ENABLED else None
+    if tracer is None:
+        # Includes a concurrent disable() racing the ENABLED check:
+        # degrade to untraced, never fail the lane.
+        return
+    for sched in scheds:
+        ev = sched.eval
+        if ev is not None and ev.trace:
+            tracer.record(name, t0, t1 - t0, parent_ctx=ev.trace,
+                          eval_id=ev.id, **tags)
 
 
 def _mesh_for(n_lanes: int, n_pad: int):
@@ -127,6 +151,24 @@ class BatchEvalRunner:
         placement-less plan instead of submitting it here (deferred is
         None): the staged pipeline routes even those submits through
         its drain stage so plan-commit order stays eval order."""
+        tracer = trace_mod.tracer() if trace_mod.ENABLED else None
+        if tracer is not None:
+            if not ev.trace:
+                # Harness/bench evals arrive without a server-stamped
+                # anchor: root their tree here so scheduler stages
+                # still form one tree per eval.
+                ev.trace = tracer.anchor("eval.created",
+                                         eval_id=ev.id,
+                                         eval_type=ev.type)
+            t0 = tracer.now()
+            try:
+                return self._begin_eval_inner(ev, finish_noop)
+            finally:
+                tracer.record("sched.begin", t0, tracer.now() - t0,
+                              parent_ctx=ev.trace, eval_id=ev.id)
+        return self._begin_eval_inner(ev, finish_noop)
+
+    def _begin_eval_inner(self, ev: Evaluation, finish_noop: bool = True):
         sched = JaxBinPackScheduler(self.state, self.planner,
                                     batch=(ev.type == "batch"))
         sched.eval = ev
@@ -230,6 +272,7 @@ class BatchEvalRunner:
                 self._process_leftovers(leftovers)
             return
 
+        t_disp = _tnow()
         # Harmonize pad shapes across lanes, stack, one dispatch.
         feasible = np.zeros((B, g_max, statics.n_pad), dtype=bool)
         asks = np.zeros((B, g_max, pending[0][2].asks.shape[1]),
@@ -292,6 +335,8 @@ class BatchEvalRunner:
                     feasible, asks, distinct, counts, penalty,
                     k_cap=k_cap, rounds=rounds)
             chosen_s, score_s = fetch_results(chosen_s, score_s)
+            _lane_spans("sched.dispatch", [s for s, _p, _a in pending],
+                        t_disp, _tnow(), fused=B)
             done = []
             for b, (sched, place, args) in enumerate(pending):
                 chosen, scores = rounds_to_placements(
@@ -311,6 +356,8 @@ class BatchEvalRunner:
                     capacity_d, reserved_d, base_usage, job_counts,
                     feasible, asks, distinct, group_idx, valid, penalty)
             chosen, scores = fetch_results(chosen, scores)
+            _lane_spans("sched.dispatch", [s for s, _p, _a in pending],
+                        t_disp, _tnow(), fused=B)
             self._finish_window(
                 [(sched, place, args, chosen[b], scores[b])
                  for b, (sched, place, args) in enumerate(pending)],
@@ -334,6 +381,7 @@ class BatchEvalRunner:
         n_real = statics.n_real
         done = []
         for sched, place, args in pending:
+            t_disp = _tnow()
             if rounds_ok:
                 chosen_s, score_s, _u = place_rounds_host(
                     statics.capacity, statics.reserved, base_usage,
@@ -348,6 +396,8 @@ class BatchEvalRunner:
                     args.view.job_counts, args.feasible_h, args.asks,
                     args.distinct, args.group_idx, args.valid,
                     float(args.penalty), n_real=n_real)
+            _lane_spans("sched.dispatch", [sched], t_disp, _tnow(),
+                        host=True)
             done.append((sched, place, args, chosen, scores))
         self._finish_window(done, retries)
 
@@ -362,9 +412,13 @@ class BatchEvalRunner:
         self.process(leftovers)
 
     def _run_single(self, sched, place, args, retries=None) -> None:
+        t0 = _tnow()
         handles = sched.dispatch_device(args)
         chosen, scores = sched.collect_device(args, handles)
+        t1 = _tnow()
+        _lane_spans("sched.dispatch", [sched], t0, t1)
         sched.finish_deferred(place, args, chosen, scores)
+        _lane_spans("sched.finish", [sched], t1, _tnow())
         self._finish(sched, retries)
 
     @staticmethod
@@ -380,6 +434,8 @@ class BatchEvalRunner:
         from nomad_tpu.structs import generate_uuids
 
         from .jax_binpack import _native_bulk
+
+        t_fin = _tnow()
 
         uuid_slab = generate_uuids(
             sum(len(place) for _, place, *_ in lanes))
@@ -413,6 +469,8 @@ class BatchEvalRunner:
                             fs, native.bulk_finish(*a))
         for (sched, *_rest), fs in zip(lanes, states):
             sched._finish_python_tail(fs)
+        _lane_spans("sched.finish", [s for s, *_r in lanes],
+                    t_fin, _tnow(), window=len(lanes))
 
     def _finish_window(self, done: list, retries=None) -> None:
         """Windowed finish + group submit for fused lanes
@@ -429,6 +487,7 @@ class BatchEvalRunner:
         order and per-lane status semantics (see ``_finish``).  Uses the
         planner's group path when it has one; per-plan submits
         otherwise."""
+        t_sub = _tnow()
         submitters = []
         for sched in scheds:
             ev = sched.eval
@@ -444,6 +503,8 @@ class BatchEvalRunner:
                 continue
             submitters.append(sched)
         if not submitters:
+            _lane_spans("sched.submit", scheds, t_sub, _tnow(),
+                        window=len(scheds))
             return
         group = getattr(self.planner, "submit_plans", None)
         if group is not None and len(submitters) > 1:
@@ -468,6 +529,8 @@ class BatchEvalRunner:
                 retry = JaxBinPackScheduler(
                     sched.state, self.planner, batch=(ev.type == "batch"))
                 retry.process(ev)
+        _lane_spans("sched.submit", scheds, t_sub, _tnow(),
+                    window=len(scheds))
 
     def _finish(self, sched, retries=None) -> None:
         """Submit the plan; on rejection/partial commit either queue the

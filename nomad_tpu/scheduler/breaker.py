@@ -32,6 +32,8 @@ import logging
 import threading
 import time
 
+from nomad_tpu.obs import flight, registry
+
 logger = logging.getLogger("nomad_tpu.scheduler.breaker")
 
 CLOSED = "closed"
@@ -118,6 +120,7 @@ class DeviceCircuitBreaker:
                     logger.info("device breaker: probe succeeded; closed")
 
     def record_failure(self, probe: bool = False) -> None:
+        opened = False
         with self._lock:
             self._counts["failures"] += 1
             if probe:
@@ -125,18 +128,27 @@ class DeviceCircuitBreaker:
                 self._state = OPEN
                 self._opened_at = self._clock()
                 self._counts["opens"] += 1
+                opened = True
                 logger.warning("device breaker: probe failed; re-opened")
-                return
-            self._consecutive_failures += 1
-            if self._state == CLOSED and \
-                    self._consecutive_failures >= self.failure_threshold:
-                self._state = OPEN
-                self._opened_at = self._clock()
-                self._counts["opens"] += 1
-                logger.warning(
-                    "device breaker: open after %d consecutive device "
-                    "failures; holding executor on host (re-probe in "
-                    "%.1fs)", self._consecutive_failures, self.cooldown)
+            else:
+                self._consecutive_failures += 1
+                if self._state == CLOSED and \
+                        self._consecutive_failures >= \
+                        self.failure_threshold:
+                    self._state = OPEN
+                    self._opened_at = self._clock()
+                    self._counts["opens"] += 1
+                    opened = True
+                    logger.warning(
+                        "device breaker: open after %d consecutive "
+                        "device failures; holding executor on host "
+                        "(re-probe in %.1fs)",
+                        self._consecutive_failures, self.cooldown)
+        if opened and flight.INSTALLED:
+            # Flight-recorder trigger (obs/flight.py), OUTSIDE the
+            # breaker lock: the device executor just went unhealthy —
+            # dump spans + stacks + metrics while the evidence is warm.
+            flight.trip("breaker.open", self.stats())
 
     # -- introspection -----------------------------------------------------
     @property
@@ -166,3 +178,8 @@ class DeviceCircuitBreaker:
 # successive PipelinedEvalRunner instances share trip state by default.
 # Tests wanting isolation pass their own instance.
 GLOBAL_BREAKER = DeviceCircuitBreaker()
+
+# The breaker is exactly the kind of process-wide singleton the global
+# metrics registry exists for: one producer, visible at
+# /v1/agent/metrics as nomad.breaker.* from any colocated agent.
+registry.REGISTRY.register("breaker", GLOBAL_BREAKER.stats)

@@ -56,8 +56,9 @@ import threading
 import time
 
 from nomad_tpu import faultinject
+from nomad_tpu.obs import trace as trace_mod
 
-from .batch import BatchEvalRunner
+from .batch import BatchEvalRunner, _lane_spans, _tnow
 from .breaker import ADMIT_HOST, ADMIT_PROBE, GLOBAL_BREAKER
 
 logger = logging.getLogger("nomad_tpu.scheduler.pipeline")
@@ -157,9 +158,11 @@ class PipelinedEvalRunner(BatchEvalRunner):
         # Optional per-collect watchdog (seconds): None = no watchdog
         # thread (zero overhead; only raised errors trip the breaker).
         self.device_deadline = device_deadline
-        # Evals re-run on host after a device failure: incremented from
-        # BOTH stages (front on dispatch faults, drain on collect
-        # faults), so the += goes through _count_lock.
+        # Evals re-run on host after a device failure.  ONE producer:
+        # every increment goes through _record_rerun (called from both
+        # stages, so it takes _count_lock); the registry exports this
+        # counter and the breaker exports its own transition counts —
+        # no number has two producers (obs/registry.py).
         self.breaker_reruns = 0
         self._count_lock = threading.Lock()
         self.parity_checks = 0    # probe evals parity-asserted host/dev
@@ -169,6 +172,18 @@ class PipelinedEvalRunner(BatchEvalRunner):
         self._collect_worker: "_CollectWorker | None" = None
         self._err_lock = threading.Lock()
         self._drain_err: BaseException | None = None
+        # Registry provider (obs/registry.py): the LIVE runner's stats
+        # under nomad.runner.* — replace-on-name keeps exactly one, and
+        # the weakref means a retired runner is never pinned (its state
+        # snapshot is a whole store generation) just to serve metrics.
+        import weakref
+
+        from nomad_tpu.obs import REGISTRY
+        ref = weakref.ref(self)
+        REGISTRY.register(
+            "runner",
+            lambda: (lambda r: r.stats() if r is not None else {})(
+                ref()))
 
     def process(self, evals: list) -> None:
         from nomad_tpu.utils.gctune import gc_pause
@@ -203,11 +218,14 @@ class PipelinedEvalRunner(BatchEvalRunner):
                     q.put(_Item(sched, None, None, None, start))
                     continue
                 place, args = sched.deferred
+                t_disp = _tnow()
                 handles, probe = self._dispatch(sched, args)
                 if sched.dispatched_host:
                     self.host_dispatches += 1
                 else:
                     self.device_dispatches += 1
+                _lane_spans("sched.dispatch", [sched], t_disp, _tnow(),
+                            host=sched.dispatched_host)
                 times["dispatch"] += time.perf_counter() - t_begin
                 q.put(_Item(sched, place, args, handles, start,
                             probe=probe))
@@ -253,10 +271,31 @@ class PipelinedEvalRunner(BatchEvalRunner):
             logger.warning("device dispatch failed; re-running eval on "
                            "the host twin", exc_info=True)
             self.breaker.record_failure(probe=probe)
-            with self._count_lock:
-                self.breaker_reruns += 1
+            self._record_rerun()
             sched.dispatched_host = True
             return sched.dispatch_host(args), False
+
+    def _record_rerun(self) -> None:
+        """The single producer of ``breaker_reruns`` (cross-thread:
+        front stage on dispatch faults, drain stage on collect faults)."""
+        with self._count_lock:
+            self.breaker_reruns += 1
+
+    def stats(self) -> dict:
+        """Registry provider (obs/registry.py): the runner's dispatch
+        mix, stage walls, windows, and breaker interactions."""
+        with self._count_lock:
+            reruns = self.breaker_reruns
+        return {
+            "host_dispatches": self.host_dispatches,
+            "device_dispatches": self.device_dispatches,
+            "breaker_reruns": reruns,
+            "parity_checks": self.parity_checks,
+            "evals": len(self.latencies),
+            "windows": len(self.windows),
+            "stage_times_ms": {k: round(v * 1000.0, 3)
+                               for k, v in self.stage_times.items()},
+        }
 
     # -- drain stage ------------------------------------------------------
     def _drain_loop(self, q: queue.Queue) -> None:
@@ -307,7 +346,9 @@ class PipelinedEvalRunner(BatchEvalRunner):
         work = [it for it in window if it.handles is not None]
         results = {}
         for it in work:
+            t_col = _tnow()
             results[id(it)] = self._collect_item(it)
+            _lane_spans("sched.collect", [it.sched], t_col, _tnow())
         t1 = time.perf_counter()
         times["collect"] += t1 - t0
 
@@ -345,8 +386,7 @@ class PipelinedEvalRunner(BatchEvalRunner):
             logger.warning("device collect failed (%s); re-running eval "
                            "on the host twin", e)
             self.breaker.record_failure(probe=it.probe)
-            with self._count_lock:
-                self.breaker_reruns += 1
+            self._record_rerun()
             return self._host_rerun(it)
         if it.probe:
             host = self._host_rerun(it)

@@ -18,6 +18,7 @@ import threading
 from typing import Optional
 
 from nomad_tpu import faultinject
+from nomad_tpu.obs import trace as trace_mod
 from nomad_tpu.structs import Allocation, Evaluation, Job, Node
 
 from . import mux
@@ -88,23 +89,41 @@ class Endpoints:
         point is that rejecting is radically cheaper than serving."""
         def admitted(args: dict):
             overload_mod.stamp_arrival(args)
-            if "_watch_fired" in args:
-                # A resumed parked blocking query was admitted when it
-                # arrived; it is NOT a new arrival.  Re-admitting here
-                # could shed an already-accepted request mid-wait with
-                # ErrOverloaded instead of the answered-with-current-
-                # state reply the blocking-query contract guarantees
-                # (and would double-fire the rpc.admit site per logical
-                # request).  stamp_arrival is idempotent, so the
-                # original envelope deadline survives the resume.
-                return handler(args)
-            if faultinject.ACTIVE:
-                faultinject.fire_rpc("rpc.admit", method, args)
-            ctrl = self.server.overload
-            if ctrl is not None:
-                ctrl.admit_rpc(method, args)  # raises ErrOverloaded
-            return handler(args)
+            # Re-fetch AND None-check behind the ENABLED gate: a
+            # concurrent disable() (scoped tracing in tests/bench)
+            # must degrade an in-flight request to untraced, never
+            # fail it (same discipline at every instrumentation site).
+            tracer = trace_mod.tracer() if trace_mod.ENABLED else None
+            if tracer is not None:
+                # Serve span, parented to the wire envelope's client
+                # span (obs/trace.py).  Ambient for the handler body:
+                # evals created inside anchor under it, and in-proc
+                # call chains (job_register -> apply_eval_update) nest.
+                with tracer.span("rpc.serve." + method,
+                                 ctx=trace_mod.extract(args),
+                                 method=method):
+                    return self._admitted_body(method, handler, args)
+            return self._admitted_body(method, handler, args)
         return admitted
+
+    def _admitted_body(self, method: str, handler, args: dict):
+        """The admission body behind the (optional) serve span."""
+        if "_watch_fired" in args:
+            # A resumed parked blocking query was admitted when it
+            # arrived; it is NOT a new arrival.  Re-admitting here
+            # could shed an already-accepted request mid-wait with
+            # ErrOverloaded instead of the answered-with-current-
+            # state reply the blocking-query contract guarantees
+            # (and would double-fire the rpc.admit site per logical
+            # request).  stamp_arrival is idempotent, so the
+            # original envelope deadline survives the resume.
+            return handler(args)
+        if faultinject.ACTIVE:
+            faultinject.fire_rpc("rpc.admit", method, args)
+        ctrl = self.server.overload
+        if ctrl is not None:
+            ctrl.admit_rpc(method, args)  # raises ErrOverloaded
+        return handler(args)
 
     def _with_leader_reads(self, method: str, handler):
         """Default-consistent reads (reference nomad/rpc.go:175-185): a

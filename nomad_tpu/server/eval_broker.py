@@ -43,6 +43,7 @@ import time
 from typing import Optional
 
 from nomad_tpu import faultinject
+from nomad_tpu.obs import trace as trace_mod
 from nomad_tpu.structs import Evaluation, generate_uuid
 
 from .overload import ErrOverloaded
@@ -108,6 +109,9 @@ class EvalBroker:
         self._deadlines: dict = {}   # eval id -> absolute monotonic deadline
         self._expired_drops = 0      # deadline-expired evals never delivered
         self._depth_sheds = 0        # enqueues refused by the hard bound
+        self._trace_enq: dict = {}   # eval id -> tracer-epoch ready time
+        #   (obs/trace.py: the broker.wait span's t0; stamped per
+        #    _enqueue_locked so nack redeliveries re-time their wait)
 
     # -- lifecycle --------------------------------------------------------
     def enabled(self) -> bool:
@@ -133,6 +137,7 @@ class EvalBroker:
             self._unack.clear()
             self._time_wait.clear()
             self._deadlines.clear()
+            self._trace_enq.clear()
             self._cond.notify_all()
 
     # -- enqueue ----------------------------------------------------------
@@ -198,6 +203,11 @@ class EvalBroker:
     def _enqueue_locked(self, ev: Evaluation, queue: str) -> None:
         if not self._enabled:
             return
+        tracer = trace_mod.tracer() if trace_mod.ENABLED else None
+        if tracer is not None and ev.trace:
+            # broker.wait t0: (re-)stamped per (re-)enqueue so a nack
+            # redelivery's wait span times ITS wait, not the first's.
+            self._trace_enq[ev.id] = tracer.now()
         pending = self._job_evals.get(ev.job_id)
         if pending is None:
             self._job_evals[ev.job_id] = ev.id
@@ -286,6 +296,13 @@ class EvalBroker:
             self._unack[ev.id] = _Unack(ev, token, timer)
             self._evals[ev.id] = self._evals.get(ev.id, 0) + 1
             timer.start()
+            tracer = trace_mod.tracer() if trace_mod.ENABLED else None
+            if tracer is not None and ev.trace:
+                t0 = self._trace_enq.pop(ev.id, None)
+                if t0 is not None:
+                    tracer.record("broker.wait", t0, tracer.now() - t0,
+                                  parent_ctx=ev.trace, eval_id=ev.id,
+                                  queue=best_sched)
             return ev, token
 
     def _nack_timer_fired(self, eval_id: str, token: str) -> None:
@@ -318,6 +335,7 @@ class EvalBroker:
             del self._unack[eval_id]
             self._evals.pop(eval_id, None)
             self._job_evals.pop(job_id, None)
+            self._trace_enq.pop(eval_id, None)
 
             blocked = self._blocked.get(job_id)
             if blocked and len(blocked):

@@ -50,6 +50,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from nomad_tpu.obs import flight
 from nomad_tpu.utils.retry import OVERLOADED_MARKER
 
 # -- states -----------------------------------------------------------------
@@ -224,6 +225,8 @@ class OverloadController:
         self._admitted: dict = {c: 0 for c in PRIORITY_CLASSES}
         self._heartbeat_lane = 0
         self._transitions = 0
+        self._trip_pending = False   # *->OVERLOAD edge awaiting a
+        #   flight-recorder dump (fired outside the lock; guarded)
 
     # -- wiring ------------------------------------------------------------
     def add_source(self, name: str, fn: Callable) -> None:
@@ -285,12 +288,33 @@ class OverloadController:
                     self._state = NORMAL
         if self._state != prev:
             self._transitions += 1
+            if self._state == OVERLOAD and flight.INSTALLED:
+                # Flight-recorder trigger: entering the shedding state
+                # is exactly when the evidence (queue depths, span
+                # ring, stacks) is worth freezing.  The dump itself
+                # runs OUTSIDE this lock (file I/O) — see _maybe_trip.
+                self._trip_pending = True
         return self._state
+
+    def _maybe_trip(self) -> None:
+        """Fire a pending overload-entry flight dump outside the lock.
+        Gated on the module bool FIRST: with no recorder installed the
+        flag can never be set, and state() sits on the hottest
+        admission path — it must not pay a second lock acquire for a
+        feature that is off (the breaker's trip-site discipline)."""
+        if not flight.INSTALLED:
+            return
+        with self._lock:
+            fire, self._trip_pending = self._trip_pending, False
+        if fire:
+            flight.trip("overload.enter", self.stats())
 
     def state(self) -> str:
         p = self.pressure()
         with self._lock:
-            return self._refresh_locked(p)
+            st = self._refresh_locked(p)
+        self._maybe_trip()
+        return st
 
     def in_brownout(self) -> bool:
         """True in brownout OR overload: the TTL wheel defers expiry in
@@ -337,7 +361,7 @@ class OverloadController:
         pressure = self.pressure()
         with self._lock:
             state = self._refresh_locked(pressure)
-            return {
+            out = {
                 "state": state,
                 "pressure": round(pressure, 4),
                 "shed": dict(self._shed),
@@ -345,6 +369,10 @@ class OverloadController:
                 "heartbeat_lane": self._heartbeat_lane,
                 "transitions": self._transitions,
             }
+        # NOT _maybe_trip: the flight dump itself snapshots stats();
+        # firing from here would recurse.  The state() path (every
+        # admission consults it) fires pending dumps promptly.
+        return out
 
     def shed_count(self) -> int:
         with self._lock:

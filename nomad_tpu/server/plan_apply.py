@@ -26,6 +26,8 @@ from nomad_tpu.structs import (
     remove_allocs,
 )
 
+from nomad_tpu.obs import flight as flight_mod
+from nomad_tpu.obs import trace as trace_mod
 from nomad_tpu.utils.metrics import metrics
 
 logger = logging.getLogger("nomad_tpu.server.plan_apply")
@@ -306,6 +308,10 @@ class PlanApplier:
     to batches (the next window verifies against the in-flight window's
     overlay)."""
 
+    # A verify+commit window past this wall is a wedged leader, not a
+    # big window: trip the flight recorder (when one is installed).
+    WINDOW_STALL_S = 30.0
+
     def __init__(self, plan_queue, eval_broker, raft, state_fn,
                  max_window: int = 64) -> None:
         self.plan_queue = plan_queue
@@ -348,8 +354,16 @@ class PlanApplier:
             window = [pending]
             window += self.plan_queue.drain_pending(self.max_window - 1)
             try:
-                wait_future, snap = self._apply_window(window, wait_future,
-                                                       snap)
+                # Stall watchdog (obs/flight.py): a window that
+                # overstays WINDOW_STALL_S trips an incident dump with
+                # the applier's stack in it — the leader's serialized
+                # commit point wedging is exactly the failure that is
+                # undebuggable after the fact.  No-op when no flight
+                # recorder is installed.
+                with flight_mod.guard("applier.window",
+                                      self.WINDOW_STALL_S):
+                    wait_future, snap = self._apply_window(
+                        window, wait_future, snap)
             except Exception as e:
                 # Popped futures must ALWAYS be responded: an applier
                 # dying with them in hand would park their workers
@@ -416,6 +430,17 @@ class PlanApplier:
         pendings = [p for p in window if self._fence(p)]
         if not pendings:
             return wait_future, snap
+        tracer = trace_mod.tracer() if trace_mod.ENABLED else None
+        if tracer is not None:
+            # Queue-wait spans: enqueue (PlanFuture.trace_t0) -> window
+            # pop, one per plan, parented to the plan's eval anchor.
+            now = tracer.now()
+            for pend in pendings:
+                if pend.plan.trace and pend.trace_t0 is not None:
+                    tracer.record("plan.queued", pend.trace_t0,
+                                  now - pend.trace_t0,
+                                  parent_ctx=pend.plan.trace,
+                                  eval_id=pend.plan.eval_id)
 
         # If the previous apply finished, drop the stale overlay; else
         # keep verifying against the optimistic view (this is the
@@ -427,7 +452,21 @@ class PlanApplier:
         if snap is None:
             snap = OptimisticSnapshot(self.state_fn().snapshot())
 
+        t_verify = tracer.now() if tracer is not None else 0.0
         outcomes = evaluate_window(snap, [p.plan for p in pendings])
+        if tracer is not None:
+            # One window verify, one span per member plan (shared
+            # t0/dur, tagged with the window size): every eval's tree
+            # records the verify IT rode, and the shared timestamps
+            # make the group-commit amortization visible in the trace.
+            dur_verify = tracer.now() - t_verify
+            for pending, outcome in zip(pendings, outcomes):
+                if pending.plan.trace:
+                    tracer.record("applier.verify", t_verify, dur_verify,
+                                  parent_ctx=pending.plan.trace,
+                                  eval_id=pending.plan.eval_id,
+                                  window=len(pendings),
+                                  fallback=outcome.fallback)
         committers = []  # (pending, result) with state to commit
         fallbacks = 0
         for pending, outcome in zip(pendings, outcomes):
@@ -472,13 +511,25 @@ class PlanApplier:
         alloc_lists = [_accepted_allocs(result)
                        for _pending, result in committers]
         if len(committers) == 1:
-            entry = codec.encode(
-                codec.ALLOC_UPDATE_REQUEST,
-                encode_alloc_update(alloc_lists[0]))
+            msg_type, payload = (codec.ALLOC_UPDATE_REQUEST,
+                                 encode_alloc_update(alloc_lists[0]))
         else:
-            entry = codec.encode(
-                codec.PLAN_BATCH_APPLY_REQUEST,
-                encode_plan_batch(alloc_lists))
+            msg_type, payload = (codec.PLAN_BATCH_APPLY_REQUEST,
+                                 encode_plan_batch(alloc_lists))
+        t_apply = 0.0
+        if tracer is not None:
+            # Ship each sub-plan's context INSIDE the log entry (the
+            # `_trace` payload key, ignored by decode): the FSM decode
+            # and the batched store upsert run on the raft thread — or
+            # on a follower — with no ambient context, and this is how
+            # their spans join each eval's tree.
+            env = [dict(pend.plan.trace, eval_id=pend.plan.eval_id)
+                   if pend.plan.trace else None
+                   for pend, _result in committers]
+            if any(e is not None for e in env):
+                payload["_trace"] = env
+            t_apply = tracer.now()
+        entry = codec.encode(msg_type, payload)
         try:
             future = self.raft.apply(entry)
         except Exception as e:
@@ -494,13 +545,24 @@ class PlanApplier:
         # From here the entry is committed (or committing): failures in
         # the bookkeeping below must not surface as plan errors — the
         # worker would retry an already-applied plan and double-place.
-        def respond(fut=future, members=committers) -> None:
+        def respond(fut=future, members=committers, t0=t_apply,
+                    tr=tracer) -> None:
             try:
                 index, _ = fut.wait()
             except Exception as e:
                 for pend, _res in members:
                     pend.respond(None, e)
                 return
+            if tr is not None:
+                # raft.apply dispatch -> committed, one span per member
+                # plan (shared t0/dur, like the verify spans).
+                dur = tr.now() - t0
+                for pend, _res in members:
+                    if pend.plan.trace:
+                        tr.record("raft.apply", t0, dur,
+                                  parent_ctx=pend.plan.trace,
+                                  eval_id=pend.plan.eval_id,
+                                  window=len(members), index=index)
             for pend, res in members:
                 res.alloc_index = index
                 pend.respond(res, None)

@@ -13,6 +13,7 @@ import itertools
 import threading
 from typing import Optional
 
+from nomad_tpu.obs import trace as trace_mod
 from nomad_tpu.structs import Plan, PlanResult
 
 from .overload import ErrOverloaded
@@ -26,6 +27,9 @@ class PlanFuture:
         self._event = threading.Event()
         self._result: Optional[PlanResult] = None
         self._error: Optional[Exception] = None
+        # obs/trace.py: tracer-epoch enqueue time; the applier times
+        # the plan.queued span (enqueue -> window pop) from it.
+        self.trace_t0: Optional[float] = None
 
     def respond(self, result: Optional[PlanResult],
                 error: Optional[Exception] = None) -> None:
@@ -86,6 +90,9 @@ class PlanQueue:
                 raise ErrOverloaded(
                     f"plan queue at depth bound {self.max_depth}")
             future = PlanFuture(plan)
+            tracer = trace_mod.tracer() if trace_mod.ENABLED else None
+            if tracer is not None and plan.trace:
+                future.trace_t0 = tracer.now()
             heapq.heappush(self._heap,
                            (-plan.priority, next(self._count), future))
             self._cond.notify_all()

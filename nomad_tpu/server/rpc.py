@@ -43,6 +43,7 @@ from typing import Callable, Optional
 import msgpack
 
 from nomad_tpu import faultinject
+from nomad_tpu.obs import trace as trace_mod
 from nomad_tpu.utils.retry import OVERLOADED_MARKER
 from nomad_tpu.utils.sync import Immutable
 
@@ -911,6 +912,17 @@ class ConnPool:
             # retry loops re-send the same args dict.
             args = dict(args, _deadline=timeout)
         address = (address[0], address[1])
+        if trace_mod.ENABLED:
+            # Trace envelope, beside the deadline: ship the context and
+            # record one client span per attempt (a retry is a new
+            # attempt, a new span, same trace) — obs/trace.client_call.
+            with trace_mod.client_call(method, args) as args:
+                return self._dispatch_call(address, method, args,
+                                           timeout)
+        return self._dispatch_call(address, method, args, timeout)
+
+    def _dispatch_call(self, address: tuple, method: str, args: dict,
+                       timeout: Optional[float]):
         if self.multiplex:
             return self._call_mux(address, method, args, timeout)
         conn = self._checkout(address)

@@ -26,6 +26,8 @@ from nomad_tpu.structs import (
     generate_uuid,
 )
 
+from nomad_tpu.obs import trace as obs_trace
+
 from .eval_broker import FAILED_QUEUE, EvalBroker
 from .fsm import NomadFSM
 from .plan_apply import PlanApplier
@@ -278,6 +280,43 @@ class Server:
             )
 
         self._setup_workers()
+        self._setup_obs_registry()
+
+    def _setup_obs_registry(self) -> None:
+        """The unified metrics registry (obs/registry.py): every
+        component ``stats()`` becomes a ``nomad.<provider>.*`` gauge
+        tree, served at /v1/agent/metrics by a colocated agent and
+        dumpable via `nomad-tpu metrics`.  Per-server instance: the
+        providers close over THIS server's components and the registry
+        dies with it (the process-global REGISTRY carries only process
+        singletons like the device breaker)."""
+        from nomad_tpu.obs import MetricsRegistry
+
+        # Importing the breaker registers the process-global
+        # nomad.breaker.* provider in obs.REGISTRY (it would otherwise
+        # only appear once the scheduler pipeline first loads).
+        from nomad_tpu.scheduler import breaker as _breaker  # noqa: F401
+
+        reg = MetricsRegistry()
+        reg.register("broker", self.eval_broker.stats)
+        reg.register("plan_queue", self.plan_queue.stats)
+        reg.register("applier", self.plan_applier.stats)
+        reg.register("overload", self.overload.stats)
+        reg.register("heartbeat", self.heartbeats.stats)
+        # fsm.state is REPLACED on snapshot restore: resolve per read.
+        reg.register("store", lambda: self.fsm.state.stats())
+        reg.register("workers", self._worker_stats)
+        if self.rpc_server is not None:
+            reg.register("rpc", self.rpc_server.stats)
+        self.obs_registry = reg
+
+    def _worker_stats(self) -> dict:
+        """Aggregate worker-pool provider: per-stage deadline drops
+        live on each worker; the registry wants one producer."""
+        return {
+            "count": len(self.workers),
+            "expired_drops": sum(w.expired_drops for w in self.workers),
+        }
 
     def _gossip_join(self, member) -> None:
         """A server joined the gossip pool: record its region for
@@ -515,6 +554,9 @@ class Server:
         # deregistered every parked long-poll; this reaps the shared
         # timeout wheel and answers any straggler as timed out.
         self.fsm.state.watch.shutdown()
+        # Drop the metrics providers: their closures hold live
+        # components and a snapshot of a torn-down server is noise.
+        self.obs_registry.clear()
 
     def _restore_eval_broker(self) -> None:
         """Broker is volatile; state is durable.  Re-enqueue all
@@ -606,6 +648,23 @@ class Server:
             if ok and held != token:
                 raise PermissionError(
                     f"eval {ev.id} token does not match outstanding token")
+        tracer = obs_trace.tracer() if obs_trace.ENABLED else None
+        if tracer is not None:
+            # Anchor every freshly created eval (obs/trace.py): the
+            # anchor span is the single root all of this eval's spans —
+            # broker wait, scheduler stages, plan commit, store upsert,
+            # on any thread or after any retry — descend from.  Parent
+            # is the ambient context (the serving RPC's span, or the
+            # creating eval's context for rolling/next evals), so the
+            # tree hangs off the agent edge.  This is the one choke
+            # point every server-side eval creation path funnels
+            # through; evals arriving with a context keep it.
+            for ev in evals:
+                if not ev.trace and not ev.terminal_status():
+                    ev.trace = tracer.anchor(
+                        "eval.created", parent_ctx=tracer.ctx(),
+                        eval_id=ev.id, eval_type=ev.type,
+                        triggered_by=ev.triggered_by)
         return self.raft_apply(
             codec.EVAL_UPDATE_REQUEST,
             {"evals": [e.to_dict() for e in evals]})

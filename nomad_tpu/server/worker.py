@@ -19,6 +19,7 @@ import threading
 import time
 from typing import Optional
 
+from nomad_tpu.obs import trace as trace_mod
 from nomad_tpu.scheduler import new_scheduler
 from nomad_tpu.utils.metrics import metrics
 from nomad_tpu.utils.retry import Backoff
@@ -139,8 +140,11 @@ class Worker:
 
         if self._delivery_deadline and \
                 time.monotonic() > self._delivery_deadline:
+            # One producer per number: the struct counter is exported
+            # by the metrics registry (obs/registry.py) as
+            # nomad.workers.expired_drops — the go-metrics counter this
+            # used to double-produce is gone.
             self.expired_drops += 1
-            metrics.incr_counter("nomad.worker.expired_drops")
             raise ErrDeadlineExceeded(
                 f"delivery of eval {ev.id} outlived the nack window")
 
@@ -155,6 +159,21 @@ class Worker:
             time.sleep(0.005)
 
     def _invoke_scheduler(self, ev: Evaluation) -> None:
+        # tracer() re-checked for None behind the gate: a concurrent
+        # disable() degrades this invoke to untraced, never fails it.
+        tracer = trace_mod.tracer() if trace_mod.ENABLED else None
+        if tracer is not None and ev.trace:
+            # The eval's scheduling span, rooted under its anchor; the
+            # context is ambient for the whole invoke so plan submits
+            # and follow-up eval creations nest into the same tree.
+            with tracer.attach(ev.trace):
+                with tracer.span("worker.invoke", eval_id=ev.id,
+                                 eval_type=ev.type):
+                    self._invoke_scheduler_inner(ev)
+            return
+        self._invoke_scheduler_inner(ev)
+
+    def _invoke_scheduler_inner(self, ev: Evaluation) -> None:
         start = time.perf_counter()
         state = self.server.fsm.state.snapshot()
         name = self.scheduler_override or ev.type

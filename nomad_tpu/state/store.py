@@ -493,6 +493,21 @@ class StateStore(_ReadMixin):
         generation, swap atomically on commit."""
         return StateRestore(self)
 
+    def stats(self) -> dict:
+        """Registry provider (obs/registry.py): table sizes, per-table
+        indexes, changelog length, and the watch fan-out's gauges —
+        the store's share of /v1/agent/metrics."""
+        with self._lock:
+            t = self._t
+            out = {
+                "tables": {name: len(table)
+                           for name, table in t.tables.items()},
+                "indexes": dict(t.indexes),
+                "alloc_log": len(t.alloc_log),
+            }
+        out["watch"] = self.watch.stats()
+        return out
+
     # -- write plumbing ---------------------------------------------------
     def _writable_table(self, name: str) -> dict:
         if self._gen_shared:

@@ -692,6 +692,12 @@ class Evaluation(_Struct):
     previous_eval: str = ""
     create_index: int = 0
     modify_index: int = 0
+    # Trace context (obs/trace.py): {"trace_id", "span_id"} of the
+    # eval's anchor span, stamped at creation by the serving endpoint
+    # and carried across the raft wire so broker/worker/applier spans
+    # on any thread (or server) join the same tree.  Empty when tracing
+    # is off.
+    trace: dict = field(default_factory=dict)
 
     def terminal_status(self) -> bool:
         return self.status in (EVAL_STATUS_COMPLETE, EVAL_STATUS_FAILED)
@@ -708,6 +714,9 @@ class Evaluation(_Struct):
             eval_id=self.id,
             priority=self.priority,
             all_at_once=bool(job.all_at_once) if job else False,
+            # The plan joins its eval's span tree: queue/verify/commit
+            # spans parent to the eval's anchor.
+            trace=dict(self.trace),
         )
 
     def next_rolling_eval(self, wait: float) -> "Evaluation":
@@ -749,6 +758,10 @@ class Plan(_Struct):
     # Host-local only — the Plan.Submit endpoint re-stamps it from the
     # RPC envelope's relative budget, never trusting a wire value.
     deadline: float = 0.0
+    # Trace context (obs/trace.py): the owning eval's anchor, stamped
+    # by Evaluation.make_plan and carried through Plan.Submit so the
+    # leader's queue-wait/verify/raft/upsert spans join the tree.
+    trace: dict = field(default_factory=dict)
 
     def append_update(self, alloc: Allocation, status: str, desc: str) -> None:
         new = alloc.copy()

@@ -15,12 +15,30 @@ from typing import Optional
 
 
 class InmemSink:
-    """Aggregating in-memory sink (intervals collapsed to one window)."""
+    """Aggregating in-memory sink with interval-windowed samples.
 
-    def __init__(self) -> None:
+    Counters and gauges are cumulative/last-write (go-metrics
+    semantics).  Samples are bucketed into ``interval``-second windows
+    and only the newest ``retain`` windows feed the percentile summary:
+    a latency spike from an hour ago must age OUT of the reported p99
+    (the forever-cumulative version served stale percentiles for the
+    life of the process).  Per-window sample count is bounded
+    (``max_per_interval``, newest kept) so a storm cannot grow the sink
+    without bound.  ``clock`` is injectable for deterministic tests."""
+
+    def __init__(self, interval: float = 10.0, retain: int = 6,
+                 max_per_interval: int = 4096,
+                 clock=time.monotonic) -> None:
+        if interval <= 0 or retain < 1:
+            raise ValueError("want interval > 0 and retain >= 1")
+        self.interval = interval
+        self.retain = retain
+        self.max_per_interval = max_per_interval
+        self._clock = clock
         self._lock = threading.Lock()
         self.counters: dict = defaultdict(float)
         self.gauges: dict = {}
+        # key -> [[interval_index, [values...]], ...] newest last.
         self.samples: dict = defaultdict(list)
 
     def incr_counter(self, key: str, value: float) -> None:
@@ -31,18 +49,36 @@ class InmemSink:
         with self._lock:
             self.gauges[key] = value
 
+    def _interval_index(self) -> int:
+        return int(self._clock() / self.interval)
+
     def add_sample(self, key: str, value: float) -> None:
+        now_idx = self._interval_index()
         with self._lock:
-            samples = self.samples[key]
-            samples.append(value)
-            if len(samples) > 4096:
-                del samples[: len(samples) - 4096]
+            windows = self.samples[key]
+            if not windows or windows[-1][0] != now_idx:
+                windows.append([now_idx, []])
+                # Age out everything beyond the retained window count.
+                if len(windows) > self.retain:
+                    del windows[: len(windows) - self.retain]
+            bucket = windows[-1][1]
+            bucket.append(value)
+            if len(bucket) > self.max_per_interval:
+                del bucket[: len(bucket) - self.max_per_interval]
 
     def snapshot(self) -> dict:
+        now_idx = self._interval_index()
         with self._lock:
+            oldest_live = now_idx - self.retain + 1
             out = {"counters": dict(self.counters),
                    "gauges": dict(self.gauges), "samples": {}}
-            for key, values in self.samples.items():
+            for key, windows in self.samples.items():
+                values: list = []
+                for idx, bucket in windows:
+                    # Windows are pruned on WRITE; a key nobody has
+                    # sampled recently still ages out on read.
+                    if idx >= oldest_live:
+                        values.extend(bucket)
                 if not values:
                     continue
                 ordered = sorted(values)

@@ -60,7 +60,11 @@ def stop_device_trace() -> str:
 
 
 def active_trace_dir() -> Optional[str]:
-    return _trace_dir
+    # Under _lock like every other _trace_dir access: a bare read could
+    # observe a torn start/stop transition from another thread (and the
+    # lockcheck gate rightly flags guarded attrs read unlocked).
+    with _lock:
+        return _trace_dir
 
 
 class device_trace:

@@ -34,6 +34,35 @@ def test_inmem_sample_ring_bounded():
     assert sink.snapshot()["samples"]["k"]["count"] == 4096
 
 
+def test_inmem_samples_are_interval_windowed():
+    """ISSUE 10 satellite: percentiles age OUT — a latency spike from
+    many intervals ago must not pin the reported p99 forever (the old
+    sink was forever-cumulative)."""
+    now = [0.0]
+    sink = InmemSink(interval=10.0, retain=3, clock=lambda: now[0])
+    sink.add_sample("lat", 9.0)       # the ancient spike
+    now[0] = 15.0
+    for _ in range(10):
+        sink.add_sample("lat", 0.001)
+    s = sink.snapshot()["samples"]["lat"]
+    assert s["count"] == 11 and s["p99"] == 9.0  # spike still in window
+    now[0] = 45.0   # both earlier windows aged past the 3-interval horizon
+    sink.add_sample("lat", 0.002)
+    s = sink.snapshot()["samples"]["lat"]
+    assert s["max"] < 1.0, "stale p99 never aged out"
+    assert s["count"] == 1            # only the live window reports
+
+
+def test_inmem_windows_age_out_on_read_too():
+    """A key nobody samples anymore still drops off the summary once
+    its windows pass out of the retained horizon."""
+    now = [0.0]
+    sink = InmemSink(interval=10.0, retain=2, clock=lambda: now[0])
+    sink.add_sample("old", 1.0)
+    now[0] = 100.0
+    assert "old" not in sink.snapshot()["samples"]
+
+
 def test_statsd_wire_format():
     rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
     rx.bind(("127.0.0.1", 0))

@@ -370,6 +370,49 @@ class TestLintGate:
                        for e in allowlist), \
             "columnar contract must not need allowlist entries"
 
+    def test_obs_plane_rides_the_gates(self):
+        """ISSUE 10 satellite: the trace & telemetry plane — the span
+        tracer (obs/trace.py), the unified metrics registry
+        (obs/registry.py), the flight recorder + stall watchdog
+        (obs/flight.py), and the trace threading through rpc/broker/
+        applier/fsm — is inside every gate's scan set, strict-clean,
+        with zero allowlist entries of its own."""
+        from nomad_tpu.analysis import (default_package_root,
+                                        load_allowlist)
+        from nomad_tpu.analysis.callgraph import CallGraph
+
+        pkg = default_package_root()
+        graph = CallGraph.build(pkg)
+        for qual in (
+            "nomad_tpu.obs.trace:Tracer.record",
+            "nomad_tpu.obs.trace:Tracer.snapshot",
+            "nomad_tpu.obs.trace:Tracer._append",
+            "nomad_tpu.obs.trace:Tracer.chrome_trace",
+            "nomad_tpu.obs.registry:MetricsRegistry.register",
+            "nomad_tpu.obs.registry:MetricsRegistry.snapshot",
+            "nomad_tpu.obs.registry:flatten",
+            "nomad_tpu.obs.flight:FlightRecorder.record",
+            "nomad_tpu.obs.flight:StallWatchdog._run",
+            "nomad_tpu.obs.flight:StallWatchdog.stop",
+            "nomad_tpu.server.fsm:NomadFSM._record_apply_spans",
+            "nomad_tpu.server.server:Server._setup_obs_registry",
+        ):
+            assert qual in graph.functions, \
+                f"{qual} missing from the interprocedural graph"
+
+        allowlist = load_allowlist(default_allowlist_path())
+        gating, _allowed, _stale = partition_findings(
+            run_lint(strict=True), allowlist)
+        touching = [f for f in gating if "nomad_tpu/obs" in f.path
+                    or f.path.startswith("obs/") or "/obs/" in f.path]
+        assert touching == [], \
+            "obs plane must lint clean:\n" + \
+            "\n".join(f.render() for f in touching)
+        assert not any("obs/" in e or "Tracer" in e or
+                       "FlightRecorder" in e or "StallWatchdog" in e
+                       for e in allowlist), \
+            "obs plane must not need allowlist entries"
+
     def test_fixed_sleep_ratchet_is_clean(self):
         """Every fixed time.sleep in the test tree is either converted
         to wait_until or carries a '# sleep-ok: why' justification —
