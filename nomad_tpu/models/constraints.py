@@ -53,18 +53,41 @@ def compile_constraint_mask(fleet: FleetStatics, c: Constraint) -> np.ndarray:
         return mask
 
     mask = np.zeros(fleet.n_pad, dtype=bool)
-    for i in range(fleet.n_real):
-        node = fleet.nodes[i]
-        l_val, ok = resolve_constraint_target(c.l_target, node)
-        if not ok:
-            continue
-        r_val, ok = resolve_constraint_target(c.r_target, node)
-        if not ok:
-            continue
-        mask[i] = check_constraint_values(_mask_ctx, c.operand, l_val, r_val)
+    if fleet.uniform and fleet.n_real and _targets_uniform(c):
+        # Uniform fleet (NodeSlab-backed, shared attributes/meta/class/
+        # dc): the predicate's verdict on ONE representative row holds
+        # for every row — O(1) instead of a 100k-1M-node Python walk.
+        mask[:fleet.n_real] = _constraint_verdict(fleet.nodes[0], c)
+    else:
+        for i in range(fleet.n_real):
+            mask[i] = _constraint_verdict(fleet.nodes[i], c)
 
     fleet.mask_cache[key] = mask
     return mask
+
+
+# Interpolation targets that resolve PER ROW even on a uniform fleet:
+# ids and names are dense slab columns, never template-shared.
+# ($node.datacenter IS covered by the uniform flag — it is only set
+# when the slab's rows share one datacenter string; $attr.*/$meta.*
+# read the shared template; literals and unknown $-targets are
+# row-independent by construction.)
+_PER_ROW_TARGETS = ("$node.id", "$node.name")
+
+
+def _targets_uniform(c: Constraint) -> bool:
+    return c.l_target not in _PER_ROW_TARGETS and \
+        c.r_target not in _PER_ROW_TARGETS
+
+
+def _constraint_verdict(node, c: Constraint) -> bool:
+    l_val, ok = resolve_constraint_target(c.l_target, node)
+    if not ok:
+        return False
+    r_val, ok = resolve_constraint_target(c.r_target, node)
+    if not ok:
+        return False
+    return check_constraint_values(_mask_ctx, c.operand, l_val, r_val)
 
 
 def compile_driver_mask(fleet: FleetStatics, driver: str) -> np.ndarray:
@@ -76,11 +99,15 @@ def compile_driver_mask(fleet: FleetStatics, driver: str) -> np.ndarray:
 
     attr = f"driver.{driver}"
     mask = np.zeros(fleet.n_pad, dtype=bool)
-    for i in range(fleet.n_real):
+    rows = range(1) if fleet.uniform and fleet.n_real \
+        else range(fleet.n_real)
+    for i in rows:
         value = fleet.attr_rows[i].get(attr)
         if value is not None and \
                 str(value).strip().lower() in ("1", "t", "true"):
             mask[i] = True
+    if fleet.uniform and fleet.n_real:
+        mask[:fleet.n_real] = mask[0]
 
     fleet.mask_cache[key] = mask
     return mask
@@ -95,8 +122,11 @@ def compile_dc_mask(fleet: FleetStatics, datacenters: list) -> np.ndarray:
 
     dc_set = set(datacenters)
     mask = np.zeros(fleet.n_pad, dtype=bool)
-    for i in range(fleet.n_real):
-        mask[i] = fleet.datacenters[i] in dc_set
+    if fleet.uniform and fleet.n_real:
+        mask[:fleet.n_real] = fleet.datacenters[0] in dc_set
+    else:
+        for i in range(fleet.n_real):
+            mask[i] = fleet.datacenters[i] in dc_set
 
     fleet.mask_cache[key] = mask
     return mask

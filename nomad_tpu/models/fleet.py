@@ -44,6 +44,7 @@ from nomad_tpu.structs import (
     Node,
     Resources,
 )
+from nomad_tpu.utils.sync import CopySwap
 
 NDIMS = len(ALL_FIT_DIMS)  # cpu, memory_mb, disk_mb, iops, mbits, port_slots
 
@@ -93,6 +94,77 @@ def _pad_to(n: int) -> int:
 _FLEET_GEN = itertools.count()
 
 
+class ShardedResidency:
+    """THE residency policy for node-axis-sharded device caches.
+
+    Every mesh-resident twin — statics capacity/reserved, per-job
+    feasibility rows, the usage mirror's sharded copies — lives in one
+    of these instead of a per-call-site dict: entries are keyed by
+    (class, ..., mesh) where ``key[0]`` names the entry's CLASS
+    ("capres" / "feas" / "usage"), bounded at ``max_resident`` entries
+    PER CLASS with the whole class evicted at its bound (alternating
+    fused batch shapes resolve different meshes and must not thrash
+    each other below it) — class-scoped so a stream of distinct job
+    versions churning feasibility entries can never evict the
+    fleet-generation-lived capacity/reserved or usage twins.  Each
+    entry carries its scatters-since-upload counter so incremental
+    maintenance (UsageMirror) and one-shot uploads (statics) ride the
+    same bookkeeping.  When a mesh is configured for a dispatch
+    (parallel/mesh.dispatch_mesh), the arrays here are the PRIMARY
+    device copies — the single-buffer ``device_cache`` entries serve
+    only single-device platforms and host-executor evals."""
+
+    __slots__ = ("max_resident", "_res")
+
+    def __init__(self, max_resident: int = 4) -> None:
+        self.max_resident = max_resident
+        self._res: dict = {}   # key -> [arrays tuple, scatter count]
+
+    def lookup(self, key):
+        entry = self._res.get(key)
+        return entry[0] if entry is not None else None
+
+    def install(self, key, mesh, arrays, spec=None):
+        """Upload ``arrays`` sharded for ``mesh`` (node axis by
+        default; pass ``spec`` for e.g. [G, N] group-major rows) and
+        make them resident under ``key``."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from nomad_tpu.parallel.mesh import FLEET_AXIS
+        if key not in self._res:
+            kind = key[0]
+            same = [k for k in self._res if k[0] == kind]
+            if len(same) >= self.max_resident:
+                for k in same:
+                    del self._res[k]
+        sharding = NamedSharding(
+            mesh, P(FLEET_AXIS) if spec is None else spec)
+        out = tuple(jax.device_put(a, sharding) for a in arrays)
+        self._res[key] = [out, 0]
+        return out
+
+    def replace(self, key, arrays) -> None:
+        """Swap a maintained entry's arrays (scatter update) and count
+        the scatter against its refresh budget."""
+        entry = self._res[key]
+        entry[0] = arrays
+        entry[1] += 1
+
+    def scatters(self, key) -> int:
+        entry = self._res.get(key)
+        return entry[1] if entry is not None else 0
+
+    def drop(self, key) -> None:
+        self._res.pop(key, None)
+
+    def clear(self) -> None:
+        self._res.clear()
+
+    def keys(self) -> list:
+        return list(self._res)
+
+
 @dataclass
 class FleetStatics:
     """Node-static tensors + host mirrors, cached per nodes-table generation."""
@@ -109,12 +181,21 @@ class FleetStatics:
     # Host-side attribute/meta mirrors for constraint compilation:
     attr_rows: list                     # index -> node.attributes dict
     meta_rows: list                     # index -> node.meta dict
+    # True when the fleet came off a NodeSlab declaring row uniformity
+    # (shared attributes/meta/class/datacenter): constraint masks then
+    # compile against ONE representative row and broadcast
+    # (models/constraints.py) instead of walking 100k-1M nodes.
+    uniform: bool = False
     mask_cache: dict = field(default_factory=dict)   # constraint-key -> bool[n_pad]
     # Device-resident mirrors, populated lazily (jax arrays).  Keys:
     # "capres" -> (capacity, reserved); ("feas", group-keys) -> bool[G, N].
     # Keeping these resident avoids re-uploading the fleet every eval —
     # at 10k nodes the feasibility matrix transfer dominates eval latency.
     device_cache: dict = field(default_factory=dict)
+    # Mesh-resident twins (capacity/reserved, sharded feasibility rows)
+    # behind the one residency policy; PRIMARY when a mesh is
+    # configured for the dispatch.
+    sharded: ShardedResidency = field(default_factory=ShardedResidency)
     # node_index -> (frozen used_ports, bw_used, bw_avail, ip, device) or
     # None: the node-static half of the fast network assigner
     # (scheduler/jax_binpack.py _node_net_init).
@@ -137,18 +218,43 @@ class FleetStatics:
         return hit
 
     def device_capacity_reserved_sharded(self, mesh):
-        """Mesh-resident (node-axis-sharded) capacity/reserved, uploaded
-        once per (fleet generation, mesh) and reused by every fused
-        multi-chip dispatch (residency policy: _put_node_sharded)."""
-        per_mesh = self.device_cache.setdefault("capres_mesh", {})
-        hit = per_mesh.get(mesh)
+        """Mesh-resident (node-axis-sharded) capacity/reserved — the
+        PRIMARY copies for sharded dispatches — uploaded once per
+        (fleet generation, mesh) under the unified residency policy."""
+        key = ("capres", mesh)
+        hit = self.sharded.lookup(key)
         if hit is None:
-            hit = _put_node_sharded(per_mesh, mesh,
-                                    (self.capacity, self.reserved))
+            hit = self.sharded.install(key, mesh,
+                                       (self.capacity, self.reserved))
         return hit
+
+    def device_feasible_sharded(self, mesh, feas_key, host: np.ndarray):
+        """Mesh-resident [G, N] feasibility rows for one prep-cache
+        feasibility entry, node axis sharded (group axis replicated),
+        uploaded once per (feas_key, mesh) like capacity/reserved."""
+        from jax.sharding import PartitionSpec as P
+
+        from nomad_tpu.parallel.mesh import FLEET_AXIS
+        key = ("feas", feas_key, mesh)
+        hit = self.sharded.lookup(key)
+        if hit is None:
+            hit = self.sharded.install(key, mesh, (host,),
+                                       spec=P(None, FLEET_AXIS))
+        return hit[0]
 
 
 def build_fleet(nodes: list[Node]) -> FleetStatics:
+    """State -> fleet tensors.  Columnar fast path: when every node is
+    an unmutated row of ONE NodeSlab (structs/node_slab.py — the
+    100k-1M-node bulk-load shape), the static tensors come straight
+    off the slab's dense vectors and shared template, with no per-node
+    Python walk; a single mutated or foreign row falls the whole build
+    back to the exact object path."""
+    from nomad_tpu.structs import node_slab_of
+
+    slab = node_slab_of(nodes)
+    if slab is not None:
+        return _build_fleet_slab(nodes, slab)
     n_real = len(nodes)
     n_pad = _pad_to(n_real)
 
@@ -186,29 +292,66 @@ def build_fleet(nodes: list[Node]) -> FleetStatics:
     )
 
 
-def _put_node_sharded(cache: dict, mesh, arrays, counters=None,
-                      max_resident: int = 4):
-    """Upload ``arrays`` node-axis-sharded for ``mesh`` into ``cache``
-    and return the tuple.  ONE residency policy for every per-mesh
-    cache (statics capacity/reserved, the usage mirror's mesh twins):
-    bounded at ``max_resident`` meshes — everything is evicted at the
-    bound (alternating fused batch sizes get different meshes and must
-    not thrash each other below it) — with ``counters`` (a parallel
-    per-mesh dict, e.g. scatter counts) kept in sync."""
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
+def _build_fleet_slab(nodes: list, slab) -> FleetStatics:
+    """FleetStatics off one NodeSlab's columns: broadcast vectors, the
+    shared attribute/meta template per row, and ``uniform=True`` when
+    the slab's rows share one datacenter — the flag the constraint
+    compiler uses to judge ONE representative row for the whole
+    fleet."""
+    n_real = slab.n
+    n_pad = _pad_to(n_real)
+    capacity = np.zeros((n_pad, NDIMS), dtype=np.float32)
+    capacity[:n_real] = slab.capacity_vec()
+    capacity[:n_real, 5] = PORT_SLOTS_CAPACITY
+    reserved = np.zeros((n_pad, NDIMS), dtype=np.float32)
+    reserved[:n_real] = slab.reserved_vec()
+    ready = np.zeros(n_pad, dtype=bool)
+    ready[:n_real] = slab.ready()
+    datacenters = np.empty(n_pad, dtype=object)
+    uniform = isinstance(slab.datacenters, str)
+    if uniform:
+        datacenters[:n_real] = slab.datacenters
+    else:
+        for i in range(n_real):
+            datacenters[i] = slab.datacenters[i]
+    attrs = slab.template.attributes
+    meta = slab.template.meta
+    return FleetStatics(
+        n_real=n_real,
+        n_pad=n_pad,
+        node_ids=list(slab.ids),
+        index_of={nid: i for i, nid in enumerate(slab.ids)},
+        nodes=list(nodes),
+        capacity=capacity,
+        reserved=reserved,
+        ready=ready,
+        datacenters=datacenters,
+        # Shared template per row: mask compilation treats these as
+        # read-only (the store immutability contract), and the uniform
+        # flag means it rarely reads past row 0 anyway.
+        attr_rows=_SharedRows(attrs, n_real),
+        meta_rows=_SharedRows(meta, n_real),
+        uniform=uniform,
+    )
 
-    from nomad_tpu.parallel.mesh import FLEET_AXIS
-    if len(cache) >= max_resident:
-        cache.clear()
-        if counters is not None:
-            counters.clear()
-    node = NamedSharding(mesh, P(FLEET_AXIS))
-    out = tuple(jax.device_put(a, node) for a in arrays)
-    cache[mesh] = out
-    if counters is not None:
-        counters[mesh] = 0
-    return out
+
+class _SharedRows:
+    """A list-shaped view serving ONE shared row dict for every index —
+    the uniform fleet's attr/meta mirror without n_real pointers."""
+
+    __slots__ = ("row", "n")
+
+    def __init__(self, row, n: int) -> None:
+        self.row = row
+        self.n = n
+
+    def __len__(self) -> int:
+        return self.n
+
+    def __getitem__(self, i):
+        if isinstance(i, int) and -self.n <= i < self.n:
+            return self.row
+        raise IndexError(i)
 
 
 def net_base_for(statics: FleetStatics, node_index: int, node):
@@ -388,12 +531,12 @@ class UsageMirror:
         # Invariant: _usage_d is None or exactly equals self.usage.
         self._usage_d = None
         self._scatters_since_upload = 0
-        # Mesh twins of _usage_d: node-axis-sharded resident copies for
-        # the fused multi-chip dispatch, one per mesh (bounded — see
-        # device_usage_sharded), maintained by the same scatters.
-        # Invariant: every value exactly equals self.usage.
-        self._usage_m: dict = {}      # mesh -> sharded jax array
-        self._m_scatters: dict = {}   # mesh -> scatters since upload
+        # Mesh twins of _usage_d behind the unified residency policy
+        # (ShardedResidency): node-axis-sharded resident copies — the
+        # PRIMARY usage for sharded dispatches — one per mesh, bounded,
+        # maintained by the same scatters as the single-device copy.
+        # Invariant: every resident value exactly equals self.usage.
+        self._sharded = ShardedResidency()
         # Per-node port/bandwidth tracking for the vectorized plan
         # verifier (server/plan_apply).  Disabled until sync_net() is
         # first called so scheduler-only users pay nothing; once
@@ -417,6 +560,14 @@ class UsageMirror:
         # place by _apply_deltas, so unlike the copy-on-write usage
         # array they must not be read unlocked.
         self._lock = threading.RLock()
+        # Published fence (index, lineage, net_ready): ONE CopySwap
+        # tuple rebound under the lock by _publish_fence, read
+        # lock-free by the sync fast paths — an already-current caller
+        # must never block behind another thread's O(allocs) rebuild.
+        # (This replaces the three bare-read allowlist waivers the old
+        # unlocked index/_lineage/_net_ready reads carried: the
+        # contract now lives in the annotation the lint enforces.)
+        self._fence: CopySwap = (-1, None, False)
 
     @property
     def lock(self):
@@ -459,15 +610,29 @@ class UsageMirror:
         self._lineage = t.lineage
         self._log_ref = log
         self._log_pos = self._position_after(log, target)
+        self._publish_fence()
         return True
+
+    def _publish_fence(self) -> None:
+        """Rebind the lock-free fence tuple (called under the lock
+        after any index/lineage/net_ready move)."""
+        self._fence = (self.index, self._lineage, self._net_ready)
 
     def sync(self, state) -> bool:
         """Bring the mirror to ``state``'s allocs table (store or
         snapshot).  O(changed allocs) when the changelog covers the gap;
         full rebuild otherwise.  Returns False (mirror untouched) when the
-        snapshot is older than the mirror — the mirror is monotonic."""
+        snapshot is older than the mirror — the mirror is monotonic.
+
+        Already-current fast path: one lock-free read of the CopySwap
+        fence tuple — a caller whose snapshot the mirror already covers
+        must return immediately even while another thread holds the
+        lock through a full O(allocs) rebuild (the old per-attribute
+        double-checked reads provided this; the fence keeps it without
+        their waivers)."""
         t = state._t
-        if self._current(t):
+        index, lineage, _net = self._fence
+        if index == t.indexes["allocs"] and lineage is t.lineage:
             return True
         with self._lock:
             return self._sync_locked(t)
@@ -475,15 +640,19 @@ class UsageMirror:
     def sync_net(self, state) -> bool:
         """sync() plus per-node port/bandwidth tracking: enabled (full
         net rebuild) on first call, maintained incrementally by every
-        later sync.  Same monotonicity contract as sync()."""
+        later sync.  Same monotonicity and fast-path contract as
+        sync()."""
         t = state._t
-        if self._net_ready and self._current(t):
+        index, lineage, net_ready = self._fence
+        if net_ready and index == t.indexes["allocs"] and \
+                lineage is t.lineage:
             return True
         with self._lock:
             ok = self._sync_locked(t)
             if ok and not self._net_ready:
                 self._rebuild_net(t.tables["allocs"])
                 self._net_ready = True
+                self._publish_fence()
             return ok
 
     def _changed_ids(self, log: list, target: int) -> set:
@@ -529,8 +698,7 @@ class UsageMirror:
         self.alloc_rows = rows
         self.rebuilds += 1
         self._usage_d = None
-        self._usage_m.clear()
-        self._m_scatters.clear()
+        self._sharded.clear()
         if self._net_ready:
             self._rebuild_net(table)
 
@@ -666,7 +834,8 @@ class UsageMirror:
         to the (about-to-be-installed) host usage: scatter the touched
         rows, or drop a copy when a fresh upload is cheaper.  Called
         under the lock from _apply_deltas."""
-        if self._usage_d is None and not self._usage_m:
+        sharded = self._sharded
+        if self._usage_d is None and not sharded.keys():
             return
         big = len(touched_rows) > self.MAX_SCATTER_ROWS
         idx = rows = None
@@ -681,16 +850,12 @@ class UsageMirror:
             else:
                 self._usage_d = _scatter_rows(self._usage_d, idx, rows)
                 self._scatters_since_upload += 1
-        for mesh in list(self._usage_m):
-            if big or self._m_scatters.get(mesh, 0) >= \
-                    self.DEVICE_REFRESH_EVERY:
-                del self._usage_m[mesh]
-                self._m_scatters.pop(mesh, None)
+        for key in sharded.keys():
+            if big or sharded.scatters(key) >= self.DEVICE_REFRESH_EVERY:
+                sharded.drop(key)
             else:
-                self._usage_m[mesh] = _scatter_rows(
-                    self._usage_m[mesh], idx, rows)
-                self._m_scatters[mesh] = \
-                    self._m_scatters.get(mesh, 0) + 1
+                (buf,) = sharded.lookup(key)
+                sharded.replace(key, (_scatter_rows(buf, idx, rows),))
 
     def _device_usage_locked(self):
         from nomad_tpu.parallel.devices import ensure_on_default
@@ -708,23 +873,21 @@ class UsageMirror:
 
     def device_usage_sharded(self, mesh, expect_usage):
         """Mesh-resident (node-axis-sharded) copy of the mirror's usage
-        for a fused multi-chip dispatch, or None when the mirror has
-        moved past the caller's view (``expect_usage`` is the view's
-        host array — the caller must then upload it itself).  Uploaded
-        on first use PER MESH (alternating fused batch sizes get
-        different meshes and must not thrash each other), scatter-
-        maintained alongside every host delta like the single-device
-        copy; bounded at a handful of resident meshes."""
+        — the PRIMARY usage for a sharded dispatch — or None when the
+        mirror has moved past the caller's view (``expect_usage`` is
+        the view's host array — the caller must then upload it itself).
+        Uploaded on first use PER MESH under the unified residency
+        policy (alternating fused batch sizes get different meshes and
+        must not thrash each other), scatter-maintained alongside
+        every host delta like the single-device copy."""
         with self._lock:
             if self.usage is not expect_usage:
                 return None
-            buf = self._usage_m.get(mesh)
-            if buf is None:
-                (buf,) = _put_node_sharded(self._usage_m, mesh,
-                                           (self.usage,),
-                                           self._m_scatters)
-                self._usage_m[mesh] = buf  # store the bare array
-            return buf
+            key = ("usage", mesh)
+            hit = self._sharded.lookup(key)
+            if hit is None:
+                hit = self._sharded.install(key, mesh, (self.usage,))
+            return hit[0]
 
     # -- views -------------------------------------------------------------
     def _view_locked(self, plan, job_id: str) -> FleetView:

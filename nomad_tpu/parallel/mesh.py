@@ -16,6 +16,9 @@ ICI the sharded fleet math.
 """
 from __future__ import annotations
 
+import contextlib
+import os
+
 from functools import partial
 
 import jax
@@ -26,6 +29,92 @@ from nomad_tpu.ops.binpack import _place_rounds, _place_sequence
 
 FLEET_AXIS = "fleet"
 LANE_AXIS = "lanes"
+
+# -- mesh resolution: the ONE authority ------------------------------------
+# Every dispatch that *could* shard the node axis asks dispatch_mesh();
+# the answer is a property of the platform (device count) and the
+# dispatch shape, overridable by NOMAD_TPU_MESH so a bench or operator
+# can force the single-device twin ("off"/"0") or cap the device count
+# (an integer) without editing code — the same lever shape as
+# NOMAD_TPU_EXECUTOR (scheduler/executor.py).
+
+ENV_VAR = "NOMAD_TPU_MESH"
+
+_MESH_CACHE: dict = {}
+# Process override installed by mesh_override(); a one-element holder so
+# readers never see a torn update.
+_OVERRIDE: list = [None]
+
+
+def _mesh_policy():
+    """Resolved policy: "off", "auto", or an int device cap."""
+    value = _OVERRIDE[0]
+    if value is None:
+        value = os.environ.get(ENV_VAR, "auto")
+    value = str(value).strip().lower() or "auto"
+    if value in ("off", "none", "0"):
+        return "off"
+    if value.isdigit():
+        return int(value)
+    return "auto"
+
+
+@contextlib.contextmanager
+def mesh_override(value):
+    """Temporarily force the mesh policy ("off", "auto", or a device
+    count) — the bench's unsharded twins and the tier-1 parity rigs
+    compare sharded against single-device runs through this."""
+    prior = _OVERRIDE[0]
+    _OVERRIDE[0] = value
+    try:
+        yield
+    finally:
+        _OVERRIDE[0] = prior
+
+
+def dispatch_mesh(n_lanes: int, n_pad: int):
+    """Mesh for a dispatch of ``n_lanes`` evals over an ``n_pad``-wide
+    (power-of-two padded) node axis, or None when one device (or the
+    "off" policy, or a lane/device shape that cannot split) makes the
+    plain jit the right call.
+
+    Lane ways = largest power of two dividing n_lanes, capped at half
+    the devices so the fleet axis keeps width; remaining devices shard
+    the node axis, capped at n_pad so the sharding always divides it.
+    ``n_lanes == 1`` therefore resolves a pure 1-D fleet mesh — the
+    single-eval scheduler path — and multi-lane dispatches get the 2-D
+    ``(lanes, fleet)`` storm layout when the shape splits.  Devices
+    resolve through parallel/devices.default_platform_devices so the
+    mesh always lives on the pinned platform."""
+    policy = _mesh_policy()
+    if policy == "off":
+        return None
+    from nomad_tpu.parallel.devices import default_platform_devices
+
+    all_devices = default_platform_devices()
+    n_dev = len(all_devices)
+    if isinstance(policy, int):
+        n_dev = min(n_dev, policy)
+    if n_dev < 2:
+        return None
+    n = 1 << (n_dev.bit_length() - 1)  # power-of-two subset
+    lane_ways = 1
+    while lane_ways * 2 <= min(n // 2, n_lanes) and \
+            n_lanes % (lane_ways * 2) == 0:
+        lane_ways *= 2
+    # Fleet ways must divide the padded node axis (both powers of two,
+    # so <= suffices); tiny fleets on big hosts use fewer devices.
+    n = min(n, lane_ways * max(1, n_pad))
+    if n < 2:
+        return None
+    key = (all_devices[0].platform, n, lane_ways)
+    mesh = _MESH_CACHE.get(key)
+    if mesh is None:
+        devices = all_devices[:n]
+        mesh = storm_mesh(lane_ways, devices) if lane_ways > 1 \
+            else fleet_mesh(devices)
+        _MESH_CACHE[key] = mesh
+    return mesh
 
 
 def fleet_mesh(devices=None) -> Mesh:

@@ -32,15 +32,6 @@ from .interfaces import SetStatusError
 from .jax_binpack import JaxBinPackScheduler, fetch_results
 from .util import set_status
 
-# Fused-dispatch mesh, resolved once per process: None on a single
-# device; otherwise the largest power-of-two device subset, shaped
-# (lanes, fleet) per dispatch by _mesh_for.  Multi-chip agents get the
-# storm layout automatically — lanes data-parallel, node axis sharded —
-# with no configuration (parallel/mesh.py; single-chip dispatch is
-# untouched).
-_MESH_CACHE: dict = {}
-
-
 def _tnow() -> float:
     """Tracer-epoch now, 0.0 when tracing is off (obs/trace.py)."""
     t = trace_mod.tracer()
@@ -62,46 +53,6 @@ def _lane_spans(name: str, scheds, t0: float, t1: float, **tags) -> None:
         if ev is not None and ev.trace:
             tracer.record(name, t0, t1 - t0, parent_ctx=ev.trace,
                           eval_id=ev.id, **tags)
-
-
-def _mesh_for(n_lanes: int, n_pad: int):
-    """Mesh for a fused dispatch of ``n_lanes`` evals over an
-    ``n_pad``-wide (power-of-two padded) node axis, or None when one
-    device (or a lane/device shape that cannot split) makes the plain
-    jit the right call.  Lane ways = largest power of two dividing
-    n_lanes, capped at half the devices so the fleet axis keeps width;
-    remaining devices shard the node axis, capped at n_pad so the
-    sharding always divides it."""
-    # Devices of the platform the runtime actually computes on: when a
-    # default device is pinned (tests pin cpu:0 while the environment
-    # also registers a remote TPU backend), the mesh must live on that
-    # platform, not on whichever backend jax.devices() favors.
-    from nomad_tpu.parallel.devices import default_platform_devices
-
-    all_devices = default_platform_devices()
-    n_dev = len(all_devices)
-    if n_dev < 2:
-        return None
-    n = 1 << (n_dev.bit_length() - 1)  # power-of-two subset
-    lane_ways = 1
-    while lane_ways * 2 <= min(n // 2, n_lanes) and \
-            n_lanes % (lane_ways * 2) == 0:
-        lane_ways *= 2
-    # Fleet ways must divide the padded node axis (both powers of two,
-    # so <= suffices); tiny fleets on big hosts use fewer devices.
-    n = min(n, lane_ways * max(1, n_pad))
-    if n < 2:
-        return None
-    key = (all_devices[0].platform, n, lane_ways)
-    mesh = _MESH_CACHE.get(key)
-    if mesh is None:
-        from nomad_tpu.parallel.mesh import fleet_mesh, storm_mesh
-
-        devices = all_devices[:n]
-        mesh = storm_mesh(lane_ways, devices) if lane_ways > 1 \
-            else fleet_mesh(devices)
-        _MESH_CACHE[key] = mesh
-    return mesh
 
 
 class BatchEvalRunner:
@@ -294,7 +245,12 @@ class BatchEvalRunner:
         penalty = np.asarray([a.penalty for _, _, a in pending],
                              dtype=np.float32)
 
-        mesh = _mesh_for(B, statics.n_pad)
+        # Mesh resolution rides the ONE authority (parallel/mesh.py):
+        # multi-chip agents automatically get the 2-D (lanes, fleet)
+        # storm layout when the shape splits, NOMAD_TPU_MESH overrides.
+        from nomad_tpu.parallel.mesh import dispatch_mesh
+
+        mesh = dispatch_mesh(B, statics.n_pad)
         # All fused lanes share the same snapshot base usage (fast-path
         # contract above); use the resident device copies when available
         # (single-device mirror copy, or on a mesh the sharded statics +

@@ -208,6 +208,14 @@ def _fit_rounds(statics, view, feasible_h, asks, slot_placements,
     n = statics.n_real
     if n == 0 or not slot_placements:
         return rounds, True
+    if max(len(ps) for ps in slot_placements.values()) <= rounds:
+        # No slot can need more rounds than it has copies (need =
+        # ceil(count / fitting) <= count), so the per-slot fit walk
+        # cannot raise ``rounds`` — skip it.  This is the 100k-1M-node
+        # heterogeneous-storm shape (thousands of count-1 slots): the
+        # walk would cost O(slots x nodes x dims) numpy per eval for a
+        # guaranteed no-op answer.
+        return rounds, True
     cap = statics.capacity[:n]
     res = statics.reserved[:n]
     usage = np.asarray(view.usage)[:n]
@@ -273,7 +281,12 @@ class DeviceArgs:
                  # uploads them once, not per eval.  Kilobytes per job —
                  # unlike feasible_d these may ride the job-held cache
                  # without meaningfully pinning HBM.
-                 "dev_const")
+                 "dev_const",
+                 # feas_key: the statics.device_cache key of this eval's
+                 # feasibility entry — the stable identity the sharded
+                 # residency (FleetStatics.device_feasible_sharded) keys
+                 # mesh-resident [G, N] rows on.
+                 "feas_key")
 
     def __init__(self, **kw) -> None:
         for k, v in kw.items():
@@ -536,6 +549,10 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
     # host, False device, None when no dispatch ran yet.  The pipelined
     # runner reads this to report an honest device_fraction.
     dispatched_host: "bool | None" = None
+    # Whether the last device dispatch ran node-axis-sharded over a
+    # mesh (parallel/mesh.dispatch_mesh resolved one) — the runner's
+    # sharded_dispatches counter and the bench's sharded rows read it.
+    dispatched_sharded: "bool | None" = None
 
     def _dev_const(self, args: "DeviceArgs", key: str,
                    host_arrays: tuple) -> list:
@@ -550,12 +567,30 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
             holder[i] = ensure_on_default(holder[i], h)
         return holder
 
+    def _dev_const_repl(self, args: "DeviceArgs", key: tuple, mesh,
+                        host_arrays: tuple) -> list:
+        """Mesh-replicated twins of the dispatch-constant arrays for
+        the sharded path, cached on the same prep-shared dev_const
+        holder as the default-device copies (one upload per job version
+        per mesh — uploading kilobytes per EVAL measurably taxed the
+        pipelined hot path, which is why _dev_const exists)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from nomad_tpu.parallel.mesh import _put
+
+        holder = args.dev_const.setdefault(key, [None] * len(host_arrays))
+        repl = NamedSharding(mesh, P())
+        for i, h in enumerate(host_arrays):
+            holder[i] = _put(h if holder[i] is None else holder[i], repl)
+        return holder
+
     def dispatch_host(self, args: "DeviceArgs") -> tuple:
         """Run the placement kernels eagerly with numpy
         (ops/binpack_host.py) — same semantics, zero dispatch latency."""
         from nomad_tpu.ops.binpack_host import (place_rounds_host,
                                                 place_sequence_host)
 
+        self.dispatched_sharded = False
         statics = args.statics
         if args.rounds_eligible:
             chosen, scores, _ = place_rounds_host(
@@ -592,6 +627,12 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
             self.dispatched_host = True
             return self.dispatch_host(args)
         self.dispatched_host = False
+        from nomad_tpu.parallel.mesh import dispatch_mesh
+
+        mesh = dispatch_mesh(1, args.statics.n_pad)
+        if mesh is not None:
+            return self._dispatch_device_sharded(args, mesh)
+        self.dispatched_sharded = False
         capacity_d, reserved_d = args.statics.device_capacity_reserved()
         feas_cached = args.feasible_d  # [host, device-or-None], lazy
         from nomad_tpu.parallel.devices import ensure_on_default
@@ -615,6 +656,59 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
                 capacity_d, reserved_d, args.view.dispatch_usage(),
                 args.view.job_counts, feasible_d, asks_d,
                 distinct_d, group_idx_d, valid_d, args.penalty)
+        for a in (chosen_s, scores_s):
+            try:
+                a.copy_to_host_async()
+            except AttributeError:  # pragma: no cover - non-array backend
+                pass
+        return chosen_s, scores_s
+
+    def _dispatch_device_sharded(self, args: "DeviceArgs", mesh) -> tuple:
+        """Single-eval device dispatch with the node axis sharded over
+        ``mesh`` — the first-class multi-chip path: capacity/reserved,
+        this eval's feasibility rows, and the usage mirror's copy are
+        all mesh-RESIDENT (uploaded once per fleet generation / job
+        version / sync under the unified ShardedResidency policy), and
+        the cross-shard argmax / top-k winner selection is resolved by
+        XLA collectives (parallel/mesh.py kernels).  Placements are
+        byte-identical to the unsharded kernels (tier-1
+        tests/test_parallel.py pins it, ties included)."""
+        from nomad_tpu.parallel.mesh import (place_rounds_sharded,
+                                             place_sequence_sharded)
+
+        self.dispatched_sharded = True
+        statics = args.statics
+        capacity_d, reserved_d = \
+            statics.device_capacity_reserved_sharded(mesh)
+        feasible_d = statics.device_feasible_sharded(
+            mesh, args.feas_key, args.feasible_h)
+        view = args.view
+        usage = None
+        if view.usage_device is not None and statics.mirror is not None:
+            # The mirror's sharded twin IS this view's usage (the view
+            # carried no plan deltas); None = the mirror moved past the
+            # view, so the view's own host array uploads instead.
+            usage = statics.mirror.device_usage_sharded(mesh, view.usage)
+        if usage is None:
+            usage = view.usage
+        if args.rounds_eligible:
+            asks_d, distinct_d, counts_d = self._dev_const_repl(
+                args, ("rounds", mesh), mesh,
+                (args.asks, args.distinct, args.counts))
+            chosen_s, scores_s, _u = place_rounds_sharded(
+                mesh, capacity_d, reserved_d, usage, view.job_counts,
+                feasible_d, asks_d, distinct_d, counts_d,
+                args.penalty, k_cap=args.k_cap, rounds=args.rounds)
+        else:
+            asks_d, distinct_d, group_idx_d, valid_d = \
+                self._dev_const_repl(
+                    args, ("seq", mesh), mesh,
+                    (args.asks, args.distinct, args.group_idx,
+                     args.valid))
+            chosen_s, scores_s, _u = place_sequence_sharded(
+                mesh, capacity_d, reserved_d, usage, view.job_counts,
+                feasible_d, asks_d, distinct_d, group_idx_d,
+                valid_d, args.penalty)
         for a in (chosen_s, scores_s):
             try:
                 a.copy_to_host_async()
@@ -845,7 +939,7 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
             rounds_eligible=eligible,
             fast_all=all(np_[0] for np_ in net_plans),
             group_l=group_idx[:len(place)].tolist(), slots_c=[None],
-            col_meta=[None], dev_const={})
+            col_meta=[None], dev_const={}, feas_key=feas_key)
         # Keyed on the fleet GENERATION, not the statics object: a strong
         # statics ref here would pin evicted generations (device
         # feasibility buffers included) for as long as the job lives.

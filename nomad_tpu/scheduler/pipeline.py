@@ -148,6 +148,11 @@ class PipelinedEvalRunner(BatchEvalRunner):
                             "finish": 0.0, "submit": 0.0}
         self.host_dispatches = 0
         self.device_dispatches = 0
+        # Device dispatches that ran node-axis-sharded over a mesh
+        # (parallel/mesh.dispatch_mesh resolved one): the bench's
+        # sharded rows assert this covers every device dispatch on a
+        # multi-device platform.
+        self.sharded_dispatches = 0
         self.windows: list[int] = []  # drained-window sizes (diagnostics)
         # Device-executor circuit breaker (scheduler/breaker.py): failed
         # or deadline-blown device dispatches re-run on the host twin
@@ -224,6 +229,8 @@ class PipelinedEvalRunner(BatchEvalRunner):
                     self.host_dispatches += 1
                 else:
                     self.device_dispatches += 1
+                    if sched.dispatched_sharded:
+                        self.sharded_dispatches += 1
                 _lane_spans("sched.dispatch", [sched], t_disp, _tnow(),
                             host=sched.dispatched_host)
                 times["dispatch"] += time.perf_counter() - t_begin
@@ -289,6 +296,7 @@ class PipelinedEvalRunner(BatchEvalRunner):
         return {
             "host_dispatches": self.host_dispatches,
             "device_dispatches": self.device_dispatches,
+            "sharded_dispatches": self.sharded_dispatches,
             "breaker_reruns": reruns,
             "parity_checks": self.parity_checks,
             "evals": len(self.latencies),

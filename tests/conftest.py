@@ -29,6 +29,10 @@ jax.config.update("jax_default_device", jax.devices("cpu")[0])
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running smoke tests (driver entry points)")
+    config.addinivalue_line(
+        "markers", "multichip: sharded-parity suite on the forced "
+        "8-device host mesh; re-driven hermetically by the tier-1 "
+        "subprocess rig (tests/test_multichip_rig.py)")
 
 
 import pytest  # noqa: E402

@@ -157,7 +157,7 @@ def test_fused_dispatch_rides_the_mesh_on_multi_device(monkeypatch):
     """On a multi-device host the fused dispatch routes through the
     mesh-sharded kernels (storm layout when the lane count splits), and
     the plans match a single-device run lane for lane."""
-    import nomad_tpu.scheduler.batch as batch_mod
+    import nomad_tpu.parallel.mesh as mesh_mod
 
     def build(runner_patch=None):
         h = Harness()
@@ -177,13 +177,13 @@ def test_fused_dispatch_rides_the_mesh_on_multi_device(monkeypatch):
 
     monkeypatch.setattr(JaxBinPackScheduler, "HOST_SINGLE_SHOT_COST", 0)
     used = []
-    orig = batch_mod._mesh_for
+    orig = mesh_mod.dispatch_mesh
 
     def spy(n_lanes, n_pad):
         mesh = orig(n_lanes, n_pad)
         used.append(mesh)
         return mesh
-    monkeypatch.setattr(batch_mod, "_mesh_for", spy)
+    monkeypatch.setattr(mesh_mod, "dispatch_mesh", spy)
 
     h, jobs = build()
     BatchEvalRunner(h.state.snapshot(), h).process(
@@ -193,11 +193,13 @@ def test_fused_dispatch_rides_the_mesh_on_multi_device(monkeypatch):
     mesh_counts = [sum(len(v) for v in p.node_allocation.values())
                    for p in h.plans]
 
-    # Same workload forced down the single-device path.
-    monkeypatch.setattr(batch_mod, "_mesh_for", lambda n, p: None)
+    # Same workload forced down the single-device path (the
+    # NOMAD_TPU_MESH="off" lever, here via its process override).
+    monkeypatch.setattr(mesh_mod, "dispatch_mesh", orig)
     h2, jobs2 = build()
-    BatchEvalRunner(h2.state.snapshot(), h2).process(
-        [make_eval(j) for j in jobs2])
+    with mesh_mod.mesh_override("off"):
+        BatchEvalRunner(h2.state.snapshot(), h2).process(
+            [make_eval(j) for j in jobs2])
     single_counts = [sum(len(v) for v in p.node_allocation.values())
                      for p in h2.plans]
     assert mesh_counts == single_counts == [4, 4, 4, 4]

@@ -39,12 +39,16 @@ def test_dryrun_multichip_8():
     assert "parity" in r.stdout
     # The forced-device pipeline (NOMAD_TPU_EXECUTOR=device twin of the
     # bench's 4_device_pipelined row) must really dispatch on the mesh
-    # platform: device_fraction > 0, placed count == the host twin.
+    # platform — AND, with sharding first-class, every one of those
+    # dispatches must have ridden the node-axis mesh.
     m = re.search(r"executor=device device_fraction=([0-9.]+) "
-                  r"placed=(\d+)", r.stdout)
+                  r"sharded_dispatches=(\d+) placed=(\d+)", r.stdout)
     assert m, r.stdout[-2000:]
     assert float(m.group(1)) > 0, r.stdout[-2000:]
     assert int(m.group(2)) > 0, r.stdout[-2000:]
+    assert int(m.group(3)) > 0, r.stdout[-2000:]
+    # The columnar node-table bridge phase ran.
+    assert "columnar slab bridge" in r.stdout
 
 
 def test_entry_compiles():

@@ -182,7 +182,7 @@ def test_fuzz_invariants_native_off(seed, monkeypatch):
 def test_fuzz_invariants_fused_mesh_storm(seed, monkeypatch):
     """The fused BatchEvalRunner with the device executor forced, so
     the dispatch rides the runtime-selected mesh on the 8-device test
-    host (scheduler/batch.py _mesh_for).  Lanes plan optimistically
+    host (parallel/mesh.py dispatch_mesh).  Lanes plan optimistically
     against one snapshot; a plan-applier-semantics planner serializes
     commits (partial accept + refresh), and the hard invariants must
     hold on the committed state — the multi-chip storm path gets the

@@ -8,13 +8,16 @@ placement for already-resident shardings.
 """
 import jax
 import numpy as np
+import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import nomad_tpu.mock as mock
-from nomad_tpu.models.fleet import fleet_cache, mirror_for
+from nomad_tpu.models.fleet import ShardedResidency, fleet_cache, mirror_for
 from nomad_tpu.parallel.mesh import FLEET_AXIS, fleet_mesh, _put
 from nomad_tpu.state.store import StateStore
 from tests.test_plan_verify_vec import bump, make_alloc
+
+pytestmark = pytest.mark.multichip
 
 
 def _rig(n_nodes=16):
@@ -83,3 +86,92 @@ def test_mirror_sharded_usage_scatter_maintained():
     assert mirror.device_usage_sharded(mesh, stale) is None
     fresh = mirror.device_usage_sharded(mesh, mirror.usage)
     np.testing.assert_allclose(np.asarray(fresh), mirror.usage)
+
+
+def test_sharded_residency_is_one_policy():
+    """ONE bounded residency for every node-axis-sharded cache: keyed
+    entries, class-scoped evict-all-at-the-bound, per-entry scatter
+    counters — the per-call-site dicts it replaced are gone."""
+    mesh = fleet_mesh(jax.devices("cpu")[:8])
+    res = ShardedResidency(max_resident=2)
+    a = np.arange(32, dtype=np.float32).reshape(16, 2)
+    (buf,) = res.install(("usage", mesh), mesh, (a,))
+    assert res.lookup(("usage", mesh))[0] is buf
+    assert buf.sharding == NamedSharding(mesh, P(FLEET_AXIS))
+    assert res.scatters(("usage", mesh)) == 0
+    res.replace(("usage", mesh), (buf,))
+    assert res.scatters(("usage", mesh)) == 1
+    # [G, N] rows shard on the node axis with the group axis replicated.
+    g = np.zeros((4, 16), dtype=bool)
+    (gbuf,) = res.install(("feas", "k1", mesh), mesh, (g,),
+                          spec=P(None, FLEET_AXIS))
+    assert gbuf.sharding == NamedSharding(mesh, P(None, FLEET_AXIS))
+    # Bound is per CLASS (key[0]): churning feasibility entries evicts
+    # only feasibility — a stream of distinct job versions must never
+    # evict the fleet-generation-lived capres/usage twins.
+    res.install(("feas", "k2", mesh), mesh, (g,),
+                spec=P(None, FLEET_AXIS))
+    res.install(("feas", "k3", mesh), mesh, (g,),
+                spec=P(None, FLEET_AXIS))  # at the bound: clears feas
+    assert res.lookup(("feas", "k1", mesh)) is None
+    assert res.lookup(("feas", "k2", mesh)) is None
+    assert res.lookup(("feas", "k3", mesh)) is not None
+    assert res.lookup(("usage", mesh)) is not None  # survived the churn
+
+
+def test_statics_sharded_feasibility_resident():
+    """The per-job feasibility rows get mesh-resident twins keyed by
+    the prep cache's feas_key — uploaded once, reused per dispatch."""
+    state, nodes, cell = _rig()
+    statics = fleet_cache.statics_for(state)
+    mesh = fleet_mesh(jax.devices("cpu")[:8])
+    host = np.zeros((8, statics.n_pad), dtype=bool)
+    host[0, : statics.n_real] = True
+    f1 = statics.device_feasible_sharded(mesh, ("feas", "k1", 8), host)
+    f2 = statics.device_feasible_sharded(mesh, ("feas", "k1", 8), host)
+    assert f1 is f2
+    assert f1.sharding == NamedSharding(mesh, P(None, FLEET_AXIS))
+    np.testing.assert_array_equal(np.asarray(f1), host)
+    # Capacity/reserved ride the SAME residency instance.
+    cap, _res = statics.device_capacity_reserved_sharded(mesh)
+    assert ("capres", mesh) in statics.sharded.keys()
+
+
+def test_sharded_dispatch_uses_resident_primaries():
+    """A forced-device single-eval dispatch on the 8-device host runs
+    node-axis-sharded and reuses the resident twins (no re-upload):
+    the statics' capres/feas entries and the mirror's usage twin are
+    the SAME buffers across two dispatches of the same job."""
+    from nomad_tpu.scheduler import Harness
+    from nomad_tpu.scheduler.executor import executor_override
+    from nomad_tpu.scheduler.jax_binpack import JaxBinPackScheduler
+    from nomad_tpu.structs import (EVAL_TRIGGER_JOB_REGISTER, Evaluation,
+                                   generate_uuid)
+
+    h = Harness()
+    for i in range(16):
+        h.state.upsert_node(h.next_index(), mock.node(i))
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+
+    def one_dispatch():
+        sched = JaxBinPackScheduler(h.state.snapshot(), h, batch=False)
+        sched.eval = Evaluation(
+            id=generate_uuid(), priority=job.priority, type=job.type,
+            triggered_by=EVAL_TRIGGER_JOB_REGISTER, job_id=job.id)
+        sched.defer_device = True
+        sched._begin()
+        place, args = sched.deferred
+        with executor_override("device"):
+            handles = sched.dispatch_device(args)
+        chosen, scores = sched.collect_device(args, handles)
+        assert sched.dispatched_sharded
+        return args.statics, chosen
+
+    statics1, chosen1 = one_dispatch()
+    keys1 = set(statics1.sharded.keys())
+    assert any(k[0] == "capres" for k in keys1)
+    statics2, chosen2 = one_dispatch()
+    assert statics2 is statics1  # same fleet generation
+    assert set(statics2.sharded.keys()) == keys1  # resident, no churn
+    assert np.array_equal(chosen1, chosen2)

@@ -1,0 +1,43 @@
+"""Tier-1 multichip lane: the sharded-parity suite, hermetically.
+
+conftest force-configures 8 virtual devices for the in-process suite,
+but that depends on import order and the caller's shell.  This rig
+re-drives every ``-m multichip`` test in a SUBPROCESS with the XLA
+flags pinned (the same discipline tests/test_graft_entry.py applies to
+the driver dry runs), so a mesh regression fails tier-1 even in an
+environment whose outer flags differ — before a TPU ever sees it.
+"""
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The sharded-parity suite: every test in these modules is marked
+# multichip (module-level pytestmark).
+SUITE = ("tests/test_parallel.py", "tests/test_mesh_resident.py",
+         "tests/test_node_slab.py")
+
+
+def test_multichip_lane_runs_sharded_parity_suite():
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    # Force EXACTLY 8 virtual devices, replacing any pre-existing count
+    # so the lane is hermetic in any shell.
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+    # The nested suite runs its own interpreter; the outer session's
+    # sanitizers already cover this code in-process.
+    env["NOMAD_TPU_SANITIZERS"] = "0"
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", *SUITE, "-m", "multichip",
+         "-q", "-p", "no:cacheprovider"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-2000:])
+    m = re.search(r"(\d+) passed", r.stdout)
+    assert m, r.stdout[-2000:]
+    # The lane must actually run the suite, not deselect it away.
+    assert int(m.group(1)) >= 15, r.stdout[-2000:]

@@ -1,0 +1,229 @@
+"""Columnar node table (structs/node_slab.py + store bulk path).
+
+The 100k-1M-node contract: a NodeSlab's lazy rows must be
+indistinguishable from full Node objects everywhere one is read — dict
+round trip, store semantics, fleet tensors, constraint masks, and
+end-to-end scheduler placements — while the bulk-load and
+fleet-build paths never walk per-node Python.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.models.constraints import compile_group_mask
+from nomad_tpu.models.fleet import build_fleet, fleet_cache
+from nomad_tpu.scheduler.util import task_group_constraints
+from nomad_tpu.state.store import StateStore
+from nomad_tpu.structs import Node, node_slab_of
+
+pytestmark = pytest.mark.multichip
+
+
+def _norm(d: dict) -> dict:
+    d = dict(d)
+    d["id"] = "X"
+    d["create_index"] = 0
+    d["modify_index"] = 0
+    return d
+
+
+def test_slab_row_materializes_bit_identical_to_mock_node():
+    slab = mock.node_slab(8)
+    for r in (0, 3, 7):
+        assert _norm(slab.node(r).to_dict()) == _norm(mock.node(r).to_dict())
+
+
+def test_store_bulk_upsert_semantics():
+    slab = mock.node_slab(6)
+    st = StateStore()
+    st.upsert_node_slab(42, slab)
+    assert st.get_index("nodes") == 42
+    nodes = list(st.nodes())
+    assert len(nodes) == 6
+    assert node_slab_of(nodes) is slab
+    for n in nodes:
+        assert st.node_by_id(n.id) is n
+        assert n.create_index == n.modify_index == 42
+    # A later object-path write rides the normal upsert contract and
+    # detaches that row from the slab fast path.
+    st.update_node_status(43, nodes[2].id, "down")
+    assert node_slab_of(list(st.nodes())) is None
+    assert st.node_by_id(nodes[2].id).status == "down"
+    # The untouched rows still read through the slab.
+    assert st.node_by_id(nodes[3].id).resources.cpu == 4000
+
+
+def test_slab_copy_honors_node_copy_contract():
+    slab = mock.node_slab(3)
+    row = slab.node(1)
+    c = row.copy()
+    # Deep-dict contract: mutating the copy's attributes never leaks
+    # into the slab template or sibling rows.
+    c.attributes["kernel.name"] = "plan9"
+    assert row.attributes["kernel.name"] == "linux"
+    assert slab.node(2).attributes["kernel.name"] == "linux"
+    # Scalar writes flag the row as mutated (fast-path disqualifier).
+    c2 = slab.node(2).copy()
+    c2.drain = True
+    assert "_hmut" in c2.__dict__
+    assert "_hmut" not in slab.node(2).__dict__
+
+
+def _object_twin(slab) -> list:
+    """Plain Node objects with the SAME ids/content as the slab rows —
+    the object-path control for byte-parity comparisons."""
+    return [Node.from_dict(slab.node(r).to_dict()) for r in range(slab.n)]
+
+
+def test_build_fleet_slab_fast_path_byte_parity():
+    slab = mock.node_slab(24)
+    st = StateStore()
+    st.upsert_node_slab(7, slab)
+    fast = build_fleet(list(st.nodes()))
+    assert fast.uniform
+    ref = build_fleet(_object_twin(slab))
+    assert not ref.uniform
+    np.testing.assert_array_equal(fast.capacity, ref.capacity)
+    np.testing.assert_array_equal(fast.reserved, ref.reserved)
+    np.testing.assert_array_equal(fast.ready, ref.ready)
+    assert list(fast.datacenters[:24]) == list(ref.datacenters[:24])
+    assert fast.node_ids == ref.node_ids
+    assert fast.index_of == ref.index_of
+    assert fast.attr_rows[23] == ref.attr_rows[23]
+    assert len(fast.attr_rows) == len(ref.attr_rows) == 24
+
+
+def test_uniform_constraint_masks_match_object_walk():
+    """The one-representative-row mask compilation (uniform fleets)
+    must produce byte-identical masks to the per-node walk — dc,
+    constraint, and driver masks composed."""
+    slab = mock.node_slab(16)
+    st = StateStore()
+    st.upsert_node_slab(7, slab)
+    fast = build_fleet(list(st.nodes()))
+    ref = build_fleet(_object_twin(slab))
+    job = mock.job()
+    tgc = task_group_constraints(job.task_groups[0])
+    m_fast, d_fast = compile_group_mask(
+        fast, job.datacenters, job.constraints, tgc.constraints,
+        tgc.drivers)
+    m_ref, d_ref = compile_group_mask(
+        ref, job.datacenters, job.constraints, tgc.constraints,
+        tgc.drivers)
+    assert d_fast == d_ref
+    np.testing.assert_array_equal(m_fast, m_ref)
+    # A constraint no node meets: uniform verdict False everywhere.
+    from nomad_tpu.structs import Constraint
+
+    bad = Constraint(hard=True, l_target="$attr.kernel.name",
+                     r_target="plan9", operand="=")
+    m2, _ = compile_group_mask(fast, job.datacenters, [bad], [], set())
+    assert not m2.any()
+
+
+def test_scheduler_places_identically_on_slab_and_object_fleets():
+    """End to end: the same job stream against a slab-backed store and
+    its object-backed twin (same node ids) places byte-identically."""
+    from nomad_tpu.scheduler import Harness
+    from nomad_tpu.structs import (EVAL_TRIGGER_JOB_REGISTER, Evaluation,
+                                   generate_uuid)
+
+    slab = mock.node_slab(16)
+
+    def run(object_path: bool):
+        h = Harness()
+        if object_path:
+            for n in _object_twin(slab):
+                h.state.upsert_node(h.next_index(), n)
+        else:
+            h.state.upsert_node_slab(h.next_index(), slab)
+        placements = []
+        for _ in range(3):
+            job = mock.job()
+            job.task_groups[0].count = 6
+            h.state.upsert_job(h.next_index(), job)
+            ev = Evaluation(id=generate_uuid(), priority=job.priority,
+                            type=job.type,
+                            triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+                            job_id=job.id)
+            h.process("jax-binpack", ev)
+            rows = sorted(
+                (a.node_id, a.task_group)
+                for a in h.state.allocs_by_job(job.id)
+                if not a.terminal_status())
+            placements.append(rows)
+        return placements
+
+    slab_rows = run(object_path=False)
+    obj_rows = run(object_path=True)
+    assert slab_rows == obj_rows
+    assert sum(len(r) for r in slab_rows) == 18
+
+
+def test_mutated_row_falls_back_to_exact_object_build():
+    """One drained node: the fleet build leaves the fast path and the
+    scheduler must see the drain (no placement on that node)."""
+    slab = mock.node_slab(4)
+    st = StateStore()
+    st.upsert_node_slab(5, slab)
+    drained = list(st.nodes())[1]
+    st.update_node_drain(6, drained.id, True)
+    statics = fleet_cache.statics_for(st)
+    assert not statics.uniform
+    di = statics.index_of[drained.id]
+    assert not statics.ready[di]
+    assert statics.ready[statics.index_of[list(st.nodes())[0].id]]
+
+
+def test_per_row_constraint_targets_skip_the_uniform_fast_path():
+    """$node.id / $node.name resolve per ROW (dense slab columns), so
+    the one-representative-row mask compilation must not broadcast
+    them — review finding: a $node.name = node-5 constraint on a
+    uniform fleet compiled to all-False."""
+    from nomad_tpu.models.constraints import compile_constraint_mask
+    from nomad_tpu.structs import Constraint
+
+    slab = mock.node_slab(8)
+    st = StateStore()
+    st.upsert_node_slab(7, slab)
+    fast = build_fleet(list(st.nodes()))
+    assert fast.uniform
+    ref = build_fleet(_object_twin(slab))
+    for c in (
+        Constraint(hard=True, l_target="$node.name", r_target="node-5",
+                   operand="="),
+        Constraint(hard=True, l_target="$node.name", r_target="node-5",
+                   operand="!="),
+        Constraint(hard=True, l_target="$node.id",
+                   r_target=slab.ids[3], operand="="),
+        # Covered-by-uniform targets still take the fast path and must
+        # agree too.
+        Constraint(hard=True, l_target="$node.datacenter",
+                   r_target="dc1", operand="="),
+    ):
+        np.testing.assert_array_equal(
+            compile_constraint_mask(fast, c),
+            compile_constraint_mask(ref, c), err_msg=str(c))
+    # The node-5 equality mask really selects exactly row 5.
+    m = compile_constraint_mask(
+        fast, Constraint(hard=True, l_target="$node.name",
+                         r_target="node-5", operand="="))
+    assert m[:8].tolist() == [False] * 5 + [True] + [False] * 2
+
+
+def test_bulk_upsert_stamps_pre_materialized_rows():
+    """A row materialized BEFORE the bulk upsert (slab.node/rows are
+    public) must still read the upsert's index from the store — review
+    finding: cached rows kept their eager dict's stale index."""
+    slab = mock.node_slab(4)
+    early = slab.node(2)  # materialized pre-upsert, index still 0
+    assert early.modify_index == 0
+    st = StateStore()
+    st.upsert_node_slab(42, slab)
+    assert st.node_by_id(slab.ids[2]) is early
+    assert early.create_index == early.modify_index == 42
+    # And the stamp rode the internal poke path: the row is still an
+    # unmutated slab row (fast path intact).
+    assert node_slab_of(list(st.nodes())) is slab

@@ -13,8 +13,15 @@ import pytest
 import nomad_tpu.mock as mock
 from nomad_tpu.models.fleet import build_fleet, build_usage
 from nomad_tpu.ops.binpack import place_sequence, place_sequence_batch
-from nomad_tpu.parallel.mesh import fleet_mesh, place_sequence_sharded
+from nomad_tpu.parallel.mesh import (fleet_mesh, mesh_override,
+                                     place_sequence_sharded)
 from nomad_tpu.structs import Resources
+
+# The whole module is the sharded-parity suite: the tier-1 subprocess
+# rig (tests/test_multichip_rig.py) re-drives it `-m multichip` under
+# hermetically forced XLA flags so mesh regressions fail before a TPU
+# ever sees them.
+pytestmark = pytest.mark.multichip
 
 
 def _problem(n_nodes=64, n_place=16):
@@ -229,3 +236,125 @@ def test_storm_mesh_validates_lane_ways():
 
     with pytest.raises(ValueError, match="must divide"):
         storm_mesh(3, jax.devices("cpu"))  # 3 does not divide 8
+
+
+# -- end-to-end sharded parity (ISSUE 12 acceptance) -----------------------
+# Not kernel-level: the full scheduler stream — reconcile, prep, device
+# dispatch, finish, plan COMMIT — run sharded (mesh auto-resolved on the
+# 8-device host) and unsharded (mesh_override("off")), byte-identical
+# placements asserted per eval, including after the UsageMirror's
+# incremental device scatters between commits.
+
+
+def _stream_rig(n_nodes: int, n_jobs: int, count: int):
+    from nomad_tpu.scheduler import Harness
+
+    h = Harness()
+    for i in range(n_nodes):
+        h.state.upsert_node(h.next_index(), mock.node(i))
+    jobs = []
+    for _ in range(n_jobs):
+        job = mock.job()
+        job.task_groups[0].count = count
+        h.state.upsert_job(h.next_index(), job)
+        jobs.append(job)
+    return h, jobs
+
+
+def _run_stream(policy, n_nodes=24, n_jobs=6, count=8):
+    """One committed eval stream under a mesh policy.  Returns
+    (per-eval placement rows as node INDEXES, runner, mirror) — node
+    ids are fresh uuids per rig, so parity compares positional node
+    identity, which is exactly what the kernels choose."""
+    from nomad_tpu.scheduler.executor import executor_override
+    from nomad_tpu.scheduler.pipeline import PipelinedEvalRunner
+    from nomad_tpu.models.fleet import fleet_cache, mirror_for
+
+    h, jobs = _stream_rig(n_nodes, n_jobs, count)
+    index_of = {n.id: i for i, n in enumerate(h.state.nodes())}
+    runner = PipelinedEvalRunner(h.state.snapshot(), h, depth=3,
+                                 state_refresh=h.snapshot)
+    with mesh_override(policy), executor_override("device"):
+        # Process one eval at a time with a refreshed snapshot so every
+        # commit lands before the next eval plans — each eval's view
+        # then rides the mirror's scatter-updated device copy.
+        for job in jobs:
+            runner.state = h.snapshot()
+            runner.process([make_eval_for(job)])
+    placements = []
+    for plan in h.plans:
+        rows = []
+        for node_id, allocs in sorted(plan.node_allocation.items(),
+                                      key=lambda kv: index_of[kv[0]]):
+            for a in allocs:
+                rows.append((index_of[node_id], a.task_group))
+        placements.append(sorted(rows))
+    statics = fleet_cache.statics_for(h.state)
+    return placements, runner, mirror_for(statics), h
+
+
+def make_eval_for(job):
+    from nomad_tpu.structs import (EVAL_TRIGGER_JOB_REGISTER, Evaluation,
+                                   generate_uuid)
+
+    return Evaluation(id=generate_uuid(), priority=job.priority,
+                      type=job.type,
+                      triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+                      job_id=job.id)
+
+
+def test_sharded_stream_byte_identical_placements():
+    """Sharded and unsharded committed streams place byte-identically
+    — every eval, every instance, every chosen node — including evals
+    whose usage view came off the mirror's scatter-maintained device
+    copy (commits land between evals)."""
+    sharded, runner_s, mirror_s, h_s = _run_stream("auto")
+    unsharded, runner_u, _mirror_u, _h_u = _run_stream("off")
+
+    assert runner_s.device_dispatches > 0
+    assert runner_s.sharded_dispatches == runner_s.device_dispatches, \
+        "auto mesh policy must shard every device dispatch on 8 devices"
+    assert runner_u.sharded_dispatches == 0
+    assert sharded == unsharded
+    # Real work happened: every job placed its full count.
+    assert sum(len(p) for p in sharded) == 6 * 8
+
+    # The mirror's sharded twin (the PRIMARY usage of the sharded
+    # dispatches) tracked every commit: it must equal the host mirror
+    # byte for byte after the stream.
+    from nomad_tpu.parallel.mesh import dispatch_mesh
+    from nomad_tpu.models.fleet import fleet_cache
+
+    statics = fleet_cache.statics_for(h_s.state)
+    mesh = dispatch_mesh(1, statics.n_pad)
+    assert mesh is not None
+    mirror_s.sync(h_s.state)
+    buf = mirror_s.device_usage_sharded(mesh, mirror_s.usage)
+    assert buf is not None
+    np.testing.assert_array_equal(np.asarray(buf), mirror_s.usage)
+
+
+def test_sharded_storm_byte_identical_placements():
+    """The fused storm (BatchEvalRunner, 2-D storm mesh on 8 devices)
+    vs its single-device twin: byte-identical placements lane for
+    lane."""
+    from nomad_tpu.scheduler.batch import BatchEvalRunner
+    from nomad_tpu.scheduler.executor import executor_override
+
+    def run(policy):
+        h, jobs = _stream_rig(n_nodes=16, n_jobs=4, count=6)
+        index_of = {n.id: i for i, n in enumerate(h.state.nodes())}
+        with mesh_override(policy), executor_override("device"):
+            BatchEvalRunner(h.state.snapshot(), h,
+                            state_refresh=h.snapshot).process(
+                [make_eval_for(j) for j in jobs])
+        out = []
+        for plan in h.plans:
+            rows = []
+            for node_id, allocs in plan.node_allocation.items():
+                rows.extend((index_of[node_id], a.task_group)
+                            for a in allocs)
+            out.append(sorted(rows))
+        return out
+
+    assert run("auto") == run("off")

@@ -328,6 +328,52 @@ class TestLintGate:
                        for e in allowlist), \
             "crash-recovery plane must not need allowlist entries"
 
+    def test_sharded_fleet_paths_ride_the_gates(self):
+        """ISSUE 12 satellite: the first-class sharding plane — the
+        mesh-resolution authority (parallel/mesh.dispatch_mesh), the
+        unified ShardedResidency, the sharded single-eval dispatch,
+        and the columnar node table (structs/node_slab.py + the store
+        bulk path) — is inside every gate's scan set, strict-clean,
+        and the touched models/ modules carry ZERO allowlist entries:
+        the three UsageMirror double-checked-read waivers are retired
+        (sync/sync_net now fence under the mirror lock) and must stay
+        retired."""
+        from nomad_tpu.analysis import (default_allowlist_path,
+                                        default_package_root,
+                                        load_allowlist)
+        from nomad_tpu.analysis.callgraph import CallGraph
+
+        pkg = default_package_root()
+        graph = CallGraph.build(pkg)
+        for qual in (
+            "nomad_tpu.parallel.mesh:dispatch_mesh",
+            "nomad_tpu.models.fleet:ShardedResidency.install",
+            "nomad_tpu.models.fleet:UsageMirror.device_usage_sharded",
+            "nomad_tpu.models.fleet:UsageMirror.sync",
+            "nomad_tpu.models.fleet:_build_fleet_slab",
+            "nomad_tpu.scheduler.jax_binpack:"
+            "JaxBinPackScheduler._dispatch_device_sharded",
+            "nomad_tpu.structs.node_slab:NodeSlab.node",
+            "nomad_tpu.structs.node_slab:node_slab_of",
+            "nomad_tpu.state.store:StateStore.upsert_node_slab",
+        ):
+            assert qual in graph.functions, \
+                f"{qual} missing from the interprocedural graph"
+
+        allowlist = load_allowlist(default_allowlist_path())
+        gating, _allowed, _stale = partition_findings(
+            run_lint(strict=True), allowlist)
+        touching = [f for f in gating
+                    if "parallel/" in f.path or "models/" in f.path
+                    or "node_slab" in f.path]
+        assert touching == [], \
+            "sharding plane must lint clean:\n" + \
+            "\n".join(f.render() for f in touching)
+        assert not any("models/" in e or "parallel/" in e
+                       or "node_slab" in e for e in allowlist), \
+            "models/ + parallel/ must carry zero allowlist entries " \
+            "(the UsageMirror waivers are retired)"
+
     def test_columnar_paths_ride_the_gates(self):
         """ISSUE 9 satellite: the columnar alloc contract — the
         AllocSlab/SlabAlloc module (structs/alloc_slab.py), the
