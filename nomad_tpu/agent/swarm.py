@@ -203,18 +203,15 @@ class AgentSwarm:
 
     # -- lifecycle ----------------------------------------------------------
     def start(self, register_timeout: float = 120.0) -> None:
+        # First beats are armed PER AGENT as its own registration
+        # lands (register_all's success callback), exactly like a real
+        # agent: the earliest-registered nodes carry the server's
+        # minimum ~10s rate-scaled TTL, so waiting for the WHOLE
+        # fleet to register before anyone beat tied their liveness to
+        # fleet-wide registration time — on a host slower than
+        # fleet/10s of registration throughput the early cohort
+        # genuinely expired before its first beat.
         self.register_all(timeout=register_timeout)
-        for i in range(self.n_agents):
-            # Staggered first beats: 10k agents must not heartbeat in
-            # lockstep (the server's own TTL jitter solves the same
-            # problem on the expiry side).  The first beat lands within
-            # ~5s regardless of cadence: the server's rate-scaled TTL
-            # starts near its 10s floor for the earliest-registered
-            # nodes and only grows as the fleet arms.
-            self._wheel.arm(f"hb:{i}",
-                            self._rng.uniform(0.05,
-                                              min(self.beat_interval,
-                                                  5.0)))
         if self.long_polls:
             for i in range(self.n_agents):
                 self._issue_poll(i)
@@ -239,6 +236,16 @@ class AgentSwarm:
                     if exc is not None:
                         failed.append(i)
                     cond.notify_all()
+                if exc is None:
+                    # Registered: this agent starts heartbeating NOW
+                    # (staggered within its cadence so the fleet never
+                    # beats in lockstep), not when the whole swarm is
+                    # up — its TTL is already running.  Idempotent
+                    # retry registrations just re-stagger the beat.
+                    self._wheel.arm(f"hb:{i}",
+                                    self._rng.uniform(
+                                        0.05, min(self.beat_interval,
+                                                  5.0)))
 
             for i in pending:
                 with cond:

@@ -176,10 +176,11 @@ class StallWatchdog:
     thread — the lifecycle lint requires every thread reaped."""
 
     def __init__(self, on_stall) -> None:
-        self.on_stall = on_stall     # fn(name, age_seconds)
+        self.on_stall = on_stall     # fn(name, age_seconds, extra)
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._armed: dict = {}       # token -> (name, armed_at, deadline)
+        self._armed: dict = {}   # token -> (name, armed_at, deadline,
+        #                                    extra_fn)
         self._tripped: set = set()   # tokens already reported
         self._seq = 0
         self._stopped = False
@@ -187,12 +188,17 @@ class StallWatchdog:
                                         name="flight-stall-watchdog")
         self._thread.start()
 
-    def arm(self, name: str, timeout: float) -> str:
+    def arm(self, name: str, timeout: float, extra_fn=None) -> str:
+        """``extra_fn`` (optional, zero-arg -> dict) is called AT trip
+        time on the watchdog thread and merged into the incident's
+        extra — the guarded section's own attribution of what it is
+        stuck on (the plan applier passes its component executor's
+        ``active()``, so a wedged window names the slow component)."""
         with self._cond:
             self._seq += 1
             token = f"g{self._seq}"
             now = time.monotonic()
-            self._armed[token] = (name, now, now + timeout)
+            self._armed[token] = (name, now, now + timeout, extra_fn)
             self._cond.notify_all()
             return token
 
@@ -202,8 +208,8 @@ class StallWatchdog:
             self._tripped.discard(token)
 
     @contextmanager
-    def guard(self, name: str, timeout: float):
-        token = self.arm(name, timeout)
+    def guard(self, name: str, timeout: float, extra_fn=None):
+        token = self.arm(name, timeout, extra_fn)
         try:
             yield
         finally:
@@ -217,13 +223,13 @@ class StallWatchdog:
                     return
                 now = time.monotonic()
                 next_deadline = None
-                for token, (name, armed_at, deadline) in \
+                for token, (name, armed_at, deadline, extra_fn) in \
                         self._armed.items():
                     if token in self._tripped:
                         continue
                     if now >= deadline:
                         self._tripped.add(token)
-                        fire.append((name, now - armed_at))
+                        fire.append((name, now - armed_at, extra_fn))
                     elif next_deadline is None or \
                             deadline < next_deadline:
                         next_deadline = deadline
@@ -233,9 +239,18 @@ class StallWatchdog:
                     self._cond.wait(None if next_deadline is None
                                     else next_deadline - now)
                     continue
-            for name, age in fire:
+            for name, age, extra_fn in fire:
+                extra = None
+                if extra_fn is not None:
+                    # The section's own attribution, best-effort: a
+                    # failing extra_fn must not eat the incident.
+                    try:
+                        extra = extra_fn()
+                    except Exception:
+                        logger.exception(
+                            "stall attribution for %r failed", name)
                 try:
-                    self.on_stall(name, age)
+                    self.on_stall(name, age, extra)
                 except Exception:
                     logger.exception("stall watchdog callback failed")
 
@@ -262,8 +277,9 @@ def install(directory: str, registries: Optional[list] = None,
     rec = FlightRecorder(directory, registries=registries, **kw)
     _RECORDER = rec
     _WATCHDOG = StallWatchdog(
-        lambda name, age: trip("stall." + name,
-                               {"stalled_for_s": round(age, 3)}))
+        lambda name, age, extra: trip(
+            "stall." + name,
+            dict(extra or {}, stalled_for_s=round(age, 3))))
     INSTALLED = True
     return rec
 
@@ -302,12 +318,14 @@ def trip(reason: str, extra: Optional[dict] = None) -> Optional[str]:
 
 
 @contextmanager
-def guard(name: str, timeout: float):
+def guard(name: str, timeout: float, extra_fn=None):
     """Stall-guard a section: if it overstays ``timeout`` the watchdog
-    trips ``stall.<name>``.  No-op when no recorder is installed."""
+    trips ``stall.<name>``, merging ``extra_fn()`` (the section's own
+    attribution — e.g. which window component is still verifying) into
+    the incident extra.  No-op when no recorder is installed."""
     watchdog = _WATCHDOG
     if watchdog is None:
         yield
         return
-    with watchdog.guard(name, timeout):
+    with watchdog.guard(name, timeout, extra_fn=extra_fn):
         yield

@@ -39,6 +39,17 @@ that synthesizes a finished span from explicit (t0, dur, ctx) — the
 broker's queue-wait span, the applier's per-plan window spans, and the
 pipelined runner's cross-thread stage spans all use it.
 
+Applier span taxonomy (the partitioned window verify, ISSUE 13): each
+member plan's tree carries ``plan.queued`` (enqueue -> window pop),
+then ``applier.window`` (shared t0/dur across the window, tagged
+``window`` size and ``components`` count), and under it one
+``applier.verify`` span carrying the timing of the claim-graph
+COMPONENT that plan verified in (tagged ``component`` scheduling
+ordinal, ``size``, ``fallback``) — component walks run concurrently on
+the applier's ComponentExecutor, so sibling verify spans under the same
+window overlap in time, which is the concurrency made visible.
+``raft.apply`` follows as before (shared per window, one per member).
+
 Export is Chrome-trace JSON (``chrome://tracing`` / Perfetto "X"
 complete events), span tags riding in ``args``.
 """

@@ -1,4 +1,4 @@
-"""Vectorized cross-plan conflict windows for the group-commit applier.
+"""Partitioned cross-plan conflict windows for the group-commit applier.
 
 The leader's plan applier is the serialization point of optimistic
 concurrency (server/plan_apply.py): under a contended storm it pays one
@@ -9,26 +9,48 @@ verify side for a whole *window* of pending plans:
     ``_evaluate_plan_vec`` — is computed for every (plan, node) claim in
     the window with a handful of dense array ops against the base
     snapshot's incremental usage mirror (models/fleet.py UsageMirror);
-  - order sensitivity is preserved exactly by a *window overlay* over
-    the mirror (``_WindowState``): plans are judged in eval order, and
-    each plan's accepted portion is folded into the overlay before the
-    next plan's verdicts — so plan i's claims are checked against
-    committed state plus every earlier non-conflicting claim in the
-    window, exactly the state sequential application would have reached;
+  - the window is PARTITIONED into connected components of the claim
+    graph (``partition_window``: plans are vertices, joined when they
+    claim a node in common).  Plans in different components touch
+    disjoint node sets and therefore *cannot* conflict — each component
+    verifies independently (concurrently, when the applier passes its
+    component executor), while eval order is preserved exactly *within*
+    each component;
+  - order sensitivity within a component rides a *component overlay*
+    (``_WindowState``) over a read-only per-window ``_Frame`` copied
+    from the mirror: each plan's accepted portion is folded into the
+    overlay before the next plan's verdicts — so plan i's claims are
+    checked against committed state plus every earlier claim that could
+    possibly interact with them, exactly the state sequential
+    application would have reached;
   - claims the incremental path cannot serve (node not in the fleet,
-    odd network topology) punt to the exact scalar walk against an
-    OptimisticSnapshot carrying the same folds, exactly as the per-plan
-    verifier punts them.
+    odd network topology) punt to the exact scalar walk against a
+    component-local OptimisticSnapshot carrying the same folds, exactly
+    as the per-plan verifier punts them.
+
+The frame is copied under the mirror lock and the lock is RELEASED
+before any component walks, so concurrent worker-side syncs are never
+blocked behind a window verify (the old code held the mirror for the
+whole pass).
+
+Deadline-aware component scheduling: components are ordered by their
+nearest member deadline (then window position), and the executor starts
+them in that order — under saturation a near-deadline plan's component
+verifies first, which together with the plan queue's deadline-promoted
+drain keeps ``expired_drops`` at 0.
 
 A plan whose claims overlap an earlier plan in the window (the
 order-sensitive prefix conflict) is reported as a ``fallback`` — its
-verdicts rode the window overlay rather than the clean dense pass — and
-counted by the applier's ``conflict_fallbacks`` stat.
+verdicts rode the component overlay rather than the clean dense pass —
+and counted by the applier's ``conflict_fallbacks`` stat.  Because two
+overlapping plans are by construction in the same component, the flag
+means exactly what it meant when the window was one flat list.
 
 Results are identical to calling ``evaluate_plan`` per plan in eval
 order with the accepted portion of each plan folded into the view before
-the next — the property the group-commit parity test
-(tests/test_plan_batch.py) locks down.
+the next — the property the group-commit parity rigs
+(tests/test_plan_batch.py) lock down for both the partitioned and the
+``partition=False`` sequential path.
 """
 from __future__ import annotations
 
@@ -43,24 +65,46 @@ from nomad_tpu.utils.metrics import metrics
 
 _MISS = object()
 
+# Components below this size verify inline on the applier thread even
+# when an executor is available: a saturated-but-uncontended window is
+# dozens of single-plan components whose walks are a few microseconds
+# of GIL-bound Python — worker handoff costs more than it buys.  A
+# component at or past this size carries a real conflict cluster (an
+# ordered chain of folds and possibly exact-walk punts), which is what
+# concurrent verification exists for.
+MIN_CONCURRENT_COMPONENT = 8
+
 
 class WindowOutcome:
     """One plan's verdict within a window."""
 
-    __slots__ = ("result", "fallback")
+    __slots__ = ("result", "fallback", "component")
 
-    def __init__(self, result: PlanResult, fallback: bool) -> None:
+    def __init__(self, result: PlanResult, fallback: bool,
+                 component: int = 0) -> None:
         self.result = result
         # True when this plan's claims overlapped an earlier plan in the
         # window (or an in-flight apply) — the order-sensitive prefix
-        # conflict: its verdicts came from the window overlay, not the
-        # clean dense pass.
+        # conflict: its verdicts came from the component overlay, not
+        # the clean dense pass.
         self.fallback = fallback
+        # Scheduling-order index of the claim-graph component this plan
+        # verified in (0 on the unpartitioned paths).
+        self.component = component
+
+
+class WindowVerdicts(list):
+    """The outcomes list plus window-level partition/scheduling info
+    (``.info`` — None on the paths that never partitioned)."""
+
+    def __init__(self, outcomes, info: Optional[dict] = None) -> None:
+        super().__init__(outcomes)
+        self.info = info
 
 
 class _OverGet:
     """dict-shaped ``.get`` view: window overrides chained over the base
-    mirror's dict.  An override of None is a tombstone (entry removed
+    frame's dict.  An override of None is a tombstone (entry removed
     within the window)."""
 
     __slots__ = ("over", "base")
@@ -96,24 +140,71 @@ class _DupGet:
         return dup if dup else default
 
 
+class _Frame:
+    """Read-only per-window copy of the mirror state the component
+    walks consume, restricted to the window's touched nodes and claimed
+    alloc ids.  Copied under the mirror lock, read without it — the
+    lock is released before any component verifies, so worker-side
+    mirror syncs never queue behind a window, and component walks on
+    executor threads never read mirror state the lock discipline
+    guards."""
+
+    __slots__ = ("alloc_rows", "net_rows", "node_ports", "node_bw",
+                 "node_net_keys", "node_dup")
+
+    def __init__(self, mirror, ids, nis) -> None:
+        alloc_rows = {}
+        net_rows = {}
+        m_rows = mirror.alloc_rows
+        m_net = mirror.net_rows
+        nis = set(nis)  # caller's set stays untouched; adds are O(1)
+        for aid in ids:
+            row = m_rows.get(aid)
+            if row is not None:
+                alloc_rows[aid] = (row[0], row[1])
+                nis.add(row[0])
+            nr = m_net.get(aid)
+            if nr is not None:
+                net_rows[aid] = nr
+                nis.add(nr[0])
+        self.alloc_rows = alloc_rows
+        self.net_rows = net_rows
+        self.node_ports = {}
+        self.node_bw = {}
+        self.node_net_keys = {}
+        self.node_dup = {}
+        for ni in nis:
+            pc = mirror.node_ports.get(ni)
+            if pc is not None:
+                self.node_ports[ni] = dict(pc)
+            bw = mirror.node_bw.get(ni)
+            if bw:
+                self.node_bw[ni] = bw
+            keys = mirror.node_net_keys.get(ni)
+            if keys is not None:
+                self.node_net_keys[ni] = dict(keys)
+            dup = mirror.node_dup.get(ni)
+            if dup:
+                self.node_dup[ni] = dup
+
+
 class _WindowState:
-    """Window overlay over a SYNCED UsageMirror: base state plus the
-    accepted portions of earlier plans in the window (and any in-flight
-    apply overlay), exposing exactly the reads the verifier needs —
-    the same ``net_rows/node_ports/node_dup/node_bw/node_net_keys``
-    surface ``plan_apply._verify_node_net`` consumes, plus per-node
-    4-dim usage deltas for the fit check.  Never mutates the mirror:
-    per-node dicts are copied on first window write.
+    """Component overlay over a window ``_Frame``: base state plus the
+    accepted portions of earlier plans in the component (and the
+    in-flight apply's allocs that touch it), exposing exactly the reads
+    the verifier needs — the same
+    ``net_rows/node_ports/node_dup/node_bw/node_net_keys`` surface
+    ``plan_apply._verify_node_net`` consumes, plus per-node 4-dim usage
+    deltas for the fit check.  Never mutates the frame: per-node dicts
+    are copied on first window write."""
 
-    Caller holds the mirror lock for the lifetime of this object."""
-
-    def __init__(self, mirror, statics) -> None:
+    def __init__(self, frame, index_of) -> None:
         from nomad_tpu.models.fleet import _net_row, alloc_vec
 
         self._net_row = _net_row
         self._alloc_vec = alloc_vec
-        self.m = mirror
-        self.index_of = statics.index_of
+        self.m = frame
+        self.index_of = index_of
         self.usage_delta: dict = {}   # ni -> [f, f, f, f]
         self._rows: dict = {}         # aid -> (ni, vec) | None
         self._net_over: dict = {}     # aid -> net row | None
@@ -121,21 +212,20 @@ class _WindowState:
         self._bw: dict = {}           # ni -> merged mbits
         self._keys: dict = {}         # ni -> merged {(ip, dev): count}
         # The verifier-facing surface:
-        self.net_rows = _OverGet(self._net_over, mirror.net_rows)
-        self.node_ports = _OverGet(self._ports, mirror.node_ports)
-        self.node_bw = _OverGet(self._bw, mirror.node_bw)
-        self.node_net_keys = _OverGet(self._keys, mirror.node_net_keys)
-        self.node_dup = _DupGet(self._ports, mirror.node_dup)
+        self.net_rows = _OverGet(self._net_over, frame.net_rows)
+        self.node_ports = _OverGet(self._ports, frame.node_ports)
+        self.node_bw = _OverGet(self._bw, frame.node_bw)
+        self.node_net_keys = _OverGet(self._keys, frame.node_net_keys)
+        self.node_dup = _DupGet(self._ports, frame.node_dup)
 
     # -- removal accounting (the caller's removed_ids walk) ---------------
     def alloc_row(self, aid):
         """(ni, vec) of a live alloc — window override first, then the
-        mirror — or None when absent/removed."""
+        frame — or None when absent/removed."""
         v = self._rows.get(aid, _MISS)
         if v is not _MISS:
             return v
-        row = self.m.alloc_rows.get(aid)
-        return None if row is None else (row[0], row[1])
+        return self.m.alloc_rows.get(aid)
 
     # -- copy-on-write materialization ------------------------------------
     def _ports_for(self, ni) -> dict:
@@ -157,8 +247,8 @@ class _WindowState:
     # -- folds -------------------------------------------------------------
     def fold(self, alloc) -> None:
         """Apply one accepted alloc (placement or eviction) to the
-        window overlay — the same old-row-out/new-row-in transition the
-        mirror's own delta sync performs on commit."""
+        component overlay — the same old-row-out/new-row-in transition
+        the mirror's own delta sync performs on commit."""
         aid = alloc.id
         old = self.alloc_row(aid)
         if old is not None:
@@ -220,6 +310,15 @@ def _touched(plan) -> set:
     return set(plan.node_update) | set(plan.node_allocation)
 
 
+def _plan_alloc_ids(plan) -> set:
+    ids = set()
+    for allocs in plan.node_update.values():
+        ids.update(a.id for a in allocs)
+    for allocs in plan.node_allocation.values():
+        ids.update(a.id for a in allocs)
+    return ids
+
+
 def _accepted_allocs(result) -> list:
     allocs = []
     for updates in result.node_update.values():
@@ -230,15 +329,60 @@ def _accepted_allocs(result) -> list:
     return allocs
 
 
-def evaluate_window(snap, plans: list) -> list:
-    """Verify a window of plans in eval order; returns one WindowOutcome
-    per plan, results identical to sequential ``evaluate_plan`` +
-    fold-into-overlay per plan.
+def partition_window(plans: list) -> list:
+    """Connected components of the window's claim graph: plans are
+    vertices, joined when they claim (place on OR evict from) a node in
+    common.  Returns a list of components, each an ascending list of
+    plan indices, ordered by first member — so concatenating them in
+    order visits a conflict-free permutation of the window.
+
+    Union-find over a node-id -> first-claimant map: O(total claims)
+    with near-constant find, cheap enough to run on every window."""
+    n = len(plans)
+    parent = list(range(n))
+
+    def find(i: int) -> int:
+        while parent[i] != i:
+            parent[i] = parent[parent[i]]  # path halving
+            i = parent[i]
+        return i
+
+    owner: dict = {}
+    for i, plan in enumerate(plans):
+        for nid in _touched(plan):
+            j = owner.get(nid)
+            if j is None:
+                owner[nid] = i
+            else:
+                ri, rj = find(i), find(j)
+                if ri != rj:
+                    # Union by MIN root: a component's root is always
+                    # its earliest plan, keeping output deterministic.
+                    if rj < ri:
+                        ri, rj = rj, ri
+                    parent[rj] = ri
+    comps: dict = {}
+    for i in range(n):
+        comps.setdefault(find(i), []).append(i)
+    return [comps[r] for r in sorted(comps)]
+
+
+def evaluate_window(snap, plans: list, executor=None,
+                    partition: bool = True) -> WindowVerdicts:
+    """Verify a window of plans; returns one WindowOutcome per plan,
+    results identical to sequential ``evaluate_plan`` + fold-into-
+    overlay per plan in eval order.
 
     ``snap`` may be an OptimisticSnapshot carrying an in-flight apply's
     overlay; it is MUTATED — each plan's accepted portion is folded in so
     the caller's overlay ends up exactly as sequential application would
     leave it.
+
+    ``partition=True`` splits the window into claim-graph components
+    (scheduled nearest-deadline-first, concurrently when ``executor``
+    is given); ``partition=False`` keeps the flat one-overlay walk —
+    the pre-partition behavior, kept as the bench's in-run sequential
+    baseline and exercised by the parity rigs.
     """
     from nomad_tpu.server.plan_apply import (
         OptimisticSnapshot,
@@ -259,14 +403,14 @@ def evaluate_window(snap, plans: list) -> list:
             # Only a caller-owned overlay needs the fold; a throwaway
             # one built here is dead work.
             overlay.upsert_allocs(_accepted_allocs(result))
-        return [WindowOutcome(result, fallback)]
+        return WindowVerdicts([WindowOutcome(result, fallback)])
 
     start = time.perf_counter()
-    outcomes = _evaluate_window_vec(overlay, plans)
+    outcomes = _evaluate_window_vec(overlay, plans, executor, partition)
     if outcomes is None:
         # No incremental mirror for this snapshot: per-plan exact path
         # against the running overlay, still in eval order.
-        outcomes = []
+        outcomes = WindowVerdicts([])
         dirty: set = {n for n in overlay._by_node if n}
         for plan in plans:
             nodes = _touched(plan)
@@ -281,15 +425,24 @@ def evaluate_window(snap, plans: list) -> list:
     return outcomes
 
 
-def _evaluate_window_vec(overlay, plans: list) -> Optional[list]:
-    """The vectorized window pass: dense base fit for every claim, then
-    an in-order verdict walk against the window overlay.  Returns None
-    when the snapshot cannot take the incremental path at all."""
+class _Prep:
+    """Everything the component walks share, frozen by the coordinator
+    before any component starts: the dense base-fit results, the frame,
+    and the in-flight overlay's contents.  Read-only once built."""
+
+    __slots__ = ("plans", "plan_nodes", "verdicts", "pairs", "pair_of",
+                 "base_used", "caps", "frame", "index_of", "statics",
+                 "base", "refresh_index", "inflight", "inflight_nodes",
+                 "inflight_by_node", "inflight_by_id")
+
+
+def _evaluate_window_vec(overlay, plans: list, executor,
+                         partition: bool) -> Optional[WindowVerdicts]:
+    """The vectorized window pass: dense base fit for every claim under
+    the mirror lock, then per-component in-order verdict walks against
+    the released frame.  Returns None when the snapshot cannot take the
+    incremental path at all."""
     from nomad_tpu.models.fleet import alloc_vec, fleet_cache, mirror_for
-    from nomad_tpu.server.plan_apply import (
-        _evaluate_node_plan,
-        _verify_node_net,
-    )
     from nomad_tpu.structs import NODE_STATUS_READY
 
     base = overlay.base
@@ -301,7 +454,7 @@ def _evaluate_window_vec(overlay, plans: list) -> Optional[list]:
         # The fallback stat keeps the uniform definition (claims
         # overlapping an earlier plan's touched nodes) even though the
         # verdicts here are state-independent.
-        outcomes = []
+        outcomes = WindowVerdicts([])
         claimed = {n for n in overlay._by_node if n}
         for plan in plans:
             nodes = _touched(plan)
@@ -321,9 +474,31 @@ def _evaluate_window_vec(overlay, plans: list) -> Optional[list]:
     capacity = statics.capacity
     index_of = statics.index_of
 
+    prep = _Prep()
+    prep.plans = plans
+    prep.base = base
+    prep.statics = statics
+    prep.index_of = index_of
+    prep.refresh_index = max(overlay.get_index("nodes"),
+                             overlay.get_index("allocs"))
+    prep.inflight = list(overlay._overlay.values())
+    prep.inflight_nodes = {n for n in overlay._by_node if n}
+    # Indexed ONCE per window: each component slices the in-flight
+    # overlay by ITS nodes/ids in O(component), not O(overlay) — a
+    # per-component scan would re-grow the O(window^2) fold churn the
+    # partition exists to remove.  Entries carry their overlay
+    # insertion ordinal so component folds keep the sequential order.
+    prep.inflight_by_node = by_node = {}
+    prep.inflight_by_id = by_id = {}
+    for k, a in enumerate(prep.inflight):
+        by_node.setdefault(a.node_id, []).append((k, a))
+        by_id[a.id] = (k, a)
+    prep.plan_nodes = [_touched(p) for p in plans]
+
     # The net dicts are mutated in place by concurrent worker syncs;
-    # hold the mirror for the whole composite read (same discipline as
-    # the per-plan vector pass).
+    # hold the mirror for the composite read — but ONLY for the dense
+    # pass and the frame copy: the component walks run lock-free
+    # against the frame.
     with mirror.lock:
         if not mirror.sync_net(base):
             return None  # snapshot older than the mirror: scalar truth
@@ -336,13 +511,21 @@ def _evaluate_window_vec(overlay, plans: list) -> Optional[list]:
         pairs: list = []     # (plan_i, nid, ni, node, placements, removed)
         vec_rows: list = []  # placement resource vectors
         vec_pair: list = []  # pair index per vec row
+        frame_ids: set = set()
+        touched_nis: set = set()
         for i, plan in enumerate(plans):
             pv = verdicts[i]
-            for nid in _touched(plan):
+            for nid in prep.plan_nodes[i]:
                 placements = plan.node_allocation.get(nid)
+                removed = {a.id for a in plan.node_update.get(nid, ())}
+                frame_ids |= removed
                 if not placements:
                     pv[nid] = True  # evict-only claims always fit
+                    ni = index_of.get(nid, -1)
+                    if ni >= 0:
+                        touched_nis.add(ni)
                     continue
+                frame_ids.update(a.id for a in placements)
                 node = base.node_by_id(nid)
                 if node is None or node.status != NODE_STATUS_READY \
                         or node.drain:
@@ -352,7 +535,7 @@ def _evaluate_window_vec(overlay, plans: list) -> Optional[list]:
                 if ni < 0:
                     pv[nid] = None  # not in fleet: exact walk
                     continue
-                removed = {a.id for a in plan.node_update.get(nid, ())}
+                touched_nis.add(ni)
                 removed.update(a.id for a in placements)  # in-place upd
                 pair = len(pairs)
                 pairs.append((i, nid, ni, node, placements, removed))
@@ -376,74 +559,204 @@ def _evaluate_window_vec(overlay, plans: list) -> Optional[list]:
             base_used = used.tolist()
             caps = capacity[ni_arr, :4].tolist()
 
-        # Pass 2: verdicts in eval order against the window overlay.
-        wm = _WindowState(mirror, statics)
-        for alloc in overlay._overlay.values():
-            wm.fold(alloc)  # in-flight apply: part of "committed" state
-        pair_of: dict = {}
-        for pair, (i, nid, *_rest) in enumerate(pairs):
-            pair_of[(i, nid)] = pair
+        # The in-flight apply's allocs fold into component overlays, so
+        # their frame rows (and nodes) must ride along too.
+        for a in prep.inflight:
+            frame_ids.add(a.id)
+            ni = index_of.get(a.node_id, -1)
+            if ni >= 0:
+                touched_nis.add(ni)
+        prep.frame = _Frame(mirror, frame_ids, touched_nis)
 
-        outcomes: list = []
-        claimed: set = {n for n in overlay._by_node if n}
-        for i, plan in enumerate(plans):
-            pv = verdicts[i]
-            nodes = _touched(plan)
-            fallback = bool(nodes & claimed)
-            result = PlanResult(failed_allocs=list(plan.failed_allocs))
-            for nid in nodes:
-                ok = pv.get(nid, _MISS)
-                if ok is None:
-                    # Vector-ineligible claim: exact walk against the
-                    # overlay (identical to the sequential verdict).
-                    ok = _evaluate_node_plan(overlay, plan, nid)
-                elif ok is _MISS:
-                    pair = pair_of[(i, nid)]
-                    _i, _nid, ni, node, placements, removed = pairs[pair]
-                    u0, u1, u2, u3 = base_used[pair]
-                    d = wm.usage_delta.get(ni)
-                    if d is not None:
-                        u0 += d[0]
-                        u1 += d[1]
-                        u2 += d[2]
-                        u3 += d[3]
-                    for aid in removed:
-                        row = wm.alloc_row(aid)
-                        if row is not None and row[0] == ni:
-                            vec = row[1]
-                            u0 -= float(vec[0])
-                            u1 -= float(vec[1])
-                            u2 -= float(vec[2])
-                            u3 -= float(vec[3])
-                    c = caps[pair]
-                    if not (u0 <= c[0] and u1 <= c[1] and u2 <= c[2]
-                            and u3 <= c[3]):
-                        ok = False
-                    else:
-                        # Port collisions + bandwidth: exact, against
-                        # base + window overlay (None punts the node to
-                        # the scalar walk).
-                        ok = _verify_node_net(wm, statics, node, ni,
-                                              placements, removed)
-                        if ok is None:
-                            ok = _evaluate_node_plan(overlay, plan, nid)
-                if ok:
-                    if plan.node_update.get(nid):
-                        result.node_update[nid] = plan.node_update[nid]
-                    if plan.node_allocation.get(nid):
-                        result.node_allocation[nid] = \
-                            plan.node_allocation[nid]
-                    continue
-                result.refresh_index = max(overlay.get_index("nodes"),
-                                           overlay.get_index("allocs"))
-                if plan.all_at_once:
-                    result.node_update = {}
-                    result.node_allocation = {}
-                    break
-            outcomes.append(WindowOutcome(result, fallback))
-            accepted = _accepted_allocs(result)
-            overlay.upsert_allocs(accepted)
+    prep.verdicts = verdicts
+    prep.pairs = pairs
+    prep.base_used = base_used
+    prep.caps = caps
+    pair_of: dict = {}
+    for pair, (i, nid, *_rest) in enumerate(pairs):
+        pair_of[(i, nid)] = pair
+    prep.pair_of = pair_of
+
+    # Pass 2: partition, schedule, walk.  Mirror lock released — the
+    # walks read only the frame, the base snapshot, and prep.
+    if partition:
+        comps = partition_window(plans)
+    else:
+        comps = [list(range(len(plans)))]
+    if len(comps) > 1:
+        # Deadline-aware scheduling: nearest member deadline first
+        # (ties by window position), so a near-deadline plan's
+        # component is never last in line behind the executor.
+        def comp_key(comp):
+            deadline = min((plans[i].deadline for i in comp
+                            if plans[i].deadline), default=float("inf"))
+            return (deadline, comp[0])
+        order = sorted(range(len(comps)), key=lambda k: comp_key(comps[k]))
+    else:
+        order = list(range(len(comps)))
+
+    wall0 = time.perf_counter()
+    tasks = [(lambda comp=comps[k]: _walk_component(prep, comp))
+             for k in order]
+    if executor is not None and len(tasks) > 1 and \
+            max(len(c) for c in comps) >= MIN_CONCURRENT_COMPONENT:
+        results = executor.run_components(
+            tasks, descs=[{"component": k, "plans": len(comps[k]),
+                           "eval_ids": [plans[i].eval_id
+                                        for i in comps[k]]}
+                          for k in order])
+    else:
+        results = [t() for t in tasks]
+    wall = time.perf_counter() - wall0
+
+    slots: list = [None] * len(plans)
+    comp_walls: list = []
+    comp_t0s: list = []
+    accepted_by_plan: list = [None] * len(plans)
+    for ordinal, (entries, comp_t0, comp_wall) in enumerate(results):
+        comp_walls.append(comp_wall)
+        comp_t0s.append(comp_t0)
+        for i, outcome, accepted in entries:
+            outcome.component = ordinal
+            slots[i] = outcome
+            accepted_by_plan[i] = accepted
+    # Fold every accepted portion into the caller's overlay in eval
+    # order — the exact end state sequential application leaves.
+    for i in range(len(plans)):
+        overlay.upsert_allocs(accepted_by_plan[i])
+    info = {
+        "components": len(comps),
+        "sizes": [len(c) for c in comps],
+        "order": order,
+        "comp_walls": comp_walls,
+        "comp_t0s": comp_t0s,  # perf_counter epoch (span conversion)
+        "wall": wall,
+        # How much wall the partition saved vs walking the same
+        # components serially (1.0 = none; GIL-bound walks cap this).
+        "speedup": (sum(comp_walls) / wall) if wall > 0 else 1.0,
+    }
+    return WindowVerdicts(slots, info)
+
+
+def _walk_component(prep, comp: list) -> tuple:
+    """In-order verdict walk of one claim-graph component against its
+    own overlay.  Returns ([(plan_index, WindowOutcome, accepted)],
+    t0_perf_counter, wall_seconds).  Reads only frozen prep state + the
+    base snapshot — safe on an executor thread."""
+    from nomad_tpu.server.plan_apply import (
+        OptimisticSnapshot,
+        _evaluate_node_plan,
+        _verify_node_net,
+    )
+
+    t0 = time.perf_counter()
+    plans = prep.plans
+    statics = prep.statics
+    inflight_nodes = prep.inflight_nodes
+    wm = _WindowState(prep.frame, prep.index_of)
+    comp_view: Optional[OptimisticSnapshot] = None
+    accepted_log: list = []
+
+    comp_nodes: set = set()
+    for i in comp:
+        comp_nodes |= prep.plan_nodes[i]
+    if prep.inflight:
+        # Only the in-flight allocs this component can see: anything on
+        # its nodes, or anything its plans replace/evict by id —
+        # gathered via the per-window indexes in O(component), folded
+        # in the overlay's insertion order (the fold order sequential
+        # application used).
+        picked: dict = {}
+        for nid in comp_nodes:
+            for k, a in prep.inflight_by_node.get(nid, ()):
+                picked[k] = a
+        by_id = prep.inflight_by_id
+        for i in comp:
+            for aid in _plan_alloc_ids(plans[i]):
+                entry = by_id.get(aid)
+                if entry is not None:
+                    picked[entry[0]] = entry[1]
+        for k in sorted(picked):
+            wm.fold(picked[k])  # in-flight apply: committed state
+
+    def view() -> OptimisticSnapshot:
+        # Exact-walk punts are rare; the component's OptimisticSnapshot
+        # is built lazily on the first one, seeded to the state the
+        # shared sequential overlay would hold at this point.
+        nonlocal comp_view
+        if comp_view is None:
+            comp_view = OptimisticSnapshot(prep.base)
+            comp_view.upsert_allocs(prep.inflight)
+            for accepted in accepted_log:
+                comp_view.upsert_allocs(accepted)
+        return comp_view
+
+    entries: list = []
+    claimed: set = set()
+    last = comp[-1]
+    for i in comp:
+        plan = plans[i]
+        pv = prep.verdicts[i]
+        nodes = prep.plan_nodes[i]
+        fallback = (not nodes.isdisjoint(claimed)) or \
+                   (not nodes.isdisjoint(inflight_nodes))
+        result = PlanResult(failed_allocs=list(plan.failed_allocs))
+        for nid in nodes:
+            ok = pv.get(nid, _MISS)
+            if ok is None:
+                # Vector-ineligible claim: exact walk against the
+                # component view (identical to the sequential verdict).
+                ok = _evaluate_node_plan(view(), plan, nid)
+            elif ok is _MISS:
+                pair = prep.pair_of[(i, nid)]
+                _i, _nid, ni, node, placements, removed = \
+                    prep.pairs[pair]
+                u0, u1, u2, u3 = prep.base_used[pair]
+                d = wm.usage_delta.get(ni)
+                if d is not None:
+                    u0 += d[0]
+                    u1 += d[1]
+                    u2 += d[2]
+                    u3 += d[3]
+                for aid in removed:
+                    row = wm.alloc_row(aid)
+                    if row is not None and row[0] == ni:
+                        vec = row[1]
+                        u0 -= float(vec[0])
+                        u1 -= float(vec[1])
+                        u2 -= float(vec[2])
+                        u3 -= float(vec[3])
+                c = prep.caps[pair]
+                if not (u0 <= c[0] and u1 <= c[1] and u2 <= c[2]
+                        and u3 <= c[3]):
+                    ok = False
+                else:
+                    # Port collisions + bandwidth: exact, against
+                    # frame + component overlay (None punts the node
+                    # to the scalar walk).
+                    ok = _verify_node_net(wm, statics, node, ni,
+                                          placements, removed)
+                    if ok is None:
+                        ok = _evaluate_node_plan(view(), plan, nid)
+            if ok:
+                if plan.node_update.get(nid):
+                    result.node_update[nid] = plan.node_update[nid]
+                if plan.node_allocation.get(nid):
+                    result.node_allocation[nid] = \
+                        plan.node_allocation[nid]
+                continue
+            result.refresh_index = prep.refresh_index
+            if plan.all_at_once:
+                result.node_update = {}
+                result.node_allocation = {}
+                break
+        accepted = _accepted_allocs(result)
+        accepted_log.append(accepted)
+        if comp_view is not None:
+            comp_view.upsert_allocs(accepted)
+        if i != last:
             for alloc in accepted:
                 wm.fold(alloc)
-            claimed |= nodes
-    return outcomes
+        claimed |= nodes
+        entries.append((i, WindowOutcome(result, fallback), accepted))
+    return entries, t0, time.perf_counter() - t0

@@ -31,8 +31,27 @@ Overload control plane (server/overload.py):
     ``stats()["expired_drops"]``.
   - **Timer lifecycle**: nothing is armed while the broker is disabled,
     nack timers fire through a tolerant wrapper, and ``flush`` cancels
-    every timer — no stray ``threading.Timer`` can fire into a
-    torn-down server.
+    every timer — no stray timer can fire into a torn-down server.
+
+Commit-pipeline scaling (the partitioned window verify, ISSUE 13):
+
+  - **Nack timers ride ONE TTL wheel** (server/ttlwheel.py) instead of a
+    ``threading.Timer`` thread per delivery: a saturated leader dequeues
+    hundreds of evals per second, and the per-dequeue thread create +
+    cancel was the single most expensive step of the whole commit
+    pipeline (~0.5 ms of a 0.9 ms/plan budget).  The wheel key is the
+    eval id; a redelivery re-arms the key, so a stale deadline can
+    never fire with a stale token.
+  - **Targeted dequeue wakeups**: a blocked ``dequeue`` parks on its own
+    event keyed by its scheduler set, and an enqueue wakes exactly ONE
+    matching waiter — under a 256-worker storm the old
+    ``Condition.notify_all`` woke every parked worker per enqueue, and
+    the thundering herd's wake/lock/scan/re-park cycles dominated
+    process CPU.
+  - **Token fence off the big lock**: delivery tokens are mirrored into
+    a dict behind a dedicated leaf lock, so the plan applier's
+    window-batched token fence (``outstanding_many``) never queues
+    behind the enqueue/dequeue/ack convoy.
 """
 from __future__ import annotations
 
@@ -77,13 +96,22 @@ class _PendingHeap:
 
 
 class _Unack:
-    __slots__ = ("eval", "token", "timer")
+    __slots__ = ("eval", "token")
 
-    def __init__(self, ev: Evaluation, token: str,
-                 timer: threading.Timer) -> None:
+    def __init__(self, ev: Evaluation, token: str) -> None:
         self.eval = ev
         self.token = token
-        self.timer = timer
+
+
+class _Waiter:
+    """One parked ``dequeue`` call: its scheduler set and a private
+    event an enqueue targets — exactly one waiter wakes per enqueue."""
+
+    __slots__ = ("scheds", "event")
+
+    def __init__(self, scheds: frozenset) -> None:
+        self.scheds = scheds
+        self.event = threading.Event()
 
 
 class EvalBroker:
@@ -98,13 +126,14 @@ class EvalBroker:
         self.admission = admission   # OverloadController (or None)
         self.max_depth = max_depth   # hard enqueue bound (None = unbounded)
         self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
         self._enabled = False
         self._evals: dict = {}       # eval id -> delivery attempts
         self._job_evals: dict = {}   # job id -> in-flight eval id
         self._blocked: dict = {}     # job id -> _PendingHeap
         self._ready: dict = {}       # scheduler type -> _PendingHeap
         self._unack: dict = {}       # eval id -> _Unack
+        self._waiters: dict = {}     # seq -> _Waiter (insertion-ordered)
+        self._waiter_seq = itertools.count()
         self._time_wait: dict = {}   # eval id -> threading.Timer
         self._deadlines: dict = {}   # eval id -> absolute monotonic deadline
         self._expired_drops = 0      # deadline-expired evals never delivered
@@ -112,6 +141,17 @@ class EvalBroker:
         self._trace_enq: dict = {}   # eval id -> tracer-epoch ready time
         #   (obs/trace.py: the broker.wait span's t0; stamped per
         #    _enqueue_locked so nack redeliveries re-time their wait)
+        # Delivery-token mirror behind a LEAF lock: the applier's
+        # window fence reads here instead of queueing on the big lock.
+        # Order is big -> leaf everywhere; nothing acquires the big
+        # lock while holding the leaf.
+        self._token_lock = threading.Lock()
+        self._tokens: dict = {}      # eval id -> outstanding token
+        # One wheel thread multiplexes every nack deadline (keyed by
+        # eval id; redelivery re-arms, ack/nack/flush disarm).
+        from .ttlwheel import TTLWheel
+        self._nack_wheel = TTLWheel(self._nack_expired,
+                                    name="broker-nack-wheel")
 
     # -- lifecycle --------------------------------------------------------
     def enabled(self) -> bool:
@@ -126,8 +166,7 @@ class EvalBroker:
 
     def flush(self) -> None:
         with self._lock:
-            for unack in self._unack.values():
-                unack.timer.cancel()
+            self._nack_wheel.clear()
             for timer in self._time_wait.values():
                 timer.cancel()
             self._evals.clear()
@@ -138,7 +177,21 @@ class EvalBroker:
             self._time_wait.clear()
             self._deadlines.clear()
             self._trace_enq.clear()
-            self._cond.notify_all()
+            waiters, self._waiters = self._waiters, {}
+            # Token mirror cleared INSIDE the big-lock section (the
+            # big->leaf order permits it): clearing it after release
+            # opened a window where the applier's token fence could
+            # still validate a delivery this flush just revoked.
+            with self._token_lock:
+                self._tokens.clear()
+        for waiter in waiters.values():
+            waiter.event.set()  # re-scan: disabled brokers raise
+
+    def shutdown(self) -> None:
+        """Terminal teardown: flush and reap the nack wheel's service
+        thread.  A shut-down broker cannot be re-enabled."""
+        self.set_enabled(False)
+        self._nack_wheel.stop()
 
     # -- enqueue ----------------------------------------------------------
     def depth(self) -> int:
@@ -215,7 +268,17 @@ class EvalBroker:
             self._blocked.setdefault(ev.job_id, _PendingHeap()).push(ev)
             return
         self._ready.setdefault(queue, _PendingHeap()).push(ev)
-        self._cond.notify_all()
+        # Wake exactly ONE waiter whose scheduler set covers this queue
+        # (removed from the registry: a woken waiter that loses the
+        # re-scan race re-registers itself).  One ready eval can only
+        # satisfy one dequeue, so waking everyone — the old
+        # notify_all — only bought a thundering herd of wake/lock/
+        # scan/re-park cycles per enqueue under a saturated leader.
+        for seq, waiter in self._waiters.items():
+            if queue in waiter.scheds:
+                del self._waiters[seq]
+                waiter.event.set()
+                break
 
     # -- dequeue ----------------------------------------------------------
     def dequeue(self, schedulers: list,
@@ -226,20 +289,42 @@ class EvalBroker:
         "no timer" behavior, worker.go dequeues with timeout 0)."""
         import time as _time
         end = None if timeout in (None, 0) else _time.monotonic() + timeout
-        with self._lock:
+        scheds = frozenset(schedulers)
+        seq = None
+        waiter = None
+        try:
             while True:
-                if not self._enabled:
-                    raise RuntimeError("eval broker disabled")
-                ev, token = self._scan_locked(schedulers)
-                if ev is not None:
-                    return ev, token
-                if end is not None:
-                    remaining = end - _time.monotonic()
-                    if remaining <= 0:
-                        return None, ""
-                    self._cond.wait(remaining)
-                else:
-                    self._cond.wait()
+                remaining = None
+                with self._lock:
+                    if seq is not None:
+                        self._waiters.pop(seq, None)
+                        seq = None
+                    if not self._enabled:
+                        raise RuntimeError("eval broker disabled")
+                    ev, token = self._scan_locked(schedulers)
+                    if ev is not None:
+                        return ev, token
+                    # Timeout decided UNDER the lock, before
+                    # registering: a waiter that registered and then
+                    # returned on its deadline could consume an
+                    # enqueue's single targeted wakeup without
+                    # scanning, stranding a ready eval while other
+                    # matching waiters stay parked.
+                    if end is not None:
+                        remaining = end - _time.monotonic()
+                        if remaining <= 0:
+                            return None, ""
+                    # Park OUTSIDE the lock on a private event an
+                    # enqueue targets; registered before release, so a
+                    # racing enqueue always sees this waiter.
+                    waiter = _Waiter(scheds)
+                    seq = next(self._waiter_seq)
+                    self._waiters[seq] = waiter
+                waiter.event.wait(remaining)
+        finally:
+            if seq is not None:
+                with self._lock:
+                    self._waiters.pop(seq, None)
 
     def dequeue_batch(self, schedulers: list, max_batch: int,
                       timeout: Optional[float] = None) -> list:
@@ -290,12 +375,17 @@ class EvalBroker:
                 self._enqueue_locked(ev, FAILED_QUEUE)
                 continue  # rescan: later evals may still be live
             token = generate_uuid()
-            timer = threading.Timer(self.nack_timeout,
-                                    self._nack_timer_fired, [ev.id, token])
-            timer.daemon = True
-            self._unack[ev.id] = _Unack(ev, token, timer)
+            # Nack deadline on the shared wheel, keyed by eval id: a
+            # redelivery re-arms the key, so no stale deadline can fire
+            # with a stale token (the wheel's callback reads the token
+            # CURRENT at expiry).  No thread is created per delivery —
+            # the per-dequeue threading.Timer this replaces cost more
+            # than the rest of the commit pipeline combined.
+            self._nack_wheel.arm(ev.id, self.nack_timeout)
+            self._unack[ev.id] = _Unack(ev, token)
+            with self._token_lock:
+                self._tokens[ev.id] = token
             self._evals[ev.id] = self._evals.get(ev.id, 0) + 1
-            timer.start()
             tracer = trace_mod.tracer() if trace_mod.ENABLED else None
             if tracer is not None and ev.trace:
                 t0 = self._trace_enq.pop(ev.id, None)
@@ -305,10 +395,20 @@ class EvalBroker:
                                   queue=best_sched)
             return ev, token
 
-    def _nack_timer_fired(self, eval_id: str, token: str) -> None:
-        """Nack-timeout path: tolerant of the delivery having been
-        acked/flushed in the firing window — a stray timer must log
-        nothing and touch nothing on a torn-down server."""
+    def _nack_expired(self, eval_id: str) -> None:
+        """Nack-deadline expiry (wheel thread): tolerant of the
+        delivery having been acked/flushed in the firing window — a
+        stray expiry must log nothing and touch nothing on a torn-down
+        server.  The token is read at expiry time; the armed re-check
+        closes the pop->callback gap: a redelivery re-ARMS the key
+        before publishing its token (both under the big lock the scan
+        holds), so a fresh deadline being armed here means the token
+        just read belongs to a NEW delivery whose window has not
+        expired — nacking it would be premature."""
+        with self._token_lock:
+            token = self._tokens.get(eval_id)
+        if token is None or self._nack_wheel.armed(eval_id):
+            return
         try:
             self.nack(eval_id, token)
         except ValueError:
@@ -316,11 +416,20 @@ class EvalBroker:
 
     # -- acknowledgement --------------------------------------------------
     def outstanding(self, eval_id: str) -> tuple[str, bool]:
-        with self._lock:
-            unack = self._unack.get(eval_id)
-            if unack is None:
-                return "", False
-            return unack.token, True
+        with self._token_lock:
+            token = self._tokens.get(eval_id)
+        if token is None:
+            return "", False
+        return token, True
+
+    def outstanding_many(self, eval_ids: list) -> dict:
+        """Outstanding tokens for a whole commit window in ONE leaf-lock
+        hold — the plan applier's batched token fence.  Absent ids are
+        simply missing from the result (not outstanding)."""
+        with self._token_lock:
+            tokens = self._tokens
+            return {eid: tokens[eid] for eid in eval_ids
+                    if eid in tokens}
 
     def ack(self, eval_id: str, token: str) -> None:
         with self._lock:
@@ -330,7 +439,9 @@ class EvalBroker:
             if unack.token != token:
                 raise ValueError("Token does not match for Evaluation ID")
             job_id = unack.eval.job_id
-            unack.timer.cancel()
+            self._nack_wheel.cancel(eval_id)
+            with self._token_lock:
+                self._tokens.pop(eval_id, None)
 
             del self._unack[eval_id]
             self._evals.pop(eval_id, None)
@@ -351,7 +462,9 @@ class EvalBroker:
                 raise ValueError("Evaluation ID not found")
             if unack.token != token:
                 raise ValueError("Token does not match for Evaluation ID")
-            unack.timer.cancel()
+            self._nack_wheel.cancel(eval_id)
+            with self._token_lock:
+                self._tokens.pop(eval_id, None)
             del self._unack[eval_id]
 
             if self._evals.get(eval_id, 0) >= self.delivery_limit:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from collections import deque
 from typing import Optional
 
@@ -291,36 +292,326 @@ def _evaluate_node_plan(snap, plan: Plan, node_id: str) -> bool:
     return fit
 
 
+class _ComponentBatch:
+    """One window's worth of component-walk tasks, consumed
+    front-to-back by the executor's workers plus the coordinator."""
+
+    __slots__ = ("tasks", "descs", "results", "next", "completed",
+                 "error", "done")
+
+    def __init__(self, tasks: list, descs: list) -> None:
+        self.tasks = tasks
+        self.descs = descs
+        self.results = [None] * len(tasks)
+        self.next = 0
+        self.completed = 0
+        self.error: Optional[Exception] = None
+        self.done = threading.Event()
+
+
+class ComponentExecutor:
+    """Small worker pool verifying a window's claim-graph components
+    concurrently (ops/plan_conflict.evaluate_window passes its
+    deadline-ordered component tasks here).
+
+    Tasks are consumed strictly front-to-back, so the deadline order
+    the scheduler chose IS the start order; the coordinator (the
+    applier thread) participates, so ``workers=0`` degrades to inline
+    execution.  ``active()`` snapshots what every thread is verifying
+    right now — the flight recorder's ``applier.window`` stall guard
+    attaches it to incident dumps, so a wedged window names the slow
+    component instead of just the window."""
+
+    def __init__(self, workers: int = 2,
+                 name: str = "plan-components") -> None:
+        self.workers = max(0, int(workers))
+        self.name = name
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._batch: Optional[_ComponentBatch] = None
+        self._threads: list = []
+        self._stopped = False
+        self._active: dict = {}   # thread name -> (desc, started)
+        self.batches = 0          # windows dispatched; guarded
+        self.components_run = 0   # component walks executed; guarded
+
+    def run_components(self, tasks: list, descs=None) -> list:
+        """Run every task, concurrently when workers exist; returns
+        results in task order.  The first task exception (components
+        must not raise in normal operation) re-raises here, after every
+        task has been consumed.
+
+        Tasks are dispatched as ``workers + 1`` CONTIGUOUS chunks of
+        the deadline-ordered list — one condition wake per worker per
+        window, not per component (a saturated window is dozens of
+        single-plan components, and per-task handoff cost more than the
+        walks).  The coordinator takes the first chunk, so the
+        nearest-deadline components start immediately on the applier
+        thread even if every worker is cold."""
+        descs = descs if descs is not None else [None] * len(tasks)
+        inline = False
+        chunks: list = []
+        with self._cond:
+            self.components_run += len(tasks)
+            if self._stopped or self.workers == 0 or len(tasks) <= 2 \
+                    or self._batch is not None:
+                inline = True
+            else:
+                n_chunks = min(len(tasks), self.workers + 1)
+                step = -(-len(tasks) // n_chunks)  # ceil division
+                for lo in range(0, len(tasks), step):
+                    sl = slice(lo, min(lo + step, len(tasks)))
+                    chunks.append((sl, tasks[sl], descs[sl]))
+                batch = _ComponentBatch(
+                    [self._chunk_task(ts) for _sl, ts, _d in chunks],
+                    [{"components": [d for d in ds if d]}
+                     for _sl, _ts, ds in chunks])
+                self._batch = batch
+                self.batches += 1
+                self._ensure_threads_locked()
+                self._cond.notify_all()
+        if inline:
+            return [self._run_one(task, desc)
+                    for task, desc in zip(tasks, descs)]
+        self._drain(batch)
+        batch.done.wait()
+        with self._cond:
+            self._batch = None
+        if batch.error is not None:
+            raise batch.error
+        out: list = [None] * len(tasks)
+        for (sl, _ts, _ds), chunk_results in zip(chunks, batch.results):
+            out[sl] = chunk_results
+        return out
+
+    @staticmethod
+    def _chunk_task(chunk_tasks: list):
+        return lambda: [t() for t in chunk_tasks]
+
+    def _run_one(self, task, desc):
+        me = threading.current_thread().name
+        with self._lock:
+            self._active[me] = (desc, time.monotonic())
+        try:
+            return task()
+        finally:
+            with self._lock:
+                self._active.pop(me, None)
+
+    def _drain(self, batch: _ComponentBatch) -> None:
+        while True:
+            with self._cond:
+                i = batch.next
+                if i >= len(batch.tasks):
+                    return
+                batch.next = i + 1
+            try:
+                result = self._run_one(batch.tasks[i], batch.descs[i])
+                batch.results[i] = result
+            except Exception as e:
+                if batch.error is None:
+                    batch.error = e
+            finally:
+                with self._cond:
+                    batch.completed += 1
+                    if batch.completed == len(batch.tasks):
+                        batch.done.set()
+
+    def _ensure_threads_locked(self) -> None:
+        while len(self._threads) < self.workers:
+            t = threading.Thread(
+                target=self._worker, daemon=True,
+                name=f"{self.name}-{len(self._threads)}")
+            self._threads.append(t)
+            t.start()
+
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                while not self._stopped and (
+                        self._batch is None
+                        or self._batch.next >= len(self._batch.tasks)):
+                    self._cond.wait()
+                if self._stopped:
+                    return
+                batch = self._batch
+            self._drain(batch)
+
+    def active(self) -> dict:
+        """What every executor thread is verifying right now — the
+        stall guard's per-component attribution."""
+        now = time.monotonic()
+        with self._lock:
+            return {"verifying": [
+                dict(desc or {}, thread=name,
+                     age_s=round(now - started, 3))
+                for name, (desc, started) in self._active.items()]}
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"workers": self.workers,
+                    "batches": self.batches,
+                    "components_run": self.components_run,
+                    "active": len(self._active)}
+
+    def stop(self, timeout: float = 2.0) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+            threads = list(self._threads)
+        for t in threads:
+            if t is not threading.current_thread():
+                t.join(timeout)
+
+
+class _Committer:
+    """ONE long-lived FIFO thread executing the commit tail of each
+    window — wire encode, raft dispatch, commit wait, future responds —
+    in window order, off the applier thread.
+
+    This deepens the reference's verify/apply overlap (plan_apply.go:
+    68-85): the applier thread's serialized section shrinks to token
+    fence + partitioned verify + overlay fold, while the encode, the
+    raft apply and (with InmemRaft) the synchronous FSM decode +
+    batched store upsert — the priciest per-plan stages of the whole
+    pipeline — ride here.  FIFO preserves the dispatch order and the
+    one-apply-in-flight discipline (each job awaits its commit before
+    the next job starts); ``wait_depth_below`` is the applier's
+    backpressure so the optimistic overlay stays bounded.  It also
+    replaces the per-window respond thread (a waived deliberate leak
+    in LINT_ALLOWLIST until this round): at partitioned commit rates —
+    hundreds of windows per second — thread creation itself was a top
+    pipeline cost."""
+
+    def __init__(self, name: str = "plan-committer") -> None:
+        self.name = name
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: deque = deque()
+        self._inflight = 0   # queued + executing jobs
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+
+    def submit(self, fn) -> None:
+        with self._cond:
+            if self._stopped:
+                raise RuntimeError("committer stopped")
+            self._queue.append(fn)
+            self._inflight += 1
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name=self.name)
+                self._thread.start()
+            self._cond.notify_all()
+
+    def drained(self) -> bool:
+        """True when every submitted commit has fully resolved — the
+        applier's signal that its optimistic overlay can be dropped
+        for a fresh post-commit snapshot."""
+        with self._lock:
+            return self._inflight == 0
+
+    def wait_depth_below(self, n: int,
+                         timeout: Optional[float] = None) -> None:
+        end = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while self._inflight >= n and not self._stopped:
+                if end is not None:
+                    remaining = end - time.monotonic()
+                    if remaining <= 0:
+                        return
+                    self._cond.wait(remaining)
+                else:
+                    self._cond.wait()
+
+    def wait_drained(self, timeout: Optional[float] = None) -> None:
+        self.wait_depth_below(1, timeout)
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._stopped:
+                    self._cond.wait()
+                if not self._queue:
+                    return  # stopped AND drained: futures never drop
+                fn = self._queue.popleft()
+            try:
+                fn()
+            except Exception:
+                logger.exception("plan committer: commit job failed")
+            finally:
+                with self._cond:
+                    self._inflight -= 1
+                    self._cond.notify_all()
+
+    def stop(self, timeout: float = 2.0) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+            _thread = self._thread
+        if _thread is not None and \
+                _thread is not threading.current_thread():
+            _thread.join(timeout)
+
+
 class PlanApplier:
     """Single leader thread draining the plan queue in group-commit
     windows.
 
-    Each iteration pops every pending plan (up to ``max_window``),
-    verifies the whole window with one vectorized cross-plan conflict
-    pass (ops/plan_conflict.evaluate_window — order-sensitive: a plan
-    whose claims overlap an earlier plan in the window falls back to the
-    exact per-plan walk against the running overlay), and commits ALL
-    accepted portions as ONE raft apply carrying a multi-plan FSM
-    message — amortizing the Raft/FSM/native overhead that made the
-    serialized commit the contended storm's floor.  Per-plan futures are
-    responded with results identical to sequential application in eval
-    order; the overlapped verify/apply snapshot-overlay semantics extend
-    to batches (the next window verifies against the in-flight window's
-    overlay)."""
+    Each iteration pops every pending plan (up to ``max_window``,
+    gathering briefly under saturation so windows drain full), fences
+    the whole window's tokens in ONE broker call, verifies it with the
+    partitioned cross-plan conflict pass
+    (ops/plan_conflict.evaluate_window — claim-graph components
+    verified concurrently on the ComponentExecutor, nearest-deadline
+    component first, byte-exact eval order within each component), and
+    commits ALL accepted portions as ONE raft apply carrying a
+    multi-plan FSM message — amortizing the Raft/FSM/native overhead
+    that made the serialized commit the contended storm's floor.
+    Per-plan futures are responded with results identical to sequential
+    application in eval order; the overlapped verify/apply
+    snapshot-overlay semantics extend to batches (the next window
+    verifies against the in-flight window's overlay).
+
+    ``sequential=True`` restores the pre-partition behavior — per-plan
+    token fence, one flat verify walk, no gather — and exists as the
+    bench's in-run baseline (bench 5f measures the partitioned path
+    against it on the same host)."""
 
     # A verify+commit window past this wall is a wedged leader, not a
     # big window: trip the flight recorder (when one is installed).
     WINDOW_STALL_S = 30.0
 
     def __init__(self, plan_queue, eval_broker, raft, state_fn,
-                 max_window: int = 64) -> None:
+                 max_window: int = 64, component_workers: int = 2,
+                 gather_s: float = 0.02,
+                 deadline_horizon: float = 0.25,
+                 sequential: bool = False) -> None:
         self.plan_queue = plan_queue
         self.eval_broker = eval_broker
         self.raft = raft
         self.state_fn = state_fn  # () -> StateStore (the FSM's live store)
         self.max_window = max(1, max_window)
+        # Window gather budget: when the previous drain left a backlog
+        # (saturation), wait up to this long for the queue to refill a
+        # full window before draining — group-commit pacing.  An idle
+        # leader (no backlog) never pays it.
+        self.gather_s = gather_s
+        # Plans whose deadline falls inside this horizon are promoted
+        # to the front of the drained window (plan_queue.drain_pending)
+        # and their components verify first.
+        self.deadline_horizon = deadline_horizon
+        self.sequential = sequential
+        self.components = ComponentExecutor(
+            workers=0 if sequential else component_workers)
+        self._committer = _Committer()
+        # Commit-pipeline depth bound: at most this many windows may be
+        # queued/executing in the committer before the applier blocks —
+        # bounds the optimistic overlay (and how far a verify can run
+        # ahead of committed state).
+        self.max_inflight_commits = 2
         self._thread: Optional[threading.Thread] = None
-        # Group-commit observability (bench 5b fields ride on these).
+        # Group-commit observability (bench 5b/5f fields ride on these).
         self._stats_lock = threading.Lock()
         self.commits = 0            # raft applies dispatched
         self.plans_committed = 0    # plans carried by those applies
@@ -331,6 +622,25 @@ class PlanApplier:
         #                             passed before verification — the
         #                             leader never burns a verify+commit
         #                             on a result nobody is waiting for
+        self.components_verified = 0  # claim-graph components walked
+        self.component_plans = 0      # plans those components carried
+        self._speedup_sum = 0.0       # per-window cross-component
+        self._speedup_n = 0           # concurrency (sum walls / wall)
+        # The serialized commit section's wall cost (token fence
+        # + window verify + overlay fold on the partitioned path; plus
+        # wire encode + raft dispatch + FSM apply on the sequential
+        # one — everything the applier thread itself must finish before
+        # the next window), and the plans that rode it:
+        # serial_ms_per_plan is the direct measure of "the commit point
+        # is no longer one ordered stream" that bench 5f asserts at
+        # matched window occupancy.
+        self.serial_seconds = 0.0
+        self.serial_plans = 0
+        # Set by a committer job whose raft DISPATCH failed (nothing
+        # entered the log): the overlay folded that window's allocs
+        # before hand-off, so the applier must serialize the pipeline
+        # out and take a fresh snapshot before trusting it again.
+        self._dispatch_failed = False
         # Recent drained window sizes, BOUNDED: a leader drains windows
         # for its whole tenure, so an unbounded list is a slow leak.
         self.windows = deque(maxlen=256)
@@ -344,15 +654,43 @@ class PlanApplier:
         if self._thread is not None:
             self._thread.join(timeout)
 
+    def shutdown(self, timeout: float = 2.0) -> None:
+        """Terminal teardown: reap the component executor's workers and
+        the committer (the applier thread itself exits when the queue
+        is disabled)."""
+        self.components.stop(timeout)
+        self._committer.stop(timeout)
+        self.join(timeout)
+
     def run(self) -> None:
         wait_future = None
         snap: Optional[OptimisticSnapshot] = None
         while True:
+            t_deq = time.monotonic()
             pending = self.plan_queue.dequeue(0)
+            deq_wait = time.monotonic() - t_deq
             if pending is None:
                 return  # queue disabled: leadership lost
+            if self.gather_s > 0.0 and deq_wait < 0.002 and \
+                    (self.plan_queue.depth() > 0
+                     or self.plan_queue.await_depth(1, 0.002) > 0):
+                # Two-phase adaptive gather.  This dequeue returned
+                # without blocking, so a stream MAY be in flight; if a
+                # backlog remains behind the popped plan — or anything
+                # arrives within a 2 ms probe — gather toward a full
+                # window instead of burning a whole commit cycle
+                # (snapshot, verify, raft entry, FSM decode, respond)
+                # on a sliver.  A lone submitter in a submit->wait->
+                # resubmit loop pays at most the 2 ms probe (its plan
+                # is the one in flight, so nothing else can arrive),
+                # and an idle leader (blocking dequeues) pays nothing.
+                self.plan_queue.await_depth(self.max_window - 1,
+                                            self.gather_s)
             window = [pending]
-            window += self.plan_queue.drain_pending(self.max_window - 1)
+            window += self.plan_queue.drain_pending(
+                self.max_window - 1,
+                horizon=None if self.sequential
+                else self.deadline_horizon)
             try:
                 # Stall watchdog (obs/flight.py): a window that
                 # overstays WINDOW_STALL_S trips an incident dump with
@@ -360,8 +698,12 @@ class PlanApplier:
                 # commit point wedging is exactly the failure that is
                 # undebuggable after the fact.  No-op when no flight
                 # recorder is installed.
+                # extra_fn: the incident dump names WHAT was being
+                # verified when the window wedged — the executor's
+                # per-component attribution, not just "the window".
                 with flight_mod.guard("applier.window",
-                                      self.WINDOW_STALL_S):
+                                      self.WINDOW_STALL_S,
+                                      extra_fn=self.components.active):
                     wait_future, snap = self._apply_window(
                         window, wait_future, snap)
             except Exception as e:
@@ -386,6 +728,11 @@ class PlanApplier:
                         wait_future.wait()
                     except Exception:
                         pass
+                if not self.sequential:
+                    # In-flight applies live in the committer pipeline:
+                    # drain it too, or the fresh snapshot could miss a
+                    # commit and re-admit its conflicts.
+                    self._committer.wait_drained(timeout=30.0)
                 wait_future, snap = None, None
 
     def _fence(self, pending) -> bool:
@@ -399,12 +746,10 @@ class PlanApplier:
         wait has expired and the broker's nack timer has (or is about
         to) redeliver the eval, so a commit here would only race the
         retry toward double placement while burning the leader."""
-        import time as _time
-
         from .overload import ErrDeadlineExceeded
 
         plan = pending.plan
-        if plan.deadline and _time.monotonic() > plan.deadline:
+        if plan.deadline and time.monotonic() > plan.deadline:
             with self._stats_lock:
                 self.expired_drops += 1
             pending.respond(None, ErrDeadlineExceeded(
@@ -421,14 +766,75 @@ class PlanApplier:
             return False
         return True
 
+    def _fence_window(self, window) -> list:
+        """The whole window's token fence in ONE broker call
+        (``outstanding_many`` reads the token mirror behind its leaf
+        lock): per-plan ``outstanding`` queued the applier behind the
+        submitter herd's enqueue/dequeue/ack convoy once per plan, and
+        under bench 5f's 256 submitters those waits were over half the
+        applier's wall.  Same verdicts as :meth:`_fence`, same response
+        semantics, same stats."""
+        from .overload import ErrDeadlineExceeded
+
+        tokens = self.eval_broker.outstanding_many(
+            [p.plan.eval_id for p in window])
+        now = time.monotonic()
+        pendings = []
+        expired = 0
+        for pending in window:
+            plan = pending.plan
+            if plan.deadline and now > plan.deadline:
+                expired += 1
+                pending.respond(None, ErrDeadlineExceeded(
+                    f"plan for eval {plan.eval_id} expired in queue"))
+                continue
+            token = tokens.get(plan.eval_id)
+            if token is None:
+                pending.respond(None, RuntimeError(
+                    "evaluation is not outstanding"))
+                continue
+            if plan.eval_token != token:
+                pending.respond(None, RuntimeError(
+                    "evaluation token does not match"))
+                continue
+            pendings.append(pending)
+        if expired:
+            with self._stats_lock:
+                self.expired_drops += expired
+        return pendings
+
     def _apply_window(self, window, wait_future, snap):
         """Verify + group-commit one drained window; returns the
         (wait_future, snap) verify/apply-overlap state carried to the
         next iteration."""
         from nomad_tpu.ops.plan_conflict import evaluate_window
 
-        pendings = [p for p in window if self._fence(p)]
+        # Serialized-section accounting: everything this method does
+        # except waiting out in-flight applies (those waits are the
+        # verify/apply overlap — by design not serialized against this
+        # window's verify).  Wall clock deliberately: the applier
+        # thread's wall between windows — GIL waits included — is what
+        # actually bounds its commit cadence.  (Thread-CPU time would
+        # be cleaner noise-wise, but CLOCK_THREAD_CPUTIME_ID ticks at
+        # ~10 ms on this class of kernel, which zeroes sub-ms
+        # sections.)  bench 5f asserts serial_ms_per_plan against the
+        # sequential baseline at matched window occupancy.
+        t_mark = time.perf_counter()
+        serial = 0.0
+        n_window = len(window)
+
+        def _book() -> None:
+            with self._stats_lock:
+                self.serial_seconds += \
+                    serial + (time.perf_counter() - t_mark)
+                self.serial_plans += n_window
+
+        if self.sequential:
+            pendings = [p for p in window if self._fence(p)]
+        else:
+            pendings = self._fence_window(window)
         if not pendings:
+            _book()
             return wait_future, snap
         tracer = trace_mod.tracer() if trace_mod.ENABLED else None
         if tracer is not None:
@@ -442,31 +848,78 @@ class PlanApplier:
                                   parent_ctx=pend.plan.trace,
                                   eval_id=pend.plan.eval_id)
 
-        # If the previous apply finished, drop the stale overlay; else
-        # keep verifying against the optimistic view (this is the
-        # verify/apply overlap, plan_apply.go:68-85, extended to the
-        # whole window).
+        # If every in-flight apply finished, drop the stale overlay;
+        # else keep verifying against the optimistic view (this is the
+        # verify/apply overlap, plan_apply.go:68-85, extended to whole
+        # windows and — on the partitioned path — to the committer
+        # pipeline's bounded queue of windows).
         if wait_future is not None and wait_future.done():
             wait_future = None
             snap = None
+        if not self.sequential:
+            with self._stats_lock:
+                dispatch_failed = self._dispatch_failed
+            if dispatch_failed:
+                # A hand-off's dispatch failed AFTER its allocs folded
+                # into the overlay: those folds are phantoms (nothing
+                # entered the log).  Serialize the pipeline out —
+                # other in-flight windows' folds are real and must
+                # land before a fresh snapshot can replace them — and
+                # clear the flag only once DRAINED: windows already
+                # queued behind the failure were verified against the
+                # phantoms, and their commit jobs must still see the
+                # flag to refuse them.
+                self._committer.wait_drained(timeout=60.0)
+                with self._stats_lock:
+                    self._dispatch_failed = False
+                snap = None
+            elif snap is not None and self._committer.drained():
+                snap = None
         if snap is None:
             snap = OptimisticSnapshot(self.state_fn().snapshot())
 
         t_verify = tracer.now() if tracer is not None else 0.0
-        outcomes = evaluate_window(snap, [p.plan for p in pendings])
+        outcomes = evaluate_window(
+            snap, [p.plan for p in pendings],
+            executor=None if self.sequential else self.components,
+            partition=not self.sequential)
+        info = getattr(outcomes, "info", None)
         if tracer is not None:
-            # One window verify, one span per member plan (shared
-            # t0/dur, tagged with the window size): every eval's tree
-            # records the verify IT rode, and the shared timestamps
-            # make the group-commit amortization visible in the trace.
+            # Span taxonomy: one applier.window span per member plan
+            # (shared t0/dur, tagged window size + component count),
+            # and under it one applier.verify span carrying the
+            # member's COMPONENT timing — so a trace shows both the
+            # group-commit amortization (shared window walls) and
+            # which component each eval's verify actually rode.
             dur_verify = tracer.now() - t_verify
+            # perf_counter epoch -> tracer epoch for component t0s.
+            perf_off = time.perf_counter() - tracer.now()
             for pending, outcome in zip(pendings, outcomes):
-                if pending.plan.trace:
-                    tracer.record("applier.verify", t_verify, dur_verify,
-                                  parent_ctx=pending.plan.trace,
-                                  eval_id=pending.plan.eval_id,
-                                  window=len(pendings),
-                                  fallback=outcome.fallback)
+                if not pending.plan.trace:
+                    continue
+                wctx = tracer.record(
+                    "applier.window", t_verify, dur_verify,
+                    parent_ctx=pending.plan.trace,
+                    eval_id=pending.plan.eval_id,
+                    window=len(pendings),
+                    components=info["components"] if info else 1)
+                if info is not None:
+                    k = outcome.component
+                    tracer.record(
+                        "applier.verify",
+                        info["comp_t0s"][k] - perf_off,
+                        info["comp_walls"][k],
+                        parent_ctx=wctx,
+                        eval_id=pending.plan.eval_id,
+                        component=k,
+                        size=info["sizes"][info["order"][k]],
+                        fallback=outcome.fallback)
+                else:
+                    tracer.record(
+                        "applier.verify", t_verify, dur_verify,
+                        parent_ctx=wctx,
+                        eval_id=pending.plan.eval_id,
+                        component=0, fallback=outcome.fallback)
         committers = []  # (pending, result) with state to commit
         fallbacks = 0
         for pending, outcome in zip(pendings, outcomes):
@@ -479,37 +932,117 @@ class PlanApplier:
         with self._stats_lock:
             self.windows.append(len(pendings))
             self.conflict_fallbacks += fallbacks
+            if info is not None:
+                self.components_verified += info["components"]
+                self.component_plans += len(pendings)
+                self._speedup_sum += info["speedup"]
+                self._speedup_n += 1
         if not committers:
+            _book()
             return wait_future, snap
 
-        # One apply in flight at a time: wait for the previous one and
-        # refresh the snapshot before dispatching (plan_apply.go:100-110;
-        # the evaluation above already ran against the optimistic view).
+        from nomad_tpu.ops.plan_conflict import _accepted_allocs
+
+        alloc_lists = [_accepted_allocs(result)
+                       for _pending, result in committers]
+
+        if not self.sequential:
+            # Partitioned path: the commit tail — wire encode, raft
+            # dispatch, commit wait, responds — rides the FIFO
+            # committer pipeline, off this thread.  The accepted
+            # portions are ALREADY folded into ``snap``
+            # (evaluate_window mutates the caller-owned overlay in
+            # eval order — its documented contract), so the next
+            # window's verify sees them without any re-fold here.
+            # Bound the pipeline depth (backpressure excluded from the
+            # serialized-section accounting: it IS the verify/apply
+            # overlap), then hand off.
+            serial += time.perf_counter() - t_mark
+            self._committer.wait_depth_below(self.max_inflight_commits,
+                                             timeout=60.0)
+            t_mark = time.perf_counter()
+            try:
+                self._committer.submit(
+                    lambda: self._commit_job(committers, alloc_lists,
+                                             tracer))
+            except Exception:
+                # Committer gone (teardown): commit inline — futures
+                # must always resolve.
+                self._commit_job(committers, alloc_lists, tracer)
+            _book()
+            return None, snap
+
+        # Sequential (baseline) path: one apply in flight at a time —
+        # wait for the previous one and refresh the snapshot before
+        # dispatching (plan_apply.go:100-110; the evaluation above
+        # already ran against the optimistic view), then encode and
+        # dispatch ON this thread, exactly the pre-partition applier.
         if wait_future is not None:
+            serial += time.perf_counter() - t_mark
             try:
                 wait_future.wait()
             except Exception:
                 pass
             wait_future = None
+            t_mark = time.perf_counter()
         snap = OptimisticSnapshot(self.state_fn().snapshot())
 
-        # ONE raft apply for the whole window, sub-plans in eval order
-        # (the FSM's batched upsert preserves last-writer-wins order, so
-        # final state is byte-identical to per-plan applies in eval
-        # order).  A single committer keeps today's wire format.
-        # Columnar contract: slab-backed allocs ride the log as
-        # [slab, row, delta] references against one shared column
-        # record per slab (the job dict crosses the wire ONCE per slab,
-        # not once per alloc) — structs/alloc_slab.SlabWireEncoder;
-        # plain allocs keep the per-alloc dict encoding.
-        from nomad_tpu.ops.plan_conflict import _accepted_allocs
+        future, t_apply = self._dispatch_window(committers,
+                                                alloc_lists, tracer)
+        if future is None:
+            # Dispatch failed; every member future already answered.
+            # The overlay folded nothing yet; the fresh snapshot above
+            # is still truthful for the next window.
+            _book()
+            return None, snap
+
+        try:
+            # Optimistically fold every committed plan into the overlay
+            # so the next window verifies against it.
+            for allocs in alloc_lists:
+                snap.upsert_allocs(allocs)
+            wait_future = future
+        except Exception:
+            # Overlay lost: serialize this apply out and start the next
+            # window from a fresh post-commit snapshot.
+            logger.exception("plan applier: overlay fold failed; "
+                             "serializing this apply")
+            try:
+                future.wait()
+            except Exception:
+                pass
+            wait_future, snap = None, None
+        try:
+            self._committer.submit(
+                lambda: self._await_and_respond(future, committers,
+                                                t_apply, tracer))
+        except Exception:
+            self._await_and_respond(future, committers, t_apply,
+                                    tracer)  # degraded but always answers
+        _book()
+        return wait_future, snap
+
+    def _dispatch_window(self, committers, alloc_lists, tracer):
+        """Encode one window's accepted portions and dispatch ONE raft
+        apply; returns the apply future, or None after answering every
+        member future with the dispatch error.
+
+        ONE raft apply for the whole window, sub-plans in eval order
+        (the FSM's batched upsert preserves last-writer-wins order, so
+        final state is byte-identical to per-plan applies in eval
+        order).  A single committer keeps the legacy single-plan wire
+        format.  Columnar contract: slab-backed allocs ride the log as
+        [slab, row, delta] references against one shared column record
+        per slab (the job dict crosses the wire ONCE per slab, not once
+        per alloc) — structs/alloc_slab.SlabWireEncoder; plain allocs
+        keep the per-alloc dict encoding.  Returns (future, t_apply) —
+        (None, 0.0) after answering every member future with the
+        dispatch error."""
         from nomad_tpu.structs.alloc_slab import (
             encode_alloc_update,
             encode_plan_batch,
         )
 
-        alloc_lists = [_accepted_allocs(result)
-                       for _pending, result in committers]
         if len(committers) == 1:
             msg_type, payload = (codec.ALLOC_UPDATE_REQUEST,
                                  encode_alloc_update(alloc_lists[0]))
@@ -533,76 +1066,106 @@ class PlanApplier:
         try:
             future = self.raft.apply(entry)
         except Exception as e:
+            # Flag BEFORE responding: a submitter that observes the
+            # error and retries must find the next window already
+            # committed to dropping this window's phantom overlay
+            # folds (the partitioned path folds before hand-off).
+            with self._stats_lock:
+                self._dispatch_failed = True
             for pending, _result in committers:
                 pending.respond(None, e)
-            # The overlay folded nothing yet; the fresh snapshot above
-            # is still truthful for the next window.
-            return None, snap
+            return None, 0.0
         with self._stats_lock:
             self.commits += 1
             self.plans_committed += len(committers)
+        return future, t_apply
 
-        # From here the entry is committed (or committing): failures in
-        # the bookkeeping below must not surface as plan errors — the
-        # worker would retry an already-applied plan and double-place.
-        def respond(fut=future, members=committers, t0=t_apply,
-                    tr=tracer) -> None:
-            try:
-                index, _ = fut.wait()
-            except Exception as e:
-                for pend, _res in members:
-                    pend.respond(None, e)
-                return
-            if tr is not None:
-                # raft.apply dispatch -> committed, one span per member
-                # plan (shared t0/dur, like the verify spans).
-                dur = tr.now() - t0
-                for pend, _res in members:
-                    if pend.plan.trace:
-                        tr.record("raft.apply", t0, dur,
+    def _await_and_respond(self, future, committers, t_apply,
+                           tracer) -> None:
+        """The respond tail: wait out one window's commit and answer
+        every member future.  From dispatch on, the entry is committed
+        (or committing): failures here must not surface as plan errors
+        beyond the commit wait itself — a worker retrying an
+        already-applied plan would double-place."""
+        try:
+            index, _ = future.wait()
+        except Exception as e:
+            for pend, _res in committers:
+                pend.respond(None, e)
+            return
+        if tracer is not None:
+            # raft.apply dispatch -> committed, one span per member
+            # plan (shared t0/dur, like the verify spans).
+            dur = tracer.now() - t_apply
+            for pend, _res in committers:
+                if pend.plan.trace:
+                    tracer.record("raft.apply", t_apply, dur,
                                   parent_ctx=pend.plan.trace,
                                   eval_id=pend.plan.eval_id,
-                                  window=len(members), index=index)
-            for pend, res in members:
-                res.alloc_index = index
-                pend.respond(res, None)
+                                  window=len(committers), index=index)
+        for pend, res in committers:
+            res.alloc_index = index
+            pend.respond(res, None)
 
-        try:
-            # Optimistically fold every committed plan into the overlay
-            # so the next window verifies against it.
-            for allocs in alloc_lists:
-                snap.upsert_allocs(allocs)
-            wait_future = future
-        except Exception:
-            # Overlay lost: serialize this apply out and start the next
-            # window from a fresh post-commit snapshot.
-            logger.exception("plan applier: overlay fold failed; "
-                             "serializing this apply")
-            try:
-                future.wait()
-            except Exception:
-                pass
-            wait_future, snap = None, None
-        try:
-            threading.Thread(target=respond, daemon=True).start()
-        except Exception:
-            respond()  # degraded (blocks the applier) but always answers
-        return wait_future, snap
+    def _commit_job(self, committers, alloc_lists, tracer) -> None:
+        """One committer-pipeline job: encode, dispatch, await, respond
+        — the whole commit tail of one window, in FIFO window order.
+
+        Poison check first: FIFO means every PRIOR window's dispatch
+        outcome is known when this job runs, so if one failed, this
+        window's verdicts were computed against overlay folds that
+        never entered the log — committing them could durably
+        over-commit (e.g. a placement that fit only because a phantom
+        eviction freed the node).  Refuse with a retryable error
+        instead; the applier drains the pipeline and re-verifies
+        retries against a fresh snapshot (the ``_dispatch_failed``
+        handling at the top of ``_apply_window``)."""
+        with self._stats_lock:
+            poisoned = self._dispatch_failed
+        if poisoned:
+            err = RuntimeError(
+                "plan verified against a commit window whose dispatch "
+                "failed; state refreshed — retry")
+            for pend, _res in committers:
+                pend.respond(None, err)
+            return
+        future, t_apply = self._dispatch_window(committers,
+                                                alloc_lists, tracer)
+        if future is None:
+            return  # dispatch failed: futures answered, flag raised
+        self._await_and_respond(future, committers, t_apply, tracer)
 
     def stats(self) -> dict:
         """Group-commit counters: commits, plans carried, mean window
-        occupancy, conflict fallbacks."""
+        occupancy, conflict fallbacks, and the partitioned-verify
+        fields (components walked, mean plans per component, mean
+        cross-component concurrency)."""
         with self._stats_lock:
             commits = self.commits
             plans = self.plans_committed
             windows = list(self.windows)
             fallbacks = self.conflict_fallbacks
             expired = self.expired_drops
+            components = self.components_verified
+            comp_plans = self.component_plans
+            speedup_sum = self._speedup_sum
+            speedup_n = self._speedup_n
+            serial_s = self.serial_seconds
+            serial_plans = self.serial_plans
         return {
             "commits": commits,
             "plans_committed": plans,
             "batch_occupancy": plans / commits if commits else 0.0,
             "conflict_fallbacks": fallbacks,
             "expired_drops": expired,
+            "components": components,
+            "component_occupancy":
+                comp_plans / components if components else 0.0,
+            "cross_component_speedup":
+                speedup_sum / speedup_n if speedup_n else 1.0,
+            "serial_seconds": serial_s,
+            "serial_ms_per_plan":
+                serial_s / serial_plans * 1000.0 if serial_plans
+                else 0.0,
             "windows": windows,
         }

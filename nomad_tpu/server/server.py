@@ -301,6 +301,12 @@ class Server:
         reg.register("broker", self.eval_broker.stats)
         reg.register("plan_queue", self.plan_queue.stats)
         reg.register("applier", self.plan_applier.stats)
+        # The partitioned verify's component executor: worker count,
+        # windows dispatched, components run, live walks (ISSUE 13 —
+        # an incident reader correlates these with the flight
+        # recorder's per-component stall attribution).
+        reg.register("applier_components",
+                     self.plan_applier.components.stats)
         reg.register("overload", self.overload.stats)
         reg.register("heartbeat", self.heartbeats.stats)
         # fsm.state is REPLACED on snapshot restore: resolve per read.
@@ -550,6 +556,10 @@ class Server:
         # After revoke (which cleared the timers): reap the heartbeat
         # service threads so nothing fires into the torn-down server.
         self.heartbeats.shutdown()
+        # Broker nack wheel + the applier's component executor are
+        # service threads with the same contract.
+        self.eval_broker.shutdown()
+        self.plan_applier.shutdown()
         # Watch fan-out last: the RPC teardown above already
         # deregistered every parked long-poll; this reaps the shared
         # timeout wheel and answers any straggler as timed out.

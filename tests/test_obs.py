@@ -25,6 +25,7 @@ not just the nightly bench.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 
@@ -310,6 +311,49 @@ class TestFlightRecorder:
         # uninstall joined the watchdog thread.
         assert not any(th.name == "flight-stall-watchdog"
                        for th in threading.enumerate())
+
+    def test_stall_guard_extra_fn_names_the_slow_component(self,
+                                                           tmp_path):
+        """ISSUE 13 satellite: the applier.window stall guard's
+        incident dump carries the component executor's per-component
+        attribution — a wedged window names WHAT it was verifying, not
+        just that it wedged."""
+        from nomad_tpu.server.plan_apply import ComponentExecutor
+
+        executor = ComponentExecutor(workers=1)
+        started = threading.Event()
+        release = threading.Event()
+
+        def slow():
+            started.set()
+            release.wait(10.0)
+            return []
+
+        runner = threading.Thread(
+            target=lambda: executor.run_components(
+                [slow, lambda: []],
+                descs=[{"component": 0, "plans": 7,
+                        "eval_ids": ["ev-stuck"]}, None]))
+        with flight.installed(str(tmp_path)) as rec:
+            runner.start()
+            try:
+                assert started.wait(5.0)
+                with flight.guard("applier.window", timeout=0.05,
+                                  extra_fn=executor.active):
+                    wait_until(lambda: rec.incidents(), timeout=5.0)
+            finally:
+                release.set()
+                runner.join(5.0)
+                executor.stop()
+            names = rec.incidents()
+            assert len(names) == 1 and "applier.window" in names[0]
+            with open(os.path.join(str(tmp_path), names[0])) as fh:
+                doc = json.load(fh)
+            verifying = doc["extra"]["verifying"]
+            assert any("ev-stuck" in str(v.get("eval_ids"))
+                       for v in verifying), \
+                "the incident must name the slow component"
+            assert "stalled_for_s" in doc["extra"]
 
     def test_breaker_open_trips(self, tmp_path):
         from nomad_tpu.scheduler.breaker import DeviceCircuitBreaker

@@ -94,10 +94,14 @@ def sequential_apply(store: StateStore, plans: list,
 
 
 def grouped_apply(store: StateStore, plans: list,
-                  base_index: int) -> list:
-    """The group-commit path: one window verify, one batched upsert,
-    same per-plan index sequence."""
-    outcomes = evaluate_window(store, plans)
+                  base_index: int, executor=None,
+                  partition: bool = True) -> list:
+    """The group-commit path: one window verify (partitioned by
+    default; optionally concurrent via a ComponentExecutor, or the
+    flat ``partition=False`` walk), one batched upsert, same per-plan
+    index sequence."""
+    outcomes = evaluate_window(store, plans, executor=executor,
+                               partition=partition)
     items = []
     for i, outcome in enumerate(outcomes):
         result = outcome.result
@@ -242,28 +246,58 @@ class TestWindowSemantics:
 # 2. sequential parity (the acceptance bar)
 # ---------------------------------------------------------------------------
 
+def _parity_modes():
+    """The grouped paths the rigs pin against sequential truth: the
+    default partitioned walk, the partitioned walk on a REAL concurrent
+    ComponentExecutor, and the flat pre-partition walk (the bench's
+    sequential-applier baseline)."""
+    from nomad_tpu.server.plan_apply import ComponentExecutor
+
+    executor = ComponentExecutor(workers=2)
+    return [
+        ("partitioned", None, True),
+        ("concurrent", executor, True),
+        ("flat", None, False),
+    ], executor
+
+
+def _stamp_adversarial_deadlines(plans) -> None:
+    """Deadlines DESCENDING by window position, so the deadline-aware
+    component scheduler verifies components in roughly REVERSE window
+    order — results must still be byte-identical to eval order."""
+    import time as _time
+    now = _time.monotonic()
+    n = len(plans)
+    for i, plan in enumerate(plans):
+        plan.deadline = now + 100.0 + (n - i) * 10.0
+
+
 class TestSequentialParity:
     def test_adversarial_stream_parity(self):
         """Hand-built contended stream covering every verdict family:
         clean full accepts (with port claims), an order-sensitive accept
         on a shared node, a window port collision, cross-plan
         over-commit, all_at_once whole-rejection, evict+refill, an
-        in-place update, and failed allocs riding a rejected plan."""
+        in-place update, and failed allocs riding a rejected plan —
+        replayed through the partitioned, concurrent-executor and flat
+        grouped paths against one sequential truth, with adversarial
+        deadlines so component scheduling order != eval order."""
         nodes = [mock.node(i) for i in range(6)]
 
         def setup(store):
             for i, n in enumerate(nodes):
                 store.upsert_node(1000 + i, n)
 
-        # Pre-existing allocs must exist in BOTH worlds with the same
+        # Pre-existing allocs must exist in EVERY world with the same
         # ids: build once, upsert into each store.
-        s_seq, s_grp = StateStore(), StateStore()
-        for store in (s_seq, s_grp):
-            setup(store)
         existing = make_alloc(nodes[3], cpu=FREE_CPU)
         existing2 = make_alloc(nodes[4], cpu=2000)
-        for store in (s_seq, s_grp):
+
+        def world():
+            store = StateStore()
+            setup(store)
             store.upsert_allocs(1500, [existing, existing2])
+            return store
 
         plans = []
         plans.append(place_plan(net_alloc(nodes[0], ports=[9000])))
@@ -287,12 +321,21 @@ class TestSequentialParity:
         failed.node_id = ""
         full_plan.append_failed(failed)
         plans.append(full_plan)
+        _stamp_adversarial_deadlines(plans)
 
+        s_seq = world()
         res_seq = sequential_apply(s_seq, plans, 2000)
-        res_grp = grouped_apply(s_grp, plans, 2000)
-        assert [result_key(r) for r in res_seq] == \
-            [result_key(r) for r in res_grp]
-        assert store_image(s_seq) == store_image(s_grp)
+        modes, executor = _parity_modes()
+        try:
+            for name, ex, part in modes:
+                s_grp = world()
+                res_grp = grouped_apply(s_grp, plans, 2000,
+                                        executor=ex, partition=part)
+                assert [result_key(r) for r in res_seq] == \
+                    [result_key(r) for r in res_grp], name
+                assert store_image(s_seq) == store_image(s_grp), name
+        finally:
+            executor.stop()
         # Sanity on the interesting verdicts.
         assert result_key(res_seq[2])[1] == {}      # port collision
         assert result_key(res_seq[4])[1] == {}      # over-commit
@@ -301,8 +344,9 @@ class TestSequentialParity:
 
     def test_recorded_contended_storm_stream_parity(self):
         """Record a REAL contended plan stream (fused storm through the
-        verifying planner), then replay it both ways onto fresh
-        worlds."""
+        verifying planner), then replay it onto fresh worlds through
+        every grouped path — partitioned, concurrent-executor, flat —
+        against one sequential truth."""
         from nomad_tpu.scheduler import Harness
         from nomad_tpu.scheduler.batch import BatchEvalRunner
         from nomad_tpu.scheduler.harness import VerifyingPlanner
@@ -336,19 +380,27 @@ class TestSequentialParity:
                         state_refresh=h.snapshot).process(evals)
         plans = h.plans
         assert plans, "storm recorded no plans"
+        _stamp_adversarial_deadlines(plans)
 
-        def setup(store):
+        def world():
+            store = StateStore()
             for i, n in enumerate(nodes):
                 store.upsert_node(1000 + i, n.copy())
+            return store
 
-        s_seq, s_grp = StateStore(), StateStore()
-        setup(s_seq)
-        setup(s_grp)
+        s_seq = world()
         res_seq = sequential_apply(s_seq, plans, 5000)
-        res_grp = grouped_apply(s_grp, plans, 5000)
-        assert [result_key(r) for r in res_seq] == \
-            [result_key(r) for r in res_grp]
-        assert store_image(s_seq) == store_image(s_grp)
+        modes, executor = _parity_modes()
+        try:
+            for name, ex, part in modes:
+                s_grp = world()
+                res_grp = grouped_apply(s_grp, plans, 5000,
+                                        executor=ex, partition=part)
+                assert [result_key(r) for r in res_seq] == \
+                    [result_key(r) for r in res_grp], name
+                assert store_image(s_seq) == store_image(s_grp), name
+        finally:
+            executor.stop()
 
 
 # ---------------------------------------------------------------------------
@@ -559,3 +611,447 @@ class TestDrainPending:
         assert len(q.drain_pending(3)) == 3
         assert len(q.drain_pending(0)) == 0
         assert len(q.drain_pending(9)) == 2
+
+    def test_deadline_promotion_pulls_near_deadline_plan_forward(self):
+        """A LOW-priority plan whose deadline falls inside the drain
+        horizon jumps the high-priority stream — without promotion it
+        would sit past the window cut until _fence expires it."""
+        import time as _time
+
+        q = PlanQueue()
+        q.set_enabled(True)
+        urgent = Plan(eval_id=generate_uuid(), priority=1)
+        urgent.deadline = _time.monotonic() + 0.05
+        hi = [Plan(eval_id=generate_uuid(), priority=90)
+              for _ in range(4)]
+        for p in hi:
+            q.enqueue(p)
+        q.enqueue(urgent)
+        # Window of 3 out of 5 pending: plain priority order would
+        # never include the low-priority near-deadline plan.
+        first = q.dequeue(0)
+        window = [first.plan] + [f.plan
+                                 for f in q.drain_pending(2,
+                                                          horizon=1.0)]
+        assert urgent in window, "near-deadline plan must be promoted"
+        assert window[1] is urgent, "promoted plans lead the window"
+        assert q.stats()["deadline_promotions"] == 1
+        # The remaining high-priority plans are still there, in order.
+        rest = q.drain_pending(8, horizon=1.0)
+        assert len(rest) == 2
+        assert q.stats()["depth"] == 0
+
+    def test_far_deadlines_keep_priority_order(self):
+        import time as _time
+
+        q = PlanQueue()
+        q.set_enabled(True)
+        lo = Plan(eval_id=generate_uuid(), priority=10)
+        lo.deadline = _time.monotonic() + 500.0  # far outside horizon
+        hi = Plan(eval_id=generate_uuid(), priority=90)
+        q.enqueue(lo)
+        q.enqueue(hi)
+        first = q.dequeue(0)
+        assert first.plan is hi
+        assert [f.plan for f in q.drain_pending(4, horizon=0.25)] == [lo]
+        assert q.stats()["deadline_promotions"] == 0
+
+    def test_await_depth_returns_on_fill_and_timeout(self):
+        import threading
+        import time as _time
+
+        q = PlanQueue()
+        q.set_enabled(True)
+        t0 = _time.monotonic()
+        assert q.await_depth(2, timeout=0.05) == 0  # times out empty
+        assert _time.monotonic() - t0 >= 0.04
+
+        def fill():
+            q.enqueue(Plan(eval_id=generate_uuid(), priority=50))
+            q.enqueue(Plan(eval_id=generate_uuid(), priority=50))
+
+        t = threading.Thread(target=fill)
+        t.start()
+        assert q.await_depth(2, timeout=5.0) >= 2  # wakes on fill
+        t.join(2.0)
+
+
+# ---------------------------------------------------------------------------
+# 5. the claim-graph partitioner (ISSUE 13 satellite: exactness)
+# ---------------------------------------------------------------------------
+
+def _brute_force_components(plans) -> set:
+    """Reference partition: adjacency over shared claimed nodes,
+    flood-filled."""
+    from nomad_tpu.ops.plan_conflict import _touched
+
+    n = len(plans)
+    touched = [_touched(p) for p in plans]
+    adj = {i: set() for i in range(n)}
+    for i in range(n):
+        for j in range(i + 1, n):
+            if touched[i] & touched[j]:
+                adj[i].add(j)
+                adj[j].add(i)
+    seen: set = set()
+    comps = []
+    for i in range(n):
+        if i in seen:
+            continue
+        comp = set()
+        stack = [i]
+        while stack:
+            k = stack.pop()
+            if k in comp:
+                continue
+            comp.add(k)
+            stack.extend(adj[k] - comp)
+        seen |= comp
+        comps.append(frozenset(comp))
+    return set(comps)
+
+
+class TestPartitioner:
+    def test_random_claim_sets_match_brute_force(self):
+        """Property test: union-find components over random windows ==
+        the brute-force adjacency flood fill, and no two plans in
+        different components share a node claim — across many seeds,
+        with evict-frees-capacity and port-collision window shapes
+        mixed in."""
+        import random
+
+        from nomad_tpu.ops.plan_conflict import (_touched,
+                                                 partition_window)
+
+        nodes = [mock.node(i) for i in range(12)]
+        for seed in range(40):
+            rng = random.Random(seed)
+            plans = []
+            for _ in range(rng.randrange(1, 24)):
+                kind = rng.random()
+                picked = rng.sample(nodes, rng.randrange(1, 4))
+                if kind < 0.25:
+                    # evict-frees-capacity shape: stop + refill
+                    plan = Plan(eval_id=generate_uuid())
+                    victim = make_alloc(picked[0], cpu=FREE_CPU)
+                    plan.append_update(victim,
+                                       ALLOC_DESIRED_STATUS_STOP,
+                                       "preempted")
+                    if len(picked) > 1:
+                        plan.append_alloc(make_alloc(picked[1]))
+                elif kind < 0.5:
+                    # port-collision shape: static port claims
+                    plan = place_plan(*[net_alloc(n, ports=[9000])
+                                        for n in picked])
+                else:
+                    plan = place_plan(*[make_alloc(n) for n in picked])
+                plans.append(plan)
+
+            comps = partition_window(plans)
+            # Exact partition of indices.
+            flat = [i for c in comps for i in c]
+            assert sorted(flat) == list(range(len(plans)))
+            assert all(c == sorted(c) for c in comps)
+            # Matches brute force.
+            assert {frozenset(c) for c in comps} == \
+                _brute_force_components(plans), seed
+            # Cross-component node-claim disjointness.
+            for a in range(len(comps)):
+                for b in range(a + 1, len(comps)):
+                    nodes_a = set().union(*[_touched(plans[i])
+                                            for i in comps[a]])
+                    nodes_b = set().union(*[_touched(plans[i])
+                                            for i in comps[b]])
+                    assert not (nodes_a & nodes_b), seed
+
+    def test_components_ordered_by_first_member(self):
+        from nomad_tpu.ops.plan_conflict import partition_window
+
+        a, b = mock.node(), mock.node(1)
+        plans = [place_plan(make_alloc(a)),     # comp 0
+                 place_plan(make_alloc(b)),     # comp 1
+                 place_plan(make_alloc(a))]     # joins comp 0
+        comps = partition_window(plans)
+        assert comps == [[0, 2], [1]]
+
+    def test_window_info_reports_partition(self):
+        store = StateStore()
+        nodes = [mock.node(i) for i in range(4)]
+        for i, n in enumerate(nodes):
+            store.upsert_node(1000 + i, n)
+        plans = [place_plan(make_alloc(n)) for n in nodes]
+        outcomes = evaluate_window(store, plans)
+        assert outcomes.info is not None
+        assert outcomes.info["components"] == 4
+        assert outcomes.info["sizes"] == [1, 1, 1, 1]
+        assert {o.component for o in outcomes} == {0, 1, 2, 3}
+
+    def test_big_component_rides_the_executor(self):
+        """A window with a real conflict cluster (>= the concurrency
+        threshold) dispatches to the ComponentExecutor, and verdicts
+        stay byte-identical to sequential application."""
+        from nomad_tpu.ops.plan_conflict import MIN_CONCURRENT_COMPONENT
+        from nomad_tpu.server.plan_apply import ComponentExecutor
+
+        shared = mock.node()
+        others = [mock.node(i + 1) for i in range(4)]
+
+        def world():
+            store = StateStore()
+            store.upsert_node(1000, shared)
+            for i, n in enumerate(others):
+                store.upsert_node(1001 + i, n)
+            return store
+
+        plans = [place_plan(make_alloc(shared, cpu=300))
+                 for _ in range(MIN_CONCURRENT_COMPONENT)]
+        plans += [place_plan(make_alloc(n)) for n in others]
+
+        s_seq = world()
+        res_seq = sequential_apply(s_seq, plans, 3000)
+        executor = ComponentExecutor(workers=2)
+        try:
+            s_grp = world()
+            res_grp = grouped_apply(s_grp, plans, 3000,
+                                    executor=executor)
+            assert [result_key(r) for r in res_seq] == \
+                [result_key(r) for r in res_grp]
+            assert store_image(s_seq) == store_image(s_grp)
+            stats = executor.stats()
+            assert stats["batches"] >= 1, \
+                "a >= threshold component must ride the executor"
+            assert stats["components_run"] >= 5
+        finally:
+            executor.stop()
+
+
+# ---------------------------------------------------------------------------
+# 6. deadline fencing + the applier's service threads
+# ---------------------------------------------------------------------------
+
+class TestDeadlineFence:
+    def test_expired_plan_dropped_before_verification(self):
+        """_fence_window answers an already-expired plan with
+        ErrDeadlineExceeded, commits the live plans, and counts the
+        drop."""
+        import time as _time
+
+        from nomad_tpu.server.overload import ErrDeadlineExceeded
+
+        broker, fsm, raft, queue, applier = _rig()
+        node = mock.node()
+        raft.apply(codec.encode(codec.NODE_REGISTER_REQUEST,
+                                {"node": node.to_dict()})).wait(5.0)
+        live = _outstanding_plan(broker, fsm, raft, node, cpu=100)
+        live.deadline = _time.monotonic() + 30.0
+        dead = _outstanding_plan(broker, fsm, raft, node, cpu=100)
+        dead.deadline = _time.monotonic() - 0.1
+        f_live = queue.enqueue(live)
+        f_dead = queue.enqueue(dead)
+        window = [queue.dequeue(0)] + queue.drain_pending(63)
+        try:
+            applier._apply_window(window, None, None)
+            with pytest.raises(ErrDeadlineExceeded):
+                f_dead.wait(5.0)
+            assert f_live.wait(5.0).alloc_index > 0
+            assert applier.stats()["expired_drops"] == 1
+            assert len(fsm.state.allocs_by_node(node.id)) == 1
+        finally:
+            applier.shutdown(5.0)
+            broker.shutdown()
+
+
+class TestDispatchFailureOverlay:
+    def test_dispatch_failure_drops_phantom_overlay_folds(self):
+        """A window whose raft DISPATCH fails has already folded its
+        allocs into the applier's optimistic overlay (the partitioned
+        path folds before the committer hand-off): the next window
+        must verify against a fresh snapshot, not the phantoms — a
+        later plan that fits only if the failed window never happened
+        must be ACCEPTED."""
+        from nomad_tpu import faultinject
+        from nomad_tpu.faultinject import FaultPlan
+
+        broker, fsm, raft, queue, applier = _rig()
+        node = mock.node()
+        raft.apply(codec.encode(codec.NODE_REGISTER_REQUEST,
+                                {"node": node.to_dict()})).wait(5.0)
+        try:
+            full_a = _outstanding_plan(broker, fsm, raft, node,
+                                       cpu=FREE_CPU)
+            full_b = _outstanding_plan(broker, fsm, raft, node,
+                                       cpu=FREE_CPU)
+            fplan = FaultPlan.parse("raft.apply=error(count=1)")
+            with faultinject.injected(fplan):
+                f_a = queue.enqueue(full_a)
+                window = [queue.dequeue(0)] + queue.drain_pending(63)
+                wait_future, snap = applier._apply_window(
+                    window, None, None)
+                with pytest.raises(Exception):
+                    f_a.wait(5.0)  # dispatch failed; flag raised
+
+                # Same node, full capacity again: fits ONLY if the
+                # failed window's folds are dropped.  Thread the
+                # RETURNED overlay state through, like run() does.
+                f_b = queue.enqueue(full_b)
+                window = [queue.dequeue(0)] + queue.drain_pending(63)
+                applier._apply_window(window, wait_future, snap)
+                assert f_b.wait(5.0).alloc_index > 0, \
+                    "phantom folds from a failed dispatch must not " \
+                    "reject later plans"
+            assert len(fsm.state.allocs_by_node(node.id)) == 1
+        finally:
+            applier.shutdown(5.0)
+            broker.shutdown()
+
+    def test_window_queued_behind_failed_dispatch_is_refused(self):
+        """The in-flight variant: window B verifies (and is ACCEPTED)
+        against window A's overlay folds while A's dispatch has not
+        yet failed, and queues behind A in the committer.  FIFO means
+        B's commit job observes A's failure — it must be REFUSED with
+        a retryable error (B fits only thanks to A's phantom
+        eviction; committing it would durably over-commit the node) —
+        and B's retry against refreshed state must see the truth."""
+        import threading
+
+        from nomad_tpu import faultinject
+        from nomad_tpu.faultinject import FaultPlan
+
+        broker, fsm, raft, queue, applier = _rig()
+        applier.max_inflight_commits = 4  # let B queue behind A
+        node = mock.node()
+        raft.apply(codec.encode(codec.NODE_REGISTER_REQUEST,
+                                {"node": node.to_dict()})).wait(5.0)
+        existing = make_alloc(node, cpu=FREE_CPU)
+        raft.apply(codec.encode(
+            codec.ALLOC_UPDATE_REQUEST,
+            {"alloc": [existing.to_dict()]})).wait(5.0)
+        try:
+            # A: token-fenced EVICTION of the full-node alloc.
+            ev_a = _outstanding_plan(broker, fsm, raft, node, cpu=1)
+            plan_a = Plan(eval_id=ev_a.eval_id,
+                          eval_token=ev_a.eval_token, priority=50)
+            plan_a.append_update(existing, ALLOC_DESIRED_STATUS_STOP,
+                                 "preempted")
+            # B: fills the capacity A's eviction would free.
+            plan_b = _outstanding_plan(broker, fsm, raft, node,
+                                       cpu=FREE_CPU)
+
+            # Hold the committer so BOTH windows queue before either
+            # dispatches, then fail A's dispatch.
+            gate = threading.Event()
+            applier._committer.submit(lambda: gate.wait(10.0))
+            fplan = FaultPlan.parse("raft.apply=error(count=1)")
+            with faultinject.injected(fplan):
+                f_a = queue.enqueue(plan_a)
+                window = [queue.dequeue(0)] + queue.drain_pending(63)
+                wait_future, snap = applier._apply_window(
+                    window, None, None)
+                f_b = queue.enqueue(plan_b)
+                window = [queue.dequeue(0)] + queue.drain_pending(63)
+                applier._apply_window(window, wait_future, snap)
+                gate.set()
+                with pytest.raises(Exception):
+                    f_a.wait(5.0)   # A: dispatch error
+                with pytest.raises(RuntimeError, match="retry"):
+                    f_b.wait(5.0)   # B: refused, never committed
+
+            # Nothing moved: the existing alloc still owns the node.
+            live = [a for a in fsm.state.allocs_by_node(node.id)
+                    if not a.terminal_status()]
+            assert [a.id for a in live] == [existing.id], \
+                "a phantom-verified window must never commit"
+
+            # B's retry sees refreshed truth: the node is still full,
+            # so the plan is rejected with a refresh (not placed).
+            f_b2 = queue.enqueue(plan_b)
+            window = [queue.dequeue(0)] + queue.drain_pending(63)
+            applier._apply_window(window, None, None)
+            result = f_b2.wait(5.0)
+            assert result.node_allocation == {}
+            assert result.refresh_index > 0
+        finally:
+            applier.shutdown(5.0)
+            broker.shutdown()
+
+
+class TestApplierServiceThreads:
+    def test_component_executor_active_attribution(self):
+        """The executor's active() snapshot names what is verifying
+        RIGHT NOW — the flight recorder's per-component stall
+        attribution rides it."""
+        import threading
+
+        from nomad_tpu.server.plan_apply import ComponentExecutor
+
+        executor = ComponentExecutor(workers=1)
+        started = threading.Event()
+        release = threading.Event()
+
+        def slow():
+            started.set()
+            release.wait(5.0)
+            return "done"
+
+        tasks = [slow] + [lambda: "fast"] * 3
+        descs = [{"component": 0, "eval_ids": ["ev-slow"]},
+                 None, None, None]
+        out = []
+        runner = threading.Thread(
+            target=lambda: out.append(
+                executor.run_components(tasks, descs)))
+        runner.start()
+        try:
+            assert started.wait(5.0)
+            active = executor.active()
+            assert active["verifying"], "a walk is live"
+            blob = str(active)
+            assert "ev-slow" in blob, \
+                "the stall attribution must name the slow component"
+        finally:
+            release.set()
+            runner.join(5.0)
+            executor.stop()
+        assert out and [r for chunk in out for r in [chunk]] is not None
+
+    def test_executor_stop_reaps_workers(self):
+        import threading
+
+        from nomad_tpu.server.plan_apply import ComponentExecutor
+
+        executor = ComponentExecutor(workers=2, name="test-comps")
+        executor.run_components([lambda: 1, lambda: 2, lambda: 3])
+        assert any(t.name.startswith("test-comps")
+                   for t in threading.enumerate())
+        executor.stop()
+        assert not any(t.name.startswith("test-comps") and t.is_alive()
+                       for t in threading.enumerate())
+
+    def test_committer_survives_and_keeps_order(self):
+        """FIFO commit order: jobs resolve in submission order even
+        when earlier jobs are slower."""
+        import threading
+        import time as _time
+
+        from nomad_tpu.server.plan_apply import _Committer
+
+        committer = _Committer(name="test-committer")
+        order = []
+        done = threading.Event()
+
+        def job(k, delay):
+            def run():
+                _time.sleep(delay)  # sleep-ok: ordering probe
+                order.append(k)
+                if k == 2:
+                    done.set()
+            return run
+
+        committer.submit(job(0, 0.05))
+        committer.submit(job(1, 0.0))
+        committer.submit(job(2, 0.0))
+        assert done.wait(5.0)
+        assert order == [0, 1, 2]
+        committer.stop()
+        assert not any(t.name == "test-committer" and t.is_alive()
+                       for t in threading.enumerate())

@@ -459,6 +459,63 @@ class TestLintGate:
                        for e in allowlist), \
             "obs plane must not need allowlist entries"
 
+    def test_partitioned_verify_rides_the_gates(self):
+        """ISSUE 13 satellite: the partitioned window verify — the
+        claim-graph partitioner + component walks
+        (ops/plan_conflict.py), the component executor + committer
+        pipeline + window-batched fence (server/plan_apply.py), the
+        deadline-aware plan queue (server/plan_queue.py), and the
+        broker's wheel-backed nack timers + targeted wakeups + token
+        mirror (server/eval_broker.py) — is inside every gate's scan
+        set, strict-clean, with ZERO new allowlist entries (the round
+        RETIRED the applier's respond-thread leak waiver)."""
+        from nomad_tpu.analysis import (default_package_root,
+                                        load_allowlist)
+        from nomad_tpu.analysis.callgraph import CallGraph
+
+        pkg = default_package_root()
+        graph = CallGraph.build(pkg)
+        for qual in (
+            "nomad_tpu.ops.plan_conflict:partition_window",
+            "nomad_tpu.ops.plan_conflict:_walk_component",
+            "nomad_tpu.ops.plan_conflict:_evaluate_window_vec",
+            "nomad_tpu.ops.plan_conflict:_Frame.__init__",
+            "nomad_tpu.server.plan_apply:ComponentExecutor"
+            ".run_components",
+            "nomad_tpu.server.plan_apply:ComponentExecutor._worker",
+            "nomad_tpu.server.plan_apply:ComponentExecutor.stop",
+            "nomad_tpu.server.plan_apply:_Committer._run",
+            "nomad_tpu.server.plan_apply:_Committer.stop",
+            "nomad_tpu.server.plan_apply:PlanApplier._fence_window",
+            "nomad_tpu.server.plan_apply:PlanApplier._commit_job",
+            "nomad_tpu.server.plan_queue:PlanQueue.drain_pending",
+            "nomad_tpu.server.plan_queue:PlanQueue.await_depth",
+            "nomad_tpu.server.eval_broker:EvalBroker.outstanding_many",
+            "nomad_tpu.server.eval_broker:EvalBroker._nack_expired",
+        ):
+            assert qual in graph.functions, \
+                f"{qual} missing from the interprocedural graph"
+
+        allowlist = load_allowlist(default_allowlist_path())
+        gating, _allowed, _stale = partition_findings(
+            run_lint(strict=True), allowlist)
+        touching = [f for f in gating
+                    if "plan_conflict" in f.path
+                    or "plan_apply" in f.path
+                    or "plan_queue" in f.path
+                    or "eval_broker" in f.path]
+        assert touching == [], \
+            "partitioned-verify paths must lint clean:\n" + \
+            "\n".join(f.render() for f in touching)
+        assert not any("plan_conflict" in e or "plan_queue" in e
+                       or "eval_broker" in e or "ComponentExecutor" in e
+                       or "_Committer" in e or "plan_apply" in e
+                       for e in allowlist), \
+            "partitioned verify must not need allowlist entries " \
+            "(the respond-thread waiver was retired this round)"
+        # The fixed-sleep ratchet stays 0 (asserted by its own test
+        # below); the gather wait is a condition, not a sleep.
+
     def test_fixed_sleep_ratchet_is_clean(self):
         """Every fixed time.sleep in the test tree is either converted
         to wait_until or carries a '# sleep-ok: why' justification —
