@@ -269,7 +269,13 @@ class Agent:
     def metrics_payload(self) -> dict:
         """The /v1/agent/metrics document: every registry this process
         owns (agent + colocated server + process singletons) flattened
-        to ``nomad.*`` keys, plus the in-memory telemetry sink."""
+        to ``nomad.*`` keys, plus the in-memory telemetry sink.
+
+        ``collect`` (not ``snapshot``): the serving surface stamps each
+        provider's ``age_s`` staleness gauge and runs providers under a
+        sample deadline, so one component wedged on a dead lock
+        isolates as ``.error`` instead of hanging every monitoring
+        poll (obs/registry.py)."""
         from nomad_tpu.obs import REGISTRY
         from nomad_tpu.utils.metrics import metrics
 
@@ -277,7 +283,8 @@ class Agent:
         if self.server is not None:
             extra.append(self.server.obs_registry)
         return {
-            "providers": self.obs_registry.snapshot(extra=extra),
+            "providers": self.obs_registry.collect(timeout=2.0,
+                                                   extra=extra),
             "inmem": metrics.inmem.snapshot(),
         }
 
@@ -394,3 +401,7 @@ class Agent:
             self.client.destroy_all()
         if self.server is not None:
             self.server.shutdown()
+        # Drop the agent-level providers and reap the registry's
+        # deadline sampler (lazily spawned by metrics_payload's
+        # collect) — no monitoring thread may outlive the agent.
+        self.obs_registry.clear()

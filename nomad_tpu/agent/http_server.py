@@ -507,8 +507,27 @@ class HTTPServer:
             # keys + the in-mem telemetry sink.  Always mounted (not
             # behind enable_debug): metrics are the production
             # monitoring surface, like the reference's /v1/agent/self
-            # stats block, and carry no secrets.
-            return 200, agent.metrics_payload(), None
+            # stats block, and carry no secrets.  ?filter=sub trims
+            # the provider keys server-side — the `metrics -watch`
+            # poller re-samples every N seconds and should not drag
+            # the full document over the wire each round.
+            payload = agent.metrics_payload()
+            flt = str(query.get("filter", "") or "")
+            if flt:
+                payload["providers"] = {
+                    k: v for k, v in payload["providers"].items()
+                    if flt in k}
+                # The inmem sink's sections are flat {key: ...} maps;
+                # trim them by the same substring — the counters and
+                # sample summaries are the BULK of the document, and a
+                # tight watch poll must not re-download them all.
+                payload["inmem"] = {
+                    section: ({k: v for k, v in vals.items()
+                               if flt in k}
+                              if isinstance(vals, dict) else vals)
+                    for section, vals in
+                    (payload.get("inmem") or {}).items()}
+            return 200, payload, None
         if parts == ["agent", "monitor"]:
             # Recent agent log lines from the in-process ring
             # (reference command/agent/log_writer.go: the monitor's

@@ -192,11 +192,14 @@ class APIClient:
         data, _ = self.raw("GET", "/v1/agent/monitor", params)
         return data.get("lines", []), int(data.get("offset", 0))
 
-    def agent_metrics(self) -> dict:
+    def agent_metrics(self, filter: str = "") -> dict:
         """The unified metrics document (/v1/agent/metrics):
         ``providers`` = flattened nomad.* registry gauges, ``inmem`` =
-        the in-memory telemetry sink's counters/gauges/samples."""
-        data, _ = self.get("/v1/agent/metrics")
+        the in-memory telemetry sink's counters/gauges/samples.
+        ``filter`` trims provider keys server-side (substring match) —
+        the watch poller's payload diet."""
+        params = {"filter": filter} if filter else None
+        data, _ = self.raw("GET", "/v1/agent/metrics", params)
         return data
 
     def agent_members(self) -> list:

@@ -155,6 +155,14 @@ def main(argv=None) -> int:
     p.add_argument("-filter", default="",
                    help="only keys containing this substring "
                         "(e.g. 'broker', 'applier')")
+    p.add_argument("-watch", type=float, default=0.0, metavar="N",
+                   help="re-sample every N seconds and render deltas "
+                        "(rates for counters) — live view of the "
+                        "feedback controller's behavior; Ctrl-C stops")
+    p.add_argument("-rounds", type=int, default=0,
+                   help="with -watch: stop after this many re-samples "
+                        "(0 = until interrupted); scripts and tests "
+                        "bound the loop with it")
     sub.add_parser("version", help="print version")
 
     p = sub.add_parser(
@@ -624,18 +632,22 @@ def cmd_metrics(args) -> int:
     agent: flat ``key = value`` lines sorted by key (the key grammar is
     ``nomad.<provider>.<path...>``), or the raw JSON document with
     -json.  The in-mem sink's counters and sample summaries ride along
-    under ``counters.*`` / ``samples.*``."""
-    from nomad_tpu.obs.registry import flatten
+    under ``counters.*`` / ``samples.*``.
 
+    ``-watch N`` re-samples every N seconds and renders DELTAS: the
+    full listing once, then only the keys that changed, each with its
+    per-second rate — so counters read as rates and the feedback
+    controller's knob movements (``nomad.controller.knobs.*.value``)
+    are observable live."""
     client = APIClient(args.address)
+    if args.watch and args.watch > 0:
+        return _watch_metrics(client, args.watch, args.filter,
+                              args.rounds)
     doc = client.agent_metrics()
     if args.as_json:
         print(json.dumps(doc, indent=2, default=str))
         return 0
-    # ONE flattening grammar (obs/registry.flatten) for the inmem doc
-    # too: counters.<key>, gauges.<key>, samples.<key>.<stat>.
-    flat = dict(doc.get("providers") or {})
-    flat.update(flatten(doc.get("inmem") or {}))
+    flat = _flat_metrics(doc)
     shown = 0
     for key in sorted(flat):
         if args.filter and args.filter not in key:
@@ -646,6 +658,65 @@ def cmd_metrics(args) -> int:
         print(f"no metric keys contain {args.filter!r}", file=sys.stderr)
         return 1
     return 0
+
+
+def _flat_metrics(doc: dict) -> dict:
+    """ONE flattening grammar (obs/registry.flatten) for the inmem doc
+    too: counters.<key>, gauges.<key>, samples.<key>.<stat>."""
+    from nomad_tpu.obs.registry import flatten
+
+    flat = dict(doc.get("providers") or {})
+    flat.update(flatten(doc.get("inmem") or {}))
+    return flat
+
+
+def _watch_metrics(client, interval: float, flt: str,
+                   rounds: int) -> int:
+    """The -watch loop: first sample prints the (filtered) listing;
+    every later round prints only the keys whose value changed, as
+    ``key = new (Δdelta, rate/s)`` for numeric keys — a counter's
+    line IS its rate.  The substring filter rides to the server
+    (?filter=) so a tight watch does not drag the full document over
+    the wire every round."""
+    prev: "dict | None" = None
+    prev_t = 0.0
+    done = 0
+    try:
+        while True:
+            doc = client.agent_metrics(filter=flt)
+            now = time.monotonic()
+            flat = {k: v for k, v in _flat_metrics(doc).items()
+                    if not flt or flt in k}
+            if prev is None:
+                for key in sorted(flat):
+                    print(f"{key} = {flat[key]}")
+            else:
+                dt = max(now - prev_t, 1e-9)
+                changed = []
+                for key in sorted(flat):
+                    old, new = prev.get(key), flat[key]
+                    if old == new:
+                        continue
+                    if isinstance(new, (int, float)) \
+                            and not isinstance(new, bool) \
+                            and isinstance(old, (int, float)):
+                        delta = new - old
+                        changed.append(
+                            f"{key} = {new} ({delta:+g}, "
+                            f"{delta / dt:+.1f}/s)")
+                    else:
+                        changed.append(f"{key} = {new} (was {old})")
+                print(f"--- +{interval:g}s: {len(changed)} of "
+                      f"{len(flat)} keys changed")
+                for line in changed:
+                    print(line)
+            prev, prev_t = flat, now
+            done += 1
+            if rounds and done > rounds:
+                return 0
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
 
 
 def cmd_version(args) -> int:

@@ -51,12 +51,20 @@ class FlightRecorder:
                  max_spans: int = 2048,
                  min_interval: float = 5.0,
                  registries: Optional[list] = None,
+                 extra_fn=None,
                  clock=time.monotonic) -> None:
         self.directory = directory
         self.max_files = max(1, max_files)
         self.max_spans = max(1, max_spans)
         self.min_interval = min_interval
         self.registries = list(registries or [])
+        # Process-context hook, the recorder-level twin of the stall
+        # guard's per-section extra_fn: a zero-arg -> dict called at
+        # dump time and merged into EVERY incident's extra under
+        # "context" (the feedback controller passes its per-knob
+        # positions, so any incident names where every knob sat).
+        # Best-effort: a failing hook must not eat the incident.
+        self.extra_fn = extra_fn
         self._clock = clock
         self._lock = threading.Lock()
         self._seq = 0
@@ -111,11 +119,17 @@ class FlightRecorder:
                 providers.update(reg.snapshot())
             except Exception as e:
                 providers["nomad.flight.registry_error"] = str(e)
+        extra = dict(extra or {})
+        if self.extra_fn is not None:
+            try:
+                extra["context"] = self.extra_fn()
+            except Exception:
+                logger.exception("flight recorder: extra_fn failed")
         doc = {
             "reason": reason,
             "seq": seq,
             "monotonic": self._clock(),
-            "extra": extra or {},
+            "extra": extra,
             "spans": spans,
             "thread_stacks": profiling.thread_stacks(),
             "metrics": {

@@ -50,6 +50,12 @@ the applier's ComponentExecutor, so sibling verify spans under the same
 window overlap in time, which is the concurrency made visible.
 ``raft.apply`` follows as before (shared per window, one per member).
 
+Control-plane taxonomy (ISSUE 14): the feedback controller records one
+``control.tick`` span per evaluation (tags ``tick``, ``adjusted``)
+with a ``control.adjust`` child per moved knob (``knob``, ``old``,
+``new``, ``gauge``, ``direction``, ``reversal``, ``rail``) — the
+decision trail that makes a tuning loop auditable after the fact.
+
 Export is Chrome-trace JSON (``chrome://tracing`` / Perfetto "X"
 complete events), span tags riding in ``args``.
 """

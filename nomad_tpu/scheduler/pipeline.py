@@ -170,6 +170,19 @@ class PipelinedEvalRunner(BatchEvalRunner):
         # no number has two producers (obs/registry.py).
         self.breaker_reruns = 0
         self._count_lock = threading.Lock()
+        # Dispatch/collect RTT EWMA (seconds; device dispatches only) —
+        # the feedback control plane's congestion gauge for the AIMD
+        # depth knob (control/wiring.wire_runner): injected
+        # device.dispatch delay or a genuinely slow chip inflates it,
+        # and the learned-floor driver retreats ``depth``.  Guarded by
+        # _count_lock (front and drain threads both feed samples).
+        self._rtt_ewma = 0.0
+        # Live in-flight gate: ``depth`` is a CONTROL KNOB now — the
+        # controller adjusts it mid-stream, so the bound is enforced by
+        # this counter + condition instead of a fixed-maxsize queue
+        # (a Queue's maxsize is frozen at construction).
+        self._inflight = 0
+        self._inflight_cond = threading.Condition(threading.Lock())
         self.parity_checks = 0    # probe evals parity-asserted host/dev
         # Lazy long-lived watchdog worker for deadline-bounded collects
         # (drain thread only; replaced after a timeout, see
@@ -199,7 +212,7 @@ class PipelinedEvalRunner(BatchEvalRunner):
     # -- front stage ------------------------------------------------------
     def _process_staged(self, evals: list) -> None:
         this_round, leftovers = self._split_rounds(evals)
-        q: queue.Queue = queue.Queue(maxsize=self.depth)
+        q: queue.Queue = queue.Queue()
         drain = threading.Thread(target=self._drain_loop, args=(q,),
                                  name="eval-pipeline-drain", daemon=True)
         drain.start()
@@ -220,22 +233,35 @@ class PipelinedEvalRunner(BatchEvalRunner):
                 if sched.deferred is None:
                     # Placement-less plan: submit-only item, routed
                     # through the drain stage to keep commit order.
+                    self._admit_inflight()
                     q.put(_Item(sched, None, None, None, start))
                     continue
-                place, args = sched.deferred
-                t_disp = _tnow()
-                handles, probe = self._dispatch(sched, args)
-                if sched.dispatched_host:
-                    self.host_dispatches += 1
-                else:
-                    self.device_dispatches += 1
-                    if sched.dispatched_sharded:
-                        self.sharded_dispatches += 1
-                _lane_spans("sched.dispatch", [sched], t_disp, _tnow(),
-                            host=sched.dispatched_host)
-                times["dispatch"] += time.perf_counter() - t_begin
-                q.put(_Item(sched, place, args, handles, start,
-                            probe=probe))
+                # The permit is held from here until the drain consumes
+                # the item; if anything raises before the put (a
+                # dispatch whose host fallback ALSO fails), release it
+                # — _inflight is runner-lifetime state now, and a
+                # leaked permit would shrink every later stream's
+                # effective depth.
+                self._admit_inflight()
+                try:
+                    place, args = sched.deferred
+                    t_disp = _tnow()
+                    handles, probe = self._dispatch(sched, args)
+                    if sched.dispatched_host:
+                        self.host_dispatches += 1
+                    else:
+                        self.device_dispatches += 1
+                        if sched.dispatched_sharded:
+                            self.sharded_dispatches += 1
+                        self._note_rtt(time.perf_counter() - t_begin)
+                    _lane_spans("sched.dispatch", [sched], t_disp,
+                                _tnow(), host=sched.dispatched_host)
+                    times["dispatch"] += time.perf_counter() - t_begin
+                    q.put(_Item(sched, place, args, handles, start,
+                                probe=probe))
+                except BaseException:
+                    self._release_inflight()
+                    raise
         finally:
             q.put(_STOP)
             drain.join()
@@ -288,15 +314,55 @@ class PipelinedEvalRunner(BatchEvalRunner):
         with self._count_lock:
             self.breaker_reruns += 1
 
+    def _note_rtt(self, seconds: float) -> None:
+        """Feed one device dispatch/collect wall sample into the RTT
+        EWMA (the control plane's congestion gauge)."""
+        with self._count_lock:
+            prev = self._rtt_ewma
+            self._rtt_ewma = seconds if prev <= 0.0 \
+                else 0.8 * prev + 0.2 * seconds
+
+    def _admit_inflight(self) -> None:
+        """Block until the in-flight window has room under the LIVE
+        ``depth`` knob (re-read each pass: the control plane adjusts it
+        mid-stream).  A dead drain stage still admits — the front loop
+        notices ``_failed()`` and stops, and the teardown put must
+        never deadlock behind a gate nobody will drain."""
+        while True:
+            bound = max(1, int(self.depth))  # re-read: a live knob
+            with self._inflight_cond:
+                if self._inflight < bound:
+                    self._inflight += 1
+                    return
+                self._inflight_cond.wait(0.05)
+            if self._failed():
+                with self._inflight_cond:
+                    self._inflight += 1
+                return
+
+    def _release_inflight(self) -> None:
+        with self._inflight_cond:
+            self._inflight -= 1
+            self._inflight_cond.notify_all()
+
     def stats(self) -> dict:
         """Registry provider (obs/registry.py): the runner's dispatch
         mix, stage walls, windows, and breaker interactions."""
         with self._count_lock:
             reruns = self.breaker_reruns
+            rtt_ewma = self._rtt_ewma
+        dispatches = self.host_dispatches + self.device_dispatches
         return {
             "host_dispatches": self.host_dispatches,
             "device_dispatches": self.device_dispatches,
             "sharded_dispatches": self.sharded_dispatches,
+            # Control-plane gauges: the live depth knob, the fraction
+            # of dispatches that actually rode the device, and the
+            # dispatch/collect RTT EWMA the AIMD depth driver reads.
+            "depth": self.depth,
+            "device_fraction": self.device_dispatches / dispatches
+            if dispatches else 0.0,
+            "rtt_ms_ewma": round(rtt_ewma * 1000.0, 4),
             "breaker_reruns": reruns,
             "parity_checks": self.parity_checks,
             "evals": len(self.latencies),
@@ -313,6 +379,7 @@ class PipelinedEvalRunner(BatchEvalRunner):
                 item = q.get()
                 if item is _STOP:
                     return
+                self._release_inflight()
                 window = [item]
                 # Opportunistic window: everything already queued drains
                 # as ONE batch (shared uuid slab, one native call).
@@ -324,6 +391,7 @@ class PipelinedEvalRunner(BatchEvalRunner):
                     if nxt is _STOP:
                         stop_seen = True
                         break
+                    self._release_inflight()
                     window.append(nxt)
                 self._drain_window(window)
                 if stop_seen:
@@ -339,7 +407,7 @@ class PipelinedEvalRunner(BatchEvalRunner):
             # deadlock (the front is in drain.join() by then).
             if not stop_seen:
                 while q.get() is not _STOP:
-                    pass
+                    self._release_inflight()
 
     def _drain_window(self, window: list) -> None:
         times = self.stage_times
@@ -389,7 +457,9 @@ class PipelinedEvalRunner(BatchEvalRunner):
         if sched.dispatched_host:
             return sched.collect_device(it.args, it.handles)
         try:
+            t_col = time.perf_counter()
             res = self._collect_device_bounded(it)
+            self._note_rtt(time.perf_counter() - t_col)
         except Exception as e:
             logger.warning("device collect failed (%s); re-running eval "
                            "on the host twin", e)

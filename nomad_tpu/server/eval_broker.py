@@ -138,6 +138,9 @@ class EvalBroker:
         self._deadlines: dict = {}   # eval id -> absolute monotonic deadline
         self._expired_drops = 0      # deadline-expired evals never delivered
         self._depth_sheds = 0        # enqueues refused by the hard bound
+        self._acks = 0               # deliveries acked (the control
+        #   plane's throughput gauge: depth / ack rate estimates queue
+        #   residence, the portable congestion signal)
         self._trace_enq: dict = {}   # eval id -> tracer-epoch ready time
         #   (obs/trace.py: the broker.wait span's t0; stamped per
         #    _enqueue_locked so nack redeliveries re-time their wait)
@@ -226,9 +229,13 @@ class EvalBroker:
                 # wait timers that would fire into a torn-down server.
                 return
             # Depth bound checked in the SAME critical section as the
-            # insert: concurrent enqueues cannot overshoot it.
-            if not force and self.max_depth is not None and \
-                    len(self._evals) >= self.max_depth:
+            # insert: concurrent enqueues cannot overshoot it.  The
+            # bound is re-read per enqueue — it is a LIVE control-plane
+            # knob (control/wiring.py moves it through a railed
+            # actuator).
+            limit = self.max_depth
+            if not force and limit is not None and \
+                    len(self._evals) >= limit:
                 self._depth_sheds += 1
                 shed = True
             else:
@@ -245,8 +252,7 @@ class EvalBroker:
                 else:
                     self._enqueue_locked(ev, ev.type)
         if shed:
-            raise ErrOverloaded(
-                f"eval broker at depth bound {self.max_depth}")
+            raise ErrOverloaded(f"eval broker at depth bound {limit}")
 
     def _enqueue_waiting(self, ev: Evaluation) -> None:
         with self._lock:
@@ -447,6 +453,7 @@ class EvalBroker:
             self._evals.pop(eval_id, None)
             self._job_evals.pop(job_id, None)
             self._trace_enq.pop(eval_id, None)
+            self._acks += 1
 
             blocked = self._blocked.get(job_id)
             if blocked and len(blocked):
@@ -484,4 +491,9 @@ class EvalBroker:
                 "by_scheduler": by_sched,
                 "expired_drops": self._expired_drops,
                 "depth_sheds": self._depth_sheds,
+                "acks": self._acks,
+                # The admission pressure source's inputs, exported so
+                # the control plane reads them as gauges.
+                "depth": len(self._evals),
+                "max_depth": self.max_depth or 0,
             }

@@ -233,6 +233,31 @@ class OverloadController:
         with self._lock:
             self._sources[name] = fn
 
+    def ratios(self) -> tuple:
+        """The live (brownout_ratio, overload_ratio) pair, read under
+        the lock (the control plane's actuators write them there)."""
+        with self._lock:
+            return self.brownout_ratio, self.overload_ratio
+
+    def set_ratios(self, brownout: Optional[float] = None,
+                   overload: Optional[float] = None) -> tuple:
+        """Adjust the entry thresholds LIVE (the feedback control
+        plane's actuator seam).  The constructor's invariant
+        ``0 < brownout_ratio <= overload_ratio`` is preserved by
+        clamping the untouched side, and the exit-edge hysteresis
+        scaling is untouched — the controller moves the thresholds,
+        never the enter/exit asymmetry.  Returns the applied pair."""
+        with self._lock:
+            new_over = self.overload_ratio if overload is None \
+                else float(overload)
+            new_brown = self.brownout_ratio if brownout is None \
+                else float(brownout)
+            new_over = max(new_over, 1e-6)
+            new_brown = min(max(new_brown, 1e-6), new_over)
+            self.brownout_ratio = new_brown
+            self.overload_ratio = new_over
+            return new_brown, new_over
+
     def force_state(self, state: Optional[str]) -> None:
         """Pin the state (tests, operator brownout drills); ``None``
         returns control to the pressure loop."""
@@ -368,6 +393,11 @@ class OverloadController:
                 "admitted": dict(self._admitted),
                 "heartbeat_lane": self._heartbeat_lane,
                 "transitions": self._transitions,
+                # The live entry thresholds: control-plane actuators
+                # move them (set_ratios), and the convergence benches
+                # read the trajectory from here.
+                "brownout_ratio": round(self.brownout_ratio, 4),
+                "overload_ratio": round(self.overload_ratio, 4),
             }
         # NOT _maybe_trip: the flight dump itself snapshots stats();
         # firing from here would recurse.  The state() path (every

@@ -511,6 +511,12 @@ class _Committer:
         with self._lock:
             return self._inflight == 0
 
+    def inflight(self) -> int:
+        """Queued + executing commit jobs — the control plane's
+        commit-pipeline occupancy gauge."""
+        with self._lock:
+            return self._inflight
+
     def wait_depth_below(self, n: int,
                          timeout: Optional[float] = None) -> None:
         end = None if timeout is None else time.monotonic() + timeout
@@ -636,6 +642,13 @@ class PlanApplier:
         # matched window occupancy.
         self.serial_seconds = 0.0
         self.serial_plans = 0
+        # Control-plane gauges: wall the applier spent blocked on a
+        # full commit pipeline (the max_inflight_commits AIMD's grow
+        # signal — sustained backpressure means more run-ahead would
+        # overlap more), and raft DISPATCH failures (its cut signal).
+        self.commit_backpressure_s = 0.0
+        self.dispatch_failures = 0
+        self.gather_wall_s = 0.0  # wall spent in the window gather
         # Set by a committer job whose raft DISPATCH failed (nothing
         # entered the log): the overlay folded that window's allocs
         # before hand-off, so the applier must serialize the pipeline
@@ -671,7 +684,8 @@ class PlanApplier:
             deq_wait = time.monotonic() - t_deq
             if pending is None:
                 return  # queue disabled: leadership lost
-            if self.gather_s > 0.0 and deq_wait < 0.002 and \
+            gather_s = self.gather_s  # re-read: a live control knob
+            if gather_s > 0.0 and deq_wait < 0.002 and \
                     (self.plan_queue.depth() > 0
                      or self.plan_queue.await_depth(1, 0.002) > 0):
                 # Two-phase adaptive gather.  This dequeue returned
@@ -684,8 +698,14 @@ class PlanApplier:
                 # resubmit loop pays at most the 2 ms probe (its plan
                 # is the one in flight, so nothing else can arrive),
                 # and an idle leader (blocking dequeues) pays nothing.
+                # The gather wall is booked: the control plane's gather
+                # driver shrinks a horizon that burns wall without
+                # buying occupancy (control/wiring.py).
+                t_gather = time.monotonic()
                 self.plan_queue.await_depth(self.max_window - 1,
-                                            self.gather_s)
+                                            gather_s)
+                with self._stats_lock:
+                    self.gather_wall_s += time.monotonic() - t_gather
             window = [pending]
             window += self.plan_queue.drain_pending(
                 self.max_window - 1,
@@ -957,10 +977,15 @@ class PlanApplier:
             # Bound the pipeline depth (backpressure excluded from the
             # serialized-section accounting: it IS the verify/apply
             # overlap), then hand off.
-            serial += time.perf_counter() - t_mark
+            t_bp = time.perf_counter()
+            serial += t_bp - t_mark
             self._committer.wait_depth_below(self.max_inflight_commits,
                                              timeout=60.0)
             t_mark = time.perf_counter()
+            with self._stats_lock:
+                # Backpressure wall (the wait above): the controller's
+                # grow signal for max_inflight_commits.
+                self.commit_backpressure_s += t_mark - t_bp
             try:
                 self._committer.submit(
                     lambda: self._commit_job(committers, alloc_lists,
@@ -1072,6 +1097,7 @@ class PlanApplier:
             # folds (the partitioned path folds before hand-off).
             with self._stats_lock:
                 self._dispatch_failed = True
+                self.dispatch_failures += 1
             for pending, _result in committers:
                 pending.respond(None, e)
             return None, 0.0
@@ -1152,7 +1178,21 @@ class PlanApplier:
             speedup_n = self._speedup_n
             serial_s = self.serial_seconds
             serial_plans = self.serial_plans
+            backpressure_s = self.commit_backpressure_s
+            dispatch_failures = self.dispatch_failures
+            gather_wall_s = self.gather_wall_s
         return {
+            "gather_wall_s": gather_wall_s,
+            # The live knob positions (the control plane's actuators
+            # move them; their gauges ride beside the counters so a
+            # trajectory is readable straight off the registry).
+            "max_window": self.max_window,
+            "max_inflight_commits": self.max_inflight_commits,
+            "gather_s": self.gather_s,
+            "deadline_horizon": self.deadline_horizon,
+            "commit_backpressure_s": backpressure_s,
+            "dispatch_failures": dispatch_failures,
+            "commit_inflight": self._committer.inflight(),
             "commits": commits,
             "plans_committed": plans,
             "batch_occupancy": plans / commits if commits else 0.0,

@@ -84,6 +84,8 @@ class PlanQueue:
         self.max_depth = max_depth
         self._depth_sheds = 0
         self._promotions = 0        # near-deadline plans pulled forward
+        self._enqueues = 0          # plans accepted (control-plane rate
+        #   gauge beside the broker's ack counter)
 
     def enabled(self) -> bool:
         with self._lock:
@@ -118,6 +120,7 @@ class PlanQueue:
                 heapq.heappush(self._dheap,
                                (plan.deadline, seq, future))
             self._n += 1
+            self._enqueues += 1
             self._cond.notify_all()
             return future
 
@@ -231,4 +234,6 @@ class PlanQueue:
         with self._lock:
             return {"depth": self._n,
                     "depth_sheds": self._depth_sheds,
-                    "deadline_promotions": self._promotions}
+                    "deadline_promotions": self._promotions,
+                    "enqueues": self._enqueues,
+                    "max_depth": self.max_depth or 0}

@@ -75,6 +75,14 @@ class ServerConfig:
         self.heartbeat_seed: Optional[int] = None  # seeded TTL jitter
         self.heartbeat_reconcile_rate: float = 32.0  # expiries/s pacing
         self.heartbeat_reconcile_burst: float = 8.0
+        # Feedback control plane (nomad_tpu/control): a seeded tick
+        # thread adjusting the live knobs above (broker depth limit,
+        # brownout/overload ratios, applier window/run-ahead/gather)
+        # from the metrics registry's gauges, inside hard rails.  Off
+        # by default: tuning is an opt-in behavior change.
+        self.control_enabled: bool = False
+        self.control_interval: float = 0.25
+        self.control_seed: int = 0
         self.enable_rpc: bool = False
         self.bind_addr: str = "127.0.0.1"
         self.rpc_port: int = 0      # 0 = ephemeral
@@ -281,6 +289,19 @@ class Server:
 
         self._setup_workers()
         self._setup_obs_registry()
+
+        # Feedback control plane (nomad_tpu/control): reads this
+        # server's registry gauges, adjusts the live knobs through
+        # railed actuators, and publishes its own decisions as the
+        # ``controller`` provider — so /v1/agent/metrics carries every
+        # knob position and reversal count.
+        self.controller = None
+        if self.config.control_enabled:
+            from nomad_tpu.control import server_controller
+            self.controller = server_controller(self)
+            self.obs_registry.register("controller",
+                                       self.controller.stats)
+            self.controller.start()
 
     def _setup_obs_registry(self) -> None:
         """The unified metrics registry (obs/registry.py): every
@@ -504,6 +525,8 @@ class Server:
         ``CrashHarness.reap()`` does the suite-hygiene joins later."""
         self._shutdown.set()
         self._leader = False
+        if self.controller is not None:
+            self.controller._stop.set()  # signal only: crashes don't join
         for w in self.workers:
             w.stop()
         # Pop workers/pollers out of their blocking waits; in-memory
@@ -537,6 +560,11 @@ class Server:
 
     def shutdown(self) -> None:
         self._shutdown.set()
+        # Controller first: no knob may move while the components it
+        # actuates are being torn down (its thread is joined here —
+        # the thread-lifecycle contract).
+        if self.controller is not None:
+            self.controller.stop()
         for w in self.workers:
             w.stop()
         self.revoke_leadership()

@@ -387,6 +387,200 @@ class TestFlightRecorder:
         assert flight.trip("breaker.open") is None
 
 
+class TestFlightRecorderEdges:
+    """ISSUE 14 satellite: the rate-limit window and max_files pruning
+    get direct edge-case coverage, and incident JSON carries the
+    controller's per-knob positions via the recorder-level extra_fn
+    hook."""
+
+    def test_same_reason_burst_rate_limits_per_reason(self, tmp_path):
+        clock = [100.0]
+        rec = flight.FlightRecorder(str(tmp_path), min_interval=5.0,
+                                    clock=lambda: clock[0])
+        # A burst of the SAME reason inside the window: one file.
+        assert rec.record("control.reversal") is not None
+        for _ in range(10):
+            assert rec.record("control.reversal") is None
+        # A different reason is a different window.
+        assert rec.record("control.rail") is not None
+        st = rec.stats()
+        assert st["trips"] == 2 and st["suppressed"] == 10
+        # The window is per-reason AND sliding: advancing past it
+        # re-arms exactly that reason.
+        clock[0] += 5.1
+        assert rec.record("control.reversal") is not None
+        assert rec.record("control.reversal") is None
+
+    def test_prune_order_under_mixed_reasons(self, tmp_path):
+        """max_files keeps the NEWEST incidents by sequence regardless
+        of reason interleaving (the zero-padded seq prefix IS the sort
+        key; a burst of reason-B files must evict old reason-A ones)."""
+        rec = flight.FlightRecorder(str(tmp_path), max_files=3,
+                                    min_interval=0.0)
+        reasons = ["overload.enter", "control.rail", "breaker.open",
+                   "control.reversal", "stall.applier.window"]
+        for reason in reasons:
+            assert rec.record(reason) is not None
+        names = rec.incidents()
+        assert len(names) == 3
+        assert [n.split("-")[1] for n in names] == \
+            ["0003", "0004", "0005"]
+        assert "breaker.open" in names[0]
+        assert "stall.applier.window" in names[-1]
+
+    def test_extra_fn_carries_controller_positions(self, tmp_path):
+        """Every incident — whatever tripped it — names where every
+        control knob sat, via the recorder's extra_fn hook (the
+        controller's positions() is the intended payload)."""
+        from nomad_tpu.control import AIMD, Actuator, Controller
+
+        ctl = Controller(lambda: {}, interval=0.05)
+        state = {"v": 6}
+        ctl.add_knob(
+            Actuator("pipeline.depth", get=lambda: state["v"],
+                     set=lambda v: state.__setitem__("v", v),
+                     lo=1, hi=16, integer=True),
+            law=AIMD(), driver=lambda view: 0)
+        rec = flight.install(str(tmp_path), extra_fn=ctl.positions)
+        try:
+            path = flight.trip("breaker.open", {"opens": 1})
+        finally:
+            flight.uninstall()
+        with open(path) as fh:
+            doc = json.load(fh)
+        assert doc["extra"]["opens"] == 1  # the trigger's extra kept
+        assert doc["extra"]["context"] == {"pipeline.depth": 6}
+
+    def test_broken_extra_fn_does_not_eat_the_incident(self, tmp_path):
+        def boom():
+            raise RuntimeError("context bug")
+        rec = flight.FlightRecorder(str(tmp_path), extra_fn=boom)
+        path = rec.record("breaker.open")
+        assert path is not None
+        with open(path) as fh:
+            assert "context" not in json.load(fh)["extra"]
+
+
+class TestRegistryCollect:
+    """ISSUE 14 satellite: collect() = snapshot() hardened for the
+    serving surface — per-provider age_s staleness stamps and a sample
+    deadline that isolates a hung provider instead of blocking the
+    whole collection."""
+
+    def test_age_stamps_track_value_changes(self):
+        clock = [50.0]
+        reg = MetricsRegistry(clock=lambda: clock[0])
+        live = [0]
+        reg.register("live", lambda: {"n": live[0]})
+        reg.register("frozen", lambda: {"n": 1})
+        reg.collect()
+        clock[0] += 10.0
+        live[0] += 1
+        out = reg.collect()
+        assert out["nomad.live.age_s"] == 0.0     # changed this sample
+        assert out["nomad.frozen.age_s"] == 10.0  # frozen for 10s
+        clock[0] += 5.0
+        out = reg.collect()
+        assert out["nomad.live.age_s"] == 5.0
+        assert out["nomad.frozen.age_s"] == 15.0
+
+    def test_hung_provider_isolated_by_sample_timeout(self):
+        reg = MetricsRegistry()
+        release = threading.Event()
+
+        def hung():
+            release.wait(30.0)
+            return {"late": 1}
+        reg.register("hung", hung)
+        reg.register("fine", lambda: {"ok": 1})
+        t0 = time.monotonic()
+        out = reg.collect(timeout=0.2)
+        try:
+            wall = time.monotonic() - t0
+            assert wall < 2.0  # the hang never blocks the collection
+            assert "timeout" in out["nomad.hung.error"]
+            assert out["nomad.fine.ok"] == 1
+            # The abandoned sampler's late result can never pollute a
+            # LATER collect (its queues died with it).
+            release.set()
+            out2 = reg.collect(timeout=1.0)
+            assert out2.get("nomad.hung.late") == 1
+            assert "nomad.hung.error" not in out2
+        finally:
+            release.set()
+            reg.clear()  # reaps the parked sampler thread
+
+    def test_erroring_provider_keeps_its_age_baseline(self):
+        clock = [10.0]
+        reg = MetricsRegistry(clock=lambda: clock[0])
+        fail = [False]
+
+        def flappy():
+            if fail[0]:
+                raise RuntimeError("torn down")
+            return {"n": 1}
+        reg.register("flappy", flappy)
+        reg.collect()
+        clock[0] += 3.0
+        fail[0] = True
+        out = reg.collect()
+        # The .error path still stamps how long the last good value
+        # has been standing.
+        assert "torn down" in out["nomad.flappy.error"]
+        assert out["nomad.flappy.age_s"] == 3.0
+
+    def test_error_path_races_replace_on_name(self):
+        """The erroring-provider path racing register() replacing the
+        same name: collection never raises, and once the replacement
+        lands its staleness clock starts fresh (the successor is not
+        blamed for the predecessor's errors)."""
+        clock = [0.0]
+        reg = MetricsRegistry(clock=lambda: clock[0])
+
+        def broken():
+            raise RuntimeError("always failing")
+        reg.register("racy", broken)
+        stop = threading.Event()
+        errors: list = []
+
+        def collector():
+            while not stop.is_set():
+                try:
+                    reg.collect()
+                except Exception as e:  # pragma: no cover
+                    errors.append(repr(e))
+
+        t = threading.Thread(target=collector, daemon=True)
+        t.start()
+        try:
+            for _ in range(50):
+                reg.register("racy", broken)
+                reg.register("racy", lambda: {"ok": 1})
+        finally:
+            stop.set()
+            t.join(5.0)
+        assert errors == []
+        # Replace-on-name resets the age baseline: a provider
+        # registered AFTER the collector stopped (so nothing sampled
+        # it yet) starts its staleness clock at its own first sample.
+        clock[0] = 7.0
+        reg.register("racy", lambda: {"ok": 1})
+        out = reg.collect()
+        assert out["nomad.racy.ok"] == 1
+        assert out["nomad.racy.age_s"] == 0.0
+
+    def test_collect_snapshot_parity_and_extra(self):
+        reg = MetricsRegistry()
+        reg.register("a", lambda: {"x": 1, "flag": True})
+        other = MetricsRegistry()
+        other.register("b", lambda: {"y": 2})
+        snap = reg.snapshot(extra=[other])
+        out = reg.collect(extra=[other])
+        for key, val in snap.items():
+            assert out[key] == val  # same grammar, plus age stamps
+        assert "nomad.a.age_s" in out and "nomad.b.age_s" in out
+
+
 # ---------------------------------------------------------------------------
 # 4. span trees on a live server
 # ---------------------------------------------------------------------------
@@ -586,6 +780,40 @@ class TestSpanTreesLiveServer:
                 ["-address", client.address, "metrics", "-filter",
                  "plan_queue"])
             assert rc == 0
+        finally:
+            agent.shutdown()
+
+    def test_metrics_watch_mode(self, capsys):
+        """ISSUE 14 satellite: `nomad-tpu metrics -watch N` re-samples
+        every N seconds and renders deltas (rates for counters) —
+        bounded here by -rounds; the substring filter rides to the
+        server as ?filter= so the polled payload stays small."""
+        from nomad_tpu.agent import Agent, AgentConfig
+        from nomad_tpu.api import APIClient
+        from nomad_tpu.cli.main import main as cli_main
+
+        agent = Agent(AgentConfig(server_enabled=True, http_port=0,
+                                  rpc_port=0))
+        try:
+            client = APIClient(
+                f"http://{agent.http.address[0]}:"
+                f"{agent.http.address[1]}")
+            # Server-side filter: only matching provider keys return.
+            doc = client.agent_metrics(filter="plan_queue")
+            assert doc["providers"]
+            assert all("plan_queue" in k for k in doc["providers"])
+
+            rc = cli_main(
+                ["-address", client.address, "metrics",
+                 "-watch", "0.05", "-rounds", "2",
+                 "-filter", "plan_queue"])
+            assert rc == 0
+            out = capsys.readouterr().out
+            # Round 1 prints the listing; later rounds print the delta
+            # header and per-key rates.
+            assert "nomad.plan_queue.depth = 0" in out
+            assert out.count("keys changed") == 2
+            assert "/s)" in out
         finally:
             agent.shutdown()
 
