@@ -20,6 +20,7 @@ Run on TPU (default backend); ``--quick`` shrinks for smoke runs.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import sys
@@ -324,30 +325,53 @@ def bench_pipelined_device_stream(h, jobs, depth: int, repeats: int = 3):
     row, executor forced to the device (NOMAD_TPU_EXECUTOR semantics
     via scheduler/executor.executor_override) through the staged
     pipeline — eval N's RTT hides behind evals N+1..N+depth's host
-    stages.  Returns (best_s, lats, placed, stage_times,
-    device_dispatches, total_dispatches)."""
+    stages.  The last rep runs under a HARD
+    ``jax.transfer_guard("disallow")`` for host->device: zero IMPLICIT
+    transfers on the hot path is asserted by that rep completing (the
+    transfer-discipline contract — every upload goes through the
+    explicit counted seams), and the explicit odometer
+    (parallel/devices.transfer_counts) yields the recorded
+    host_transfers_per_eval.  Returns (best_s, lats, placed,
+    stage_times, device_dispatches, total_dispatches,
+    transfers_per_eval)."""
+    import jax as _jax
+
+    from nomad_tpu.parallel.devices import transfer_counts
     from nomad_tpu.scheduler.executor import executor_override
     from nomad_tpu.scheduler.pipeline import PipelinedEvalRunner
 
     best, best_lats, best_stages, placed = float("inf"), [], {}, 0
     dev_n = total_n = 0
+    transfers_per_eval = 0.0
     with executor_override("device"):
-        for _ in range(repeats):
+        for rep in range(repeats):
             recorder = _RecordOnlyPlanner()
             snapshot = h.state.snapshot()
             runner = PipelinedEvalRunner(snapshot, recorder, depth=depth)
             evals = [make_eval(j) for j in jobs]
-            start = time.perf_counter()
-            runner.process(evals)
-            total = time.perf_counter() - start
+            guard = _jax.transfer_guard_host_to_device("disallow") \
+                if rep == repeats - 1 else contextlib.nullcontext()
+            t0 = transfer_counts()
+            with guard:
+                start = time.perf_counter()
+                runner.process(evals)
+                total = time.perf_counter() - start
+            t1 = transfer_counts()
             assert len(recorder.plans) == len(jobs)
+            if rep == repeats - 1:
+                # Every transfer this rep performed was explicit (the
+                # guard proved it) and counted — the honest per-eval
+                # h2d cost of the device hot path.
+                transfers_per_eval = (t1["h2d"] - t0["h2d"]) / \
+                    max(1, len(jobs))
             if total < best:
                 best, best_lats = total, runner.latencies
                 best_stages = dict(runner.stage_times)
                 placed = _placed(recorder)
                 dev_n = runner.device_dispatches
                 total_n = dev_n + runner.host_dispatches
-    return best, best_lats, placed, best_stages, dev_n, total_n
+    return (best, best_lats, placed, best_stages, dev_n, total_n,
+            transfers_per_eval)
 
 
 # Nominal HBM bandwidth used for the rough roofline line: TPU v5 lite
@@ -2223,9 +2247,9 @@ def main() -> None:
     device_depth = max(args.depth,
                        min(64, int(kernel_s / host_stage_s) + 2))
     bench_pipelined_device_stream(h4, jobs4, device_depth, 1)  # warm
-    pdev_s, pdev_lats, pdev_placed, pdev_stages, dev_n, total_n = \
-        bench_pipelined_device_stream(h4, jobs4, device_depth,
-                                      args.repeats)
+    (pdev_s, pdev_lats, pdev_placed, pdev_stages, dev_n, total_n,
+     pdev_transfers) = bench_pipelined_device_stream(
+        h4, jobs4, device_depth, args.repeats)
     host_placed = args.groups * len(jobs4)
     assert pdev_placed == host_placed, (pdev_placed, host_placed)
     assert dev_n == total_n == len(jobs4), (dev_n, total_n)
@@ -2251,6 +2275,13 @@ def main() -> None:
         "device_dispatch_share": round(dev_n / max(1, total_n), 3),
         "device_fraction": round(pdev_frac, 3),
         "device_occupancy_x": round(occupancy_x, 2),
+        # Transfer discipline (devlint / ISSUE 15): the final rep ran
+        # under jax.transfer_guard("disallow") for h2d — completing it
+        # IS the zero-implicit-transfer assertion on the hot path; the
+        # counted EXPLICIT uploads per eval (usage view + job counts +
+        # first-touch residency) are recorded beside it.
+        "host_transfers_per_eval": round(pdev_transfers, 2),
+        "implicit_transfers_hot_path": 0,
         "stage_times_ms": {k: round(v * 1000.0, 1)
                            for k, v in pdev_stages.items()},
         "note": ("same stream and plans as 4_binpack_10kn_x_1ktg with "

@@ -113,7 +113,7 @@ def run_lint(package_dir: Optional[str] = None,
     resolved vs dynamic) — the analyzer's own blind spots, surfaced in
     ``nomad-tpu lint --json`` instead of silent.
     """
-    from . import blocking, callgraph, jaxlint, lockcheck
+    from . import blocking, callgraph, devlint, jaxlint, lockcheck
 
     package_dir = package_dir or default_package_root()
     if not os.path.isdir(package_dir):
@@ -128,9 +128,17 @@ def run_lint(package_dir: Optional[str] = None,
     findings.extend(blocking.analyze_package(package_dir, graph=graph,
                                              scan=scan))
     findings.extend(jaxlint.analyze_package(package_dir))
+    dev_cov: dict = {}
+    findings.extend(devlint.analyze_package(package_dir, graph=graph,
+                                            scan=scan,
+                                            coverage_out=dev_cov))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     if coverage_out is not None:
         coverage_out.update(graph.coverage())
+        # The device-plane passes' own self-coverage (kernels found,
+        # operands judged placed vs host, transfer sites, hot-path
+        # closure size, marker-waived sites) rides the same JSON block.
+        coverage_out["devlint"] = dev_cov
     return findings
 
 

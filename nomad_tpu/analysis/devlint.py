@@ -1,0 +1,861 @@
+"""Device-plane lint: sharding propagation, transfer discipline,
+recompile provenance.
+
+The jax-binpack kernel is the repo's whole thesis, and since the fleet
+went sharded (PR 12) its failure modes are *placement* failures no
+behavioral test sees: a dispatch that bypasses the one mesh authority,
+a host operand silently committed into a sharded kernel (a per-eval
+implicit transfer), a device value concretized while a lock is held, or
+a jit call whose static args drift per call and retrace the kernel.
+Each degrades the 131k-node rows into transfer-bound or
+recompile-per-eval regimes quietly — the metastable-failure shape
+(PAPERS.md, Bronson et al.) — on hardware the test machine doesn't
+have.  Three passes ride the PR-4 interprocedural call graph:
+
+**Sharding propagation** — abstract-interprets placement through the
+device core.  Every jit kernel in the package is discovered (decorator
+and ``name = jax.jit(...)`` wrapper forms, ``*_sharded`` names classify
+the sharded family); at each resolved kernel call site, every operand
+is judged *placed* (derived from an explicit placement seam —
+``device_put`` / ``mesh._put`` / ``devices.put_counted`` /
+``ensure_on_default`` / ``ShardedResidency`` / the ``_dev_const``
+holders / ``shard_fleet_arrays`` — or another kernel's output) or
+*host*.  Rules:
+
+  - ``mesh-bypass``: an UNSHARDED kernel dispatched from a function
+    that never consulted ``dispatch_mesh`` — the dispatch silently
+    pins the whole fleet to one device no matter what mesh the
+    platform resolves.  (Kernel bodies and the kernel's own defining
+    module are exempt: jit-to-jit composition is traced code, not a
+    dispatch.)
+  - ``sharding-mix``: a host operand flowing into a SHARDED kernel —
+    GSPMD commits it with default placement, mixing shardings and
+    paying an implicit transfer on every call.  Wrapper functions'
+    parameters count as host (the wrapper IS the placement boundary).
+  - ``resident-bypass``: a raw ``jax.device_put`` outside the
+    sanctioned residency seams — an upload the transfer odometer and
+    the residency policy never see.
+
+**Transfer discipline** — classifies transfer sites: explicit
+placements (the device_put family), device->host concretizations
+(``np.asarray`` / ``float()`` / ``.item()`` / ``.tolist()`` /
+``device_get`` on device-tainted values), and implicit
+host-flows-into-kernel operands.  Two rules intersect them with
+context:
+
+  - ``transfer-under-lock``: a transfer site (or a call chain reaching
+    one) inside a held-lock region — every other thread queues behind
+    a PCIe/ICI round trip (the lock machinery is shared with
+    blocking.py, same ``Qual[Lock.site]`` key grammar).
+  - ``transfer-in-hot-loop``: an IMPLICIT transfer (host kernel
+    operand, or an unsanctioned tainted concretize) reachable from the
+    pipeline/applier hot paths — the per-eval cost that turns the
+    stream transfer-bound.  The sanctioned collect seams
+    (``fetch_results`` / ``collect_device``) stay open; explicit
+    counted placements are the *fix*, not a finding.
+
+**Recompile provenance** — makes the runtime recompile sentinel static:
+
+  - ``recompile-churn``: a kernel call site whose static args derive
+    from per-call-varying values (``len()`` arithmetic with no
+    bucketing through ``_pad_to``/``pad_lanes``/``bit_length``
+    rounding), an array constructor with an unbucketed dynamic shape
+    feeding a kernel, or a dtype-less constructor feeding a kernel
+    (dtype drift = a new trace signature per ambient default).
+
+Deliberate exceptions carry an inline justification marker on (or one
+line above) the site — ``# devlint-ok(<rule>): <why>`` — the same
+reviewed-waiver pattern as the test tree's ``# sleep-ok:``; markers
+with no justification text do not waive.  Waived sites are counted in
+the coverage block (``nomad-tpu lint -json`` → ``coverage.devlint``)
+so the ledger stays visible.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from typing import Optional
+
+from . import Finding
+from .callgraph import CallGraph, _self_attr
+from . import blocking, lockcheck
+from .jaxlint import _dotted, _is_jax_jit, _static_names_from_call
+
+# -- placement seams --------------------------------------------------------
+
+# Call names (function or method, last segment) whose RESULT is a
+# device-resident value: the explicit placement seams plus the resident
+# cache getters.  The abstract interpretation of "placed" starts here.
+PRODUCERS = frozenset({
+    "device_put", "_put", "ensure_on_default", "put_counted",
+    "shard_fleet_arrays",
+    "device_capacity_reserved", "device_capacity_reserved_sharded",
+    "device_feasible_sharded", "device_usage", "device_usage_sharded",
+    "dispatch_usage", "_dev_const", "_dev_const_repl",
+})
+
+# Receiver-qualified producers: `<something sharded>.prepare/install/
+# lookup` (ShardedResidency) — "prepare"/"install" alone are too
+# generic to trust on arbitrary receivers.
+_SHARDED_RES_METHODS = frozenset({"prepare", "install", "lookup"})
+
+# Functions allowed to call jax.device_put directly (the seams
+# themselves).  Quals starting with "ShardedResidency." are also
+# sanctioned.
+RESIDENT_SEAMS = frozenset({
+    "_put", "ensure_on_default", "put_counted", "_scatter_rows",
+})
+
+# Sanctioned device->host collect seams: the deliberate fetch points
+# whose concretizations are the design, not a finding.
+D2H_SEAMS = frozenset({"fetch_results", "fetch_host", "collect_device"})
+
+# Shape-bucketing helpers: a value routed through one of these is
+# stable across calls (power-of-two buckets).
+BUCKETING = frozenset({"_pad_to", "pad_lanes"})
+
+# Hot-path roots (qualname last segment): the pipeline/batch dispatch
+# and drain stages plus the applier's window verify — the per-eval
+# loops where an implicit transfer is paid per eval.
+HOT_SUFFIXES = frozenset({
+    "dispatch_device", "_dispatch_device_sharded", "_drain_window",
+    "_collect_item", "_process_staged", "_drain_loop", "_finish_lanes",
+    "_run_single", "_process", "_apply_window", "evaluate_window",
+    "_prepare_device", "finish_deferred", "_submit_window",
+})
+
+_ARRAY_CTORS = frozenset({"zeros", "ones", "empty", "full", "asarray",
+                          "array", "arange"})
+_CONCRETIZE_FUNCS = frozenset({"float", "int", "bool"})
+_CONCRETIZE_METHODS = frozenset({"item", "tolist"})
+
+_MARKER_RE = re.compile(r"#\s*devlint-ok\((?P<rule>[a-z-]+)\)\s*:\s*\S")
+
+
+class Kernel:
+    """One jit-wrapped callable discovered in the package."""
+
+    __slots__ = ("fn_key", "names", "static", "sharded", "module",
+                 "params", "line")
+
+    def __init__(self, fn_key: str, module: str, params: list,
+                 line: int) -> None:
+        self.fn_key = fn_key          # FuncNode key of the traced body
+        self.names: set = set()       # binding/def names callers use
+        self.static: set = set()      # static_argnames (param names)
+        self.sharded = False
+        self.module = module
+        self.params = params          # positional param names, in order
+        self.line = line
+
+
+def _find_kernels(graph: CallGraph) -> dict:
+    """fn_key -> Kernel for every jit root in the package (decorator
+    AND wrapper form, vmap/partial unwrapped)."""
+    kernels: dict = {}
+
+    def ensure(module: str, fn_name: str, fn_node, line: int) -> Kernel:
+        key = f"{module}:{fn_name}"
+        k = kernels.get(key)
+        if k is None:
+            params = [a.arg for a in fn_node.args.args]
+            k = kernels[key] = Kernel(key, module, params, line)
+            k.names.add(fn_name)
+        return k
+
+    for module, info in graph.modules.items():
+        fns = {}
+        for node in ast.walk(info.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fns.setdefault(node.name, node)
+        for node in ast.walk(info.tree):
+            if isinstance(node, ast.FunctionDef):
+                for deco in node.decorator_list:
+                    call = deco if isinstance(deco, ast.Call) else None
+                    target = call.func if call else deco
+                    inner = None
+                    if _is_jax_jit(target):
+                        inner = node
+                    elif call is not None and _dotted(call.func) in (
+                            ("partial",), ("functools", "partial")) and \
+                            call.args and _is_jax_jit(call.args[0]):
+                        inner = node
+                    if inner is None:
+                        continue
+                    k = ensure(module, node.name, node, node.lineno)
+                    if call is not None:
+                        k.static |= _static_names_from_call(call, node)
+            elif isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    _is_jax_jit(node.value.func):
+                jit_call = node.value
+                fn_node = _unwrap(fns, jit_call.args[0]) \
+                    if jit_call.args else None
+                if fn_node is None:
+                    continue
+                k = ensure(module, fn_node.name, fn_node, node.lineno)
+                k.static |= _static_names_from_call(jit_call, fn_node)
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        k.names.add(tgt.id)
+    for k in kernels.values():
+        k.sharded = any("sharded" in n for n in k.names)
+    return kernels
+
+
+def _unwrap(fns: dict, expr: ast.expr) -> Optional[ast.FunctionDef]:
+    for _ in range(6):
+        if isinstance(expr, ast.Name):
+            return fns.get(expr.id)
+        if isinstance(expr, ast.Call):
+            d = _dotted(expr.func)
+            if d and d[-1] in ("vmap", "partial", "pmap", "shard_map",
+                               "checkpoint", "remat", "grad") and \
+                    expr.args:
+                expr = expr.args[0]
+                continue
+            return None
+        return None
+    return None
+
+
+# -- markers ----------------------------------------------------------------
+
+def _load_markers(package_dir: str, rels) -> dict:
+    """(rel, line) -> {rule, ...} for every justified devlint-ok marker."""
+    base = os.path.dirname(os.path.abspath(package_dir))
+    out: dict = {}
+    for rel in rels:
+        path = os.path.join(base, rel)
+        try:
+            with open(path) as fh:
+                lines = fh.read().splitlines()
+        except OSError:
+            continue
+        for i, text in enumerate(lines, 1):
+            for m in _MARKER_RE.finditer(text):
+                rule = m.group("rule")
+                out.setdefault((rel, i), set()).add(rule)
+                if not text.lstrip().startswith("#"):
+                    # Inline marker (trailing comment on a code line):
+                    # it waives THAT line only — never the statement
+                    # below it.
+                    continue
+                # Comment-line marker: waive the continuation comment
+                # lines directly below it and the first code line the
+                # block lands on (a wrapped justification still covers
+                # its site); a blank line ends the block unattached.
+                j = i + 1
+                while j <= len(lines) and \
+                        lines[j - 1].lstrip().startswith("#"):
+                    out.setdefault((rel, j), set()).add(rule)
+                    j += 1
+                if j <= len(lines) and lines[j - 1].strip():
+                    out.setdefault((rel, j), set()).add(rule)
+    return out
+
+
+def _waived(markers: dict, rel: str, line: int, rule: str) -> bool:
+    # Exact-line only: _load_markers already propagated each marker
+    # down its comment block onto the first code line, so checking
+    # line-1 here would ALSO waive the statement after the waived one
+    # (a real defect hiding directly beneath any marker).
+    return rule in markers.get((rel, line), ())
+
+
+# -- per-function local classification --------------------------------------
+
+class _Locals:
+    """Best-effort forward classification of a function's locals:
+    which names hold device-placed values, device-tainted values, and
+    per-call-varying ("unstable") sizes; plus array-constructor sites.
+    Branch-insensitive by design (any producer assignment marks the
+    name placed) — the misses are counted, not silent."""
+
+    __slots__ = ("placed", "tainted", "unstable", "ctors")
+
+    def __init__(self) -> None:
+        self.placed: set = set()
+        self.tainted: set = set()
+        self.unstable: set = set()
+        # name -> (line, has_dtype, unstable_shape)
+        self.ctors: dict = {}
+
+
+def _producer_call(node: ast.Call, kernels_by_name: dict) -> bool:
+    fn = node.func
+    name = None
+    if isinstance(fn, ast.Name):
+        name = fn.id
+    elif isinstance(fn, ast.Attribute):
+        name = fn.attr
+        if name in _SHARDED_RES_METHODS:
+            try:
+                owner = ast.unparse(fn.value)
+            except Exception:
+                owner = ""
+            return "sharded" in owner
+    if name is None:
+        return False
+    if name in PRODUCERS:
+        return True
+    return name in kernels_by_name
+
+
+def _is_bucketed(expr: ast.expr) -> bool:
+    """``_pad_to(x)`` / ``pad_lanes(x)`` / ``1 << (...).bit_length()``
+    / min/max compositions of those."""
+    if isinstance(expr, ast.Call):
+        d = _dotted(expr.func)
+        if d and d[-1] in BUCKETING:
+            return True
+        if d and d[-1] in ("min", "max"):
+            return True  # min/max over stable inputs stays bounded
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.LShift):
+        return True
+    return False
+
+
+def _scan_locals(fn_node, kernels_by_name: dict) -> _Locals:
+    st = _Locals()
+
+    def unstable_expr(expr) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in st.unstable
+        if isinstance(expr, ast.Call):
+            d = _dotted(expr.func)
+            if d == ("len",) or (d and d[-1] == "sum"):
+                return True
+            return False
+        if isinstance(expr, ast.BinOp):
+            return unstable_expr(expr.left) or unstable_expr(expr.right)
+        if isinstance(expr, ast.UnaryOp):
+            return unstable_expr(expr.operand)
+        return False
+
+    def classify(target, value, lineno) -> None:
+        names = []
+        if isinstance(target, ast.Name):
+            names = [target.id]
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            names = [el.id for el in target.elts
+                     if isinstance(el, ast.Name)]
+        elif isinstance(target, ast.Subscript) and \
+                isinstance(target.value, ast.Name):
+            # holder[i] = producer(...) marks the holder placed
+            # (the dev_const / feasibility [host, device] patterns).
+            if isinstance(value, ast.Call) and \
+                    _producer_call(value, kernels_by_name):
+                st.placed.add(target.value.id)
+            return
+        if not names:
+            return
+        if isinstance(value, ast.Call):
+            d = _dotted(value.func)
+            if _producer_call(value, kernels_by_name):
+                for n in names:
+                    st.placed.add(n)
+                    st.tainted.add(n)
+                return
+            if d and len(d) >= 2 and d[0] in ("np", "numpy") and \
+                    d[-1] in _ARRAY_CTORS:
+                has_dtype = any(kw.arg == "dtype"
+                                for kw in value.keywords)
+                shape_unstable = False
+                if value.args:
+                    shape = value.args[0]
+                    elts = shape.elts if isinstance(
+                        shape, (ast.Tuple, ast.List)) else [shape]
+                    shape_unstable = any(unstable_expr(e) for e in elts)
+                for n in names:
+                    st.ctors[n] = (lineno, has_dtype, shape_unstable)
+                    st.placed.discard(n)
+                return
+        if _is_bucketed(value):
+            for n in names:
+                st.unstable.discard(n)
+            return
+        if unstable_expr(value):
+            for n in names:
+                st.unstable.add(n)
+            return
+        # Plain rebinding propagates placement/taint (x = holder[1],
+        # y = x): the two-pass walk stabilizes chains.
+        if _expr_placed(value, st, kernels_by_name):
+            for n in names:
+                st.placed.add(n)
+        if _expr_tainted(value, st, kernels_by_name):
+            for n in names:
+                st.tainted.add(n)
+
+    # Two passes so loop-carried classifications stabilize.
+    for _ in range(2):
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    classify(tgt, node.value, node.lineno)
+            elif isinstance(node, ast.AnnAssign) and \
+                    node.value is not None:
+                classify(node.target, node.value, node.lineno)
+            elif isinstance(node, ast.AugAssign):
+                if isinstance(node.target, ast.Name) and \
+                        unstable_expr(node.value):
+                    st.unstable.add(node.target.id)
+    return st
+
+
+def _expr_placed(expr, st: _Locals, kernels_by_name: dict) -> bool:
+    """Is this call-site operand derived from an explicit placement?"""
+    if isinstance(expr, ast.Name):
+        return expr.id in st.placed
+    if isinstance(expr, ast.Attribute):
+        if expr.attr.endswith("_d") or expr.attr.endswith("_device") or \
+                expr.attr == "usage_device":
+            return True
+        return _expr_placed(expr.value, st, kernels_by_name)
+    if isinstance(expr, ast.Subscript):
+        return _expr_placed(expr.value, st, kernels_by_name)
+    if isinstance(expr, ast.Call):
+        return _producer_call(expr, kernels_by_name)
+    if isinstance(expr, ast.Starred):
+        return _expr_placed(expr.value, st, kernels_by_name)
+    return False
+
+
+def _expr_tainted(expr, st: _Locals, kernels_by_name: dict) -> bool:
+    """Does this expression carry a device value (a concretization of
+    it is a device->host transfer)?"""
+    if isinstance(expr, ast.Name):
+        return expr.id in st.tainted
+    if isinstance(expr, (ast.Attribute, ast.Subscript, ast.Starred)):
+        return _expr_tainted(expr.value, st, kernels_by_name)
+    if isinstance(expr, ast.Call):
+        return _producer_call(expr, kernels_by_name)
+    return False
+
+
+# -- the region walk --------------------------------------------------------
+
+class _DevRecord:
+    __slots__ = ("key", "qual", "rel", "transfers", "kernel_calls",
+                 "calls", "consults_mesh", "is_kernel", "d2h_sites")
+
+    def __init__(self, key: str, qual: str, rel: str) -> None:
+        self.key = key
+        self.qual = qual
+        self.rel = rel
+        # (held, kind, line, text): kind in {"put", "implicit-h2d",
+        # "d2h"} — the transfer sites, with held-lock context.
+        self.transfers: list = []
+        # (held, Kernel, ast.Call, line)
+        self.kernel_calls: list = []
+        # (held, callee_key, line, text) — resolved intra calls.
+        self.calls: list = []
+        self.consults_mesh = False
+        self.is_kernel = False
+        self.d2h_sites: list = []   # (held, line, text, implicit)
+
+
+class _DevVisitor(blocking._RegionVisitor):
+    """blocking's held-lock region walk, extended to record the
+    device-plane events (kernel dispatches, placements, concretize
+    sites) alongside the parent's lock bookkeeping."""
+
+    def __init__(self, graph, pkg, info, cls_info, region, fn_node,
+                 dev: _DevRecord, st: _Locals, kernels: dict,
+                 kernels_by_name: dict) -> None:
+        super().__init__(graph, pkg, info, cls_info, region, fn_node)
+        self.dev = dev
+        self.st = st
+        self.kernels = kernels
+        self.kernels_by_name = kernels_by_name
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self._classify_dev(node)
+        super().visit_Call(node)
+
+    def _classify_dev(self, node: ast.Call) -> None:
+        held = tuple(self.stack)
+        dev = self.dev
+        d = _dotted(node.func)
+        text = ""
+        try:
+            text = ast.unparse(node.func)
+        except Exception:
+            pass
+
+        if d and d[-1] == "dispatch_mesh":
+            dev.consults_mesh = True
+
+        # Explicit placement family (the device_put side).
+        if d and d[-1] == "device_put":
+            dev.transfers.append((held, "put", node.lineno, text))
+            return
+        if d and d[-1] in ("_put", "ensure_on_default", "put_counted"):
+            dev.transfers.append((held, "put", node.lineno, text))
+            return
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("prepare", "install"):
+            try:
+                owner = ast.unparse(node.func.value)
+            except Exception:
+                owner = ""
+            if "sharded" in owner:
+                dev.transfers.append((held, "put", node.lineno, text))
+                return
+
+        # Device->host concretizations.
+        if d and d[-1] in ("device_get", "fetch_host"):
+            dev.transfers.append((held, "d2h", node.lineno, text))
+            dev.d2h_sites.append((held, node.lineno, text, False))
+            return
+        if d and len(d) >= 2 and d[0] in ("np", "numpy") and \
+                d[-1] in ("asarray", "array") and node.args and \
+                _expr_tainted(node.args[0], self.st,
+                              self.kernels_by_name):
+            dev.transfers.append((held, "d2h", node.lineno, text))
+            dev.d2h_sites.append((held, node.lineno, text, True))
+            return
+        if d and len(d) == 1 and d[0] in _CONCRETIZE_FUNCS and \
+                node.args and _expr_tainted(node.args[0], self.st,
+                                            self.kernels_by_name):
+            dev.transfers.append((held, "d2h", node.lineno, text))
+            dev.d2h_sites.append((held, node.lineno, text, True))
+            return
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _CONCRETIZE_METHODS and \
+                _expr_tainted(node.func.value, self.st,
+                              self.kernels_by_name):
+            dev.transfers.append((held, "d2h", node.lineno, text))
+            dev.d2h_sites.append((held, node.lineno, text, True))
+            return
+
+        # Kernel dispatches.
+        callee, kind = self.graph.resolve_call(
+            self.info, self.cls_key, self.local_types, node.func)
+        if kind == "intra" and callee in self.kernels:
+            dev.kernel_calls.append((held, self.kernels[callee], node,
+                                     node.lineno))
+            return
+        # Unresolved bare-name kernel call (synthetic packages, local
+        # aliases): fall back to the name table.
+        name = d[-1] if d else None
+        if name in self.kernels_by_name and (kind != "intra"):
+            dev.kernel_calls.append(
+                (held, self.kernels_by_name[name], node, node.lineno))
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+def analyze_package(package_dir: str, graph: Optional[CallGraph] = None,
+                    scan=None, coverage_out: Optional[dict] = None
+                    ) -> list:
+    if graph is None:
+        graph = CallGraph.build(package_dir)
+    pkg, _trees, err = scan or lockcheck.scan_package(package_dir)
+    if err is not None:
+        return []  # lockcheck already reports the parse error
+    kernels = _find_kernels(graph)
+    kernels_by_name: dict = {}
+    for k in kernels.values():
+        for n in k.names:
+            kernels_by_name[n] = k
+    kernel_fn_keys = set(kernels)
+
+    cls_infos = {}
+    for info in pkg.classes:
+        cls_infos[(info.module, info.name)] = info
+
+    markers = _load_markers(
+        package_dir, {fn.rel for fn in graph.functions.values()})
+
+    cov = {"kernels": len(kernels), "kernel_call_sites": 0,
+           "placed_args": 0, "host_args": 0, "transfer_sites": 0,
+           "hot_functions": 0, "waived": 0}
+
+    records: dict = {}
+    locals_of: dict = {}
+    for key, fn in graph.functions.items():
+        info = graph.modules.get(fn.module)
+        if info is None:
+            continue
+        cls_info = cls_infos.get((fn.module, fn.cls)) if fn.cls else None
+        dev = _DevRecord(key, fn.qual, fn.rel)
+        dev.is_kernel = key in kernel_fn_keys
+        st = _scan_locals(fn.node, kernels_by_name)
+        region = blocking._Region(key, fn.qual, fn.rel)
+        _DevVisitor(graph, pkg, info, cls_info, region, fn.node, dev,
+                    st, kernels, kernels_by_name).run()
+        dev.calls = region.calls
+        records[key] = dev
+        locals_of[key] = st
+
+    findings: list = []
+    # Waived SITES, deduped (rel, line, rule): one reviewed marker is
+    # one ledger entry no matter how many passes or caller chains
+    # touch it.
+    waived_sites: set = set()
+
+    def emit(rule, rel, where, msg, line):
+        if _waived(markers, rel, line, rule):
+            waived_sites.add((rel, line, rule))
+            return
+        findings.append(Finding(rule, rel, where, msg, line))
+
+    def judge_args(kernel: Kernel, call: ast.Call, st: _Locals) -> list:
+        """[(param_name, arg_expr, placed)] for every non-static
+        operand of one kernel call (positional by index, keyword by
+        name)."""
+        out = []
+        for pos, arg in enumerate(call.args):
+            pname = kernel.params[pos] if pos < len(kernel.params) \
+                else f"arg{pos}"
+            if pname in kernel.static:
+                continue
+            out.append((pname, arg,
+                        _expr_placed(arg, st, kernels_by_name)))
+        for kw in call.keywords:
+            if kw.arg is None or kw.arg in kernel.static:
+                continue
+            out.append((kw.arg, kw.value,
+                        _expr_placed(kw.value, st, kernels_by_name)))
+        return out
+
+    # -- pass 1: sharding propagation ----------------------------------
+    for key, dev in records.items():
+        if dev.is_kernel:
+            continue  # traced code: jit-to-jit composition, not dispatch
+        st = locals_of[key]
+        for held, kernel, call, line in dev.kernel_calls:
+            cov["kernel_call_sites"] += 1
+            fn = graph.functions[key]
+            in_def_module = fn.module == kernel.module
+            # Per-operand placement judgment (skipping static args).
+            host_args = []
+            for pname, arg, placed in judge_args(kernel, call, st):
+                if placed:
+                    cov["placed_args"] += 1
+                else:
+                    cov["host_args"] += 1
+                    host_args.append((pname, arg))
+
+            if kernel.sharded:
+                for pname, arg in host_args:
+                    try:
+                        a_text = ast.unparse(arg)
+                    except Exception:
+                        a_text = pname
+                    emit("sharding-mix", dev.rel,
+                         f"{dev.qual}.{pname}",
+                         f"host operand `{a_text}` flows into sharded "
+                         f"kernel call (param `{pname}`): GSPMD commits "
+                         "it unsharded — route it through mesh._put / "
+                         "the dev_const holders", line)
+            elif not in_def_module:
+                if not dev.consults_mesh:
+                    kname = sorted(kernel.names)[0]
+                    emit("mesh-bypass", dev.rel,
+                         f"{dev.qual}.{kname}",
+                         f"dispatches unsharded kernel `{kname}` "
+                         "without consulting parallel/mesh."
+                         "dispatch_mesh — on a multi-device platform "
+                         "this silently pins the fleet to one device",
+                         line)
+
+    # resident-bypass: raw device_put outside the seams.
+    for key, dev in records.items():
+        qual_last = dev.qual.split(".")[-1]
+        sanctioned = qual_last in RESIDENT_SEAMS or \
+            dev.qual.startswith("ShardedResidency.") or dev.is_kernel
+        if sanctioned:
+            continue
+        for held, kind, line, text in dev.transfers:
+            if kind == "put" and text.endswith("device_put"):
+                emit("resident-bypass", dev.rel, dev.qual,
+                     "raw jax.device_put outside the residency seams "
+                     "(mesh._put / devices.put_counted / "
+                     "ensure_on_default / ShardedResidency): the "
+                     "upload bypasses the transfer odometer and the "
+                     "residency policy", line)
+
+    # -- pass 2: transfer discipline -----------------------------------
+    # Count transfer sites; waive marker-justified roots out of the
+    # may-transfer chains so a justified site doesn't flag its callers.
+    chains: dict = {}
+    for key, dev in records.items():
+        cov["transfer_sites"] += len(dev.transfers)
+        live_roots = []
+        for held, kind, line, text in dev.transfers:
+            if _waived(markers, dev.rel, line, "transfer-under-lock"):
+                waived_sites.add((dev.rel, line, "transfer-under-lock"))
+            else:
+                live_roots.append((held, kind, line, text))
+        if live_roots:
+            held, kind, line, text = live_roots[0]
+            chains[key] = [(f"{text or kind} [{kind}]", dev.rel, line)]
+    changed = True
+    while changed:
+        changed = False
+        for key, dev in records.items():
+            for held, callee, line, text in dev.calls:
+                if callee is None or callee not in chains:
+                    continue
+                cand = [(text or callee, dev.rel, line)] + chains[callee]
+                if key not in chains or len(cand) < len(chains[key]):
+                    chains[key] = cand
+                    changed = True
+
+    seen: set = set()
+    for key, dev in records.items():
+        if dev.is_kernel:
+            continue
+        for held, kind, line, text in dev.transfers:
+            if not held:
+                continue
+            innermost = held[-1]
+            # Dedup is line-qualified: two same-shaped sites under one
+            # lock are separate findings, so a marker waiving the first
+            # can never swallow the second.
+            fkey = (dev.qual, innermost, kind, text, line)
+            if fkey in seen:
+                continue
+            seen.add(fkey)
+            emit("transfer-under-lock", dev.rel,
+                 f"{dev.qual}[{innermost}]",
+                 f"holds {innermost} across a device transfer "
+                 f"({text or kind}): every other thread queues behind "
+                 "the copy — upload outside the lock and revalidate",
+                 line)
+        for held, callee, line, text in dev.calls:
+            if not held or callee is None:
+                continue
+            chain = chains.get(callee)
+            if chain is None:
+                continue
+            waived_step = next(
+                ((rel, ln) for _txt, rel, ln in chain
+                 if _waived(markers, rel, ln, "transfer-under-lock")),
+                None)
+            if waived_step is not None:
+                waived_sites.add(waived_step +
+                                 ("transfer-under-lock",))
+                continue
+            innermost = held[-1]
+            fkey = (dev.qual, innermost, callee)
+            if fkey in seen:
+                continue
+            seen.add(fkey)
+            emit("transfer-under-lock", dev.rel,
+                 f"{dev.qual}[{innermost}]",
+                 f"holds {innermost} across a call chain that "
+                 f"transfers: {text or callee} -> " +
+                 " -> ".join(s[0] for s in chain), line)
+
+    # Hot-path reachability (BFS over resolved intra calls).
+    hot: set = set()
+    frontier = [key for key, dev in records.items()
+                if dev.qual.split(".")[-1] in HOT_SUFFIXES]
+    while frontier:
+        key = frontier.pop()
+        if key in hot:
+            continue
+        hot.add(key)
+        dev = records.get(key)
+        if dev is None:
+            continue
+        for _held, callee, _line, _text in dev.calls:
+            if callee is not None and callee in records and \
+                    callee not in hot:
+                frontier.append(callee)
+    cov["hot_functions"] = len(hot)
+
+    for key in hot:
+        dev = records[key]
+        if dev.is_kernel:
+            continue
+        qual_last = dev.qual.split(".")[-1]
+        st = locals_of[key]
+        # Implicit host operands into kernels on the hot path.
+        for held, kernel, call, line in dev.kernel_calls:
+            if kernel.sharded:
+                continue  # pass 1 owns the sharded family
+            for pname, arg, placed in judge_args(kernel, call, st):
+                if placed:
+                    continue
+                try:
+                    a_text = ast.unparse(arg)
+                except Exception:
+                    a_text = pname
+                emit("transfer-in-hot-loop", dev.rel,
+                     f"{dev.qual}.{pname}",
+                     f"host operand `{a_text}` is committed "
+                     "implicitly by jit on the per-eval hot path — "
+                     "place it explicitly (devices.put_counted / "
+                     "the dev_const holders) so the transfer is "
+                     "counted and guard-safe", line)
+        # Unsanctioned tainted concretizations on the hot path.
+        if qual_last not in D2H_SEAMS:
+            for held, line, text, implicit in dev.d2h_sites:
+                if not implicit:
+                    continue  # explicit device_get: disciplined
+                emit("transfer-in-hot-loop", dev.rel,
+                     f"{dev.qual}.{text or 'concretize'}",
+                     f"implicit device->host concretization "
+                     f"({text}) on the per-eval hot path — fetch "
+                     "through the collect seams "
+                     "(fetch_results/devices.fetch_host)", line)
+
+    # -- pass 3: recompile provenance ----------------------------------
+    for key, dev in records.items():
+        if dev.is_kernel:
+            continue
+        st = locals_of[key]
+        for held, kernel, call, line in dev.kernel_calls:
+            # (a) static args must be call-stable.
+            for kw in call.keywords:
+                if kw.arg not in kernel.static:
+                    continue
+                v = kw.value
+                if isinstance(v, ast.Constant):
+                    continue
+                if isinstance(v, ast.Name) and v.id in st.unstable:
+                    emit("recompile-churn", dev.rel,
+                         f"{dev.qual}.{kw.arg}",
+                         f"static arg `{kw.arg}={v.id}` derives from a "
+                         "per-call-varying value with no bucketing "
+                         "(_pad_to / pad_lanes / bit_length rounding): "
+                         "every new value is a full XLA retrace", line)
+            # (b) array operands with unbucketed dynamic shapes or
+            # missing dtype feeding the kernel.
+            for arg in list(call.args) + [kw.value
+                                          for kw in call.keywords]:
+                if not isinstance(arg, ast.Name):
+                    continue
+                ctor = st.ctors.get(arg.id)
+                if ctor is None:
+                    continue
+                ctor_line, has_dtype, shape_unstable = ctor
+                if shape_unstable:
+                    emit("recompile-churn", dev.rel,
+                         f"{dev.qual}.{arg.id}",
+                         f"kernel operand `{arg.id}` is constructed "
+                         "with a per-call-varying shape (len-derived, "
+                         "unbucketed): each distinct size retraces the "
+                         "kernel — bucket it (_pad_to / pad_lanes)",
+                         ctor_line)
+                elif not has_dtype:
+                    emit("recompile-churn", dev.rel,
+                         f"{dev.qual}.{arg.id}",
+                         f"kernel operand `{arg.id}` is constructed "
+                         "without an explicit dtype: the ambient "
+                         "default (float64 vs float32) silently forks "
+                         "the trace signature", ctor_line)
+
+    cov["waived"] = len(waived_sites)
+    if coverage_out is not None:
+        coverage_out.update(cov)
+    return findings

@@ -21,7 +21,18 @@ costs a full XLA compile, and a kernel whose shapes aren't properly
 bucketed erodes the bench headline without failing a single behavioral
 test.
 
-Both are opt-in via install()/uninstall() and wired into the test suite
+**TransferGuardSanitizer** — wraps the scheduler's device-dispatch
+seams in ``jax.transfer_guard_host_to_device("disallow")`` scopes: any
+IMPLICIT host->device transfer on a dispatch path (a host array or
+scalar silently committed by jit) raises inside the test that caused
+it.  This is the runtime twin of devlint's transfer-discipline pass:
+the discipline says every intended transfer is explicit (`device_put`
+through the counted seams — devices.put_counted / mesh._put /
+ShardedResidency), so the guard can reject everything implicit without
+false positives.  Direct kernel calls outside the scheduler seams
+(parity tests feeding host arrays on purpose) are unaffected.
+
+All are opt-in via install()/uninstall() and wired into the test suite
 by tests/test_static_analysis.py (and conftest, env-gated) — see
 README "Static analysis & sanitizers".
 """
@@ -254,6 +265,88 @@ def _cache_size(jitted) -> Optional[int]:
             except Exception:
                 return None
     return None
+
+
+# ---------------------------------------------------------------------------
+# Transfer-guard sanitizer
+# ---------------------------------------------------------------------------
+
+# The dispatch seams the guard wraps: every scheduler-driven device
+# dispatch flows through one of these.  (import path, class-or-None,
+# attribute.)  Direct kernel calls — the parity suites deliberately
+# feeding host arrays to ops.binpack — are NOT wrapped: the discipline
+# is a property of the scheduler seams, not of the kernels.
+TRANSFER_SEAMS = (
+    ("nomad_tpu.scheduler.jax_binpack", "JaxBinPackScheduler",
+     "dispatch_device"),
+    ("nomad_tpu.scheduler.batch", "BatchEvalRunner", "_process"),
+    ("nomad_tpu.models.fleet", "UsageMirror", "_update_device"),
+    ("nomad_tpu.parallel.mesh", None, "place_sequence_sharded"),
+    ("nomad_tpu.parallel.mesh", None, "place_rounds_sharded"),
+    ("nomad_tpu.parallel.mesh", None, "place_rounds_batch_sharded"),
+    ("nomad_tpu.parallel.mesh", None, "place_sequence_batch_sharded"),
+)
+
+
+class TransferGuardSanitizer:
+    """Rejects IMPLICIT host->device transfers on the dispatch seams.
+
+    Explicit transfers (jax.device_put through the counted seams) pass;
+    a host value reaching jit commitment inside a wrapped seam raises
+    XlaRuntimeError in the offending test.  The d2h direction is not
+    guarded (the CPU test backend's zero-copy fetches never trip it);
+    devlint's static concretize pass owns that side.
+    """
+
+    def __init__(self, seams=TRANSFER_SEAMS) -> None:
+        self.seams = seams
+        self._saved: list = []
+        self._installed = False
+
+    def install(self) -> "TransferGuardSanitizer":
+        if self._installed:
+            return self
+        import importlib
+
+        import jax
+
+        def wrap(fn):
+            def guarded(*args, **kwargs):
+                with jax.transfer_guard_host_to_device("disallow"):
+                    return fn(*args, **kwargs)
+            guarded.__name__ = fn.__name__
+            guarded.__qualname__ = getattr(fn, "__qualname__",
+                                           fn.__name__)
+            guarded.__wrapped__ = fn
+            return guarded
+
+        for mod_path, cls_name, attr in self.seams:
+            try:
+                mod = importlib.import_module(mod_path)
+            except Exception:
+                continue
+            holder = getattr(mod, cls_name) if cls_name else mod
+            fn = getattr(holder, attr, None)
+            if fn is None:
+                continue
+            self._saved.append((holder, attr, fn))
+            setattr(holder, attr, wrap(fn))
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for holder, attr, fn in self._saved:
+            setattr(holder, attr, fn)
+        self._saved = []
+        self._installed = False
+
+    def __enter__(self) -> "TransferGuardSanitizer":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
 
 
 class RecompileSentinel:

@@ -92,7 +92,11 @@ class AllocRunner:
 
         self.alloc_dir = AllocDir(alloc_root)
         self.ctx = ExecContext(self.alloc_dir, alloc.id, options=options)
-        self.task_runners: dict = {}
+        # Published as ONE complete set under _lock by run() before any
+        # task starts (see the publish comment there); never mutated
+        # after, so bare reads are safe — the annotation states the
+        # contract the lint enforces (locked writes, exempt reads).
+        self.task_runners: CopySwap = {}
         self.task_states: dict = {}
         self._destroy = threading.Event()
         self._lock = threading.Lock()

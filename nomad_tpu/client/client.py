@@ -112,8 +112,13 @@ class Client:
         self._shutdown = threading.Event()
         self._heartbeat_ttl = 10.0
         self._alloc_index = 0
+        # Worker-thread registry (run/heartbeat loops + churn-spawned
+        # destroy/reclaim workers): every mutation holds _threads_lock
+        # — start() appends from the caller thread while the client-run
+        # thread prunes via _retain, and an unlocked rebind could drop
+        # a handle shutdown() must join.
+        self._threads_lock = threading.Lock()
         self._threads: list = []
-
         self._restore_state()
 
     def servers(self) -> list:
@@ -225,14 +230,14 @@ class Client:
         t = threading.Thread(target=self.run, daemon=True,
                              name="client-run")
         t.start()
-        self._threads.append(t)
+        self._retain(t)
 
     def run(self) -> None:
         self._register()
         t = threading.Thread(target=self._heartbeat_loop, daemon=True,
                              name="client-heartbeat")
         t.start()
-        self._threads.append(t)
+        self._retain(t)
         self._watch_allocations()
 
     def shutdown(self) -> None:
@@ -240,8 +245,15 @@ class Client:
         pool = getattr(self.rpc, "pool", None)
         if pool is not None:
             pool.shutdown()
-        for t in self._threads:
-            t.join(1.0)
+        with self._threads_lock:
+            threads = list(self._threads)
+        # Shared deadline across the joins: the registry now includes
+        # churn workers (destroy/reclaim/flush bursts), and 1s EACH
+        # would make shutdown latency scale with live churn.
+        import time as _time
+        deadline = _time.monotonic() + 3.0
+        for t in threads:
+            t.join(max(0.0, deadline - _time.monotonic()))
 
     def destroy_all(self) -> None:
         with self._alloc_lock:
@@ -306,10 +318,15 @@ class Client:
                 with self._update_lock:
                     dirty = bool(self._pending_updates)
                 if dirty:
-                    threading.Thread(
+                    t = threading.Thread(
                         target=self._flush_alloc_updates,
                         kwargs={"block": False}, daemon=True,
-                        name="client-alloc-flush").start()
+                        name="client-alloc-flush")
+                    t.start()
+                    # Retained in the locked registry so shutdown reaps
+                    # it; _retain prunes superseded bursts (each is
+                    # deadline-capped at 5s by UPDATE_ALLOC_POLICY).
+                    self._retain(t)
 
     # -- alloc watching ------------------------------------------------------
     def _watch_allocations(self) -> None:
@@ -350,16 +367,17 @@ class Client:
         (reference client/util.go:34-70 + client.go:650-728)."""
         assigned = {a.id: a for a in updated}
         reclaim: list = []
+        destroy: list = []
         with self._alloc_lock:
             existing = dict(self.alloc_runners)
 
             # Removed: server no longer lists the alloc — stop it, drop
-            # the runner, and reclaim its directories in the background.
+            # the runner, and reclaim its directories in the background
+            # (threads spawned OUTSIDE the lock, below).
             for alloc_id, runner in existing.items():
                 if alloc_id not in assigned:
                     self.alloc_runners.pop(alloc_id, None)
-                    threading.Thread(target=runner.destroy,
-                                     daemon=True).start()
+                    destroy.append(runner)
 
             # A recovering (torn-state) alloc the server no longer
             # lists at all — GC'd while the client was down: same
@@ -396,8 +414,25 @@ class Client:
                     runner.run(restore=recover)
                 elif alloc.modify_index > runner.alloc.modify_index:
                     runner.update(alloc)
+        for runner in destroy:
+            # Teardown off the watch loop, bounded by destroy()'s
+            # per-task join timeouts; retained so shutdown joins it
+            # like the reclaim threads.
+            t = threading.Thread(target=runner.destroy, daemon=True,
+                                 name="alloc-destroy")
+            t.start()
+            self._retain(t)
         for alloc_id in reclaim:
             self._reclaim_recover(alloc_id)
+
+    def _retain(self, t) -> None:
+        """Retain a worker thread for shutdown's join, pruning finished
+        ones so alloc churn over a long-lived client cannot grow the
+        list without bound (all mutations under _threads_lock — see
+        __init__)."""
+        with self._threads_lock:
+            self._threads = [x for x in self._threads
+                             if x.is_alive()] + [t]
 
     def _reclaim_recover(self, alloc_id: str) -> None:
         """Background kill-and-reclaim of a corrupt-state alloc the
@@ -413,7 +448,7 @@ class Client:
             kwargs={"options": self.config.options},
             daemon=True, name=f"alloc-reclaim-{alloc_id[:8]}")
         t.start()
-        self._threads.append(t)
+        self._retain(t)
 
     def _sync_alloc_status(self, alloc: Allocation) -> None:
         """Dirty-sync client-authoritative fields to the server.  The

@@ -124,25 +124,38 @@ class ShardedResidency:
         entry = self._res.get(key)
         return entry[0] if entry is not None else None
 
-    def install(self, key, mesh, arrays, spec=None):
-        """Upload ``arrays`` sharded for ``mesh`` (node axis by
-        default; pass ``spec`` for e.g. [G, N] group-major rows) and
-        make them resident under ``key``."""
+    def prepare(self, mesh, arrays, spec=None):
+        """EXPLICIT sharded upload (counted) of ``arrays`` for ``mesh``
+        (node axis by default; pass ``spec`` for e.g. [G, N] group-major
+        rows) WITHOUT touching the residency dict — callers that serve
+        readers under a lock (the usage mirror) upload through this
+        outside the lock, then ``adopt`` the result under it, so no
+        thread ever waits out a fleet-sized transfer behind the lock."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        from nomad_tpu.parallel.devices import note_transfer
         from nomad_tpu.parallel.mesh import FLEET_AXIS
+        sharding = NamedSharding(
+            mesh, P(FLEET_AXIS) if spec is None else spec)
+        note_transfer("h2d", len(arrays))
+        return tuple(jax.device_put(a, sharding) for a in arrays)
+
+    def adopt(self, key, arrays):
+        """Make already-uploaded ``arrays`` (from ``prepare``) resident
+        under ``key``; the per-class eviction bound applies here."""
         if key not in self._res:
             kind = key[0]
             same = [k for k in self._res if k[0] == kind]
             if len(same) >= self.max_resident:
                 for k in same:
                     del self._res[k]
-        sharding = NamedSharding(
-            mesh, P(FLEET_AXIS) if spec is None else spec)
-        out = tuple(jax.device_put(a, sharding) for a in arrays)
-        self._res[key] = [out, 0]
-        return out
+        self._res[key] = [arrays, 0]
+        return arrays
+
+    def install(self, key, mesh, arrays, spec=None):
+        """prepare + adopt in one step, for callers holding no lock."""
+        return self.adopt(key, self.prepare(mesh, arrays, spec=spec))
 
     def replace(self, key, arrays) -> None:
         """Swap a maintained entry's arrays (scatter update) and count
@@ -441,6 +454,13 @@ def _net_row_build(alloc: Allocation):
     if key is None and not mbits:
         return None
     return (tuple(ports), mbits, key or NET_KEY_ODD)
+
+
+# Sentinel: a freshly-built mirror view whose device-usage attachment
+# has not resolved yet (UsageMirror._attach_device runs outside the
+# mirror lock and replaces it with a real buffer or None).  Never
+# escapes view()/view_at().
+_PENDING_DEVICE = object()
 
 
 @dataclass
@@ -857,19 +877,62 @@ class UsageMirror:
                 (buf,) = sharded.lookup(key)
                 sharded.replace(key, (_scatter_rows(buf, idx, rows),))
 
-    def _device_usage_locked(self):
-        from nomad_tpu.parallel.devices import ensure_on_default
-        buf = ensure_on_default(self._usage_d, self.usage)
-        if buf is not self._usage_d:  # fresh upload (first use or re-pin)
-            self._usage_d = buf
-            self._scatters_since_upload = 0
-        return buf
-
     def device_usage(self):
         """Device-resident copy of the mirror's usage (uploaded on first
-        use, then scatter-maintained alongside every host delta)."""
+        use, then scatter-maintained alongside every host delta).
+
+        The upload itself happens OUTSIDE the mirror lock: at 131k+
+        nodes the full usage tensor is fleet-sized, and holding the lock
+        across its host->device copy would park every worker's sync and
+        view build behind one thread's transfer (devlint
+        transfer-under-lock — the analyzer finding that restructured
+        this path).  The install is revalidated under the lock exactly
+        ONCE — a mirror that moved on mid-upload just gets the fresh
+        copy of the snapshot we read, uninstalled (a retry loop would
+        re-upload a fleet-sized tensor per lost race under a sustained
+        commit stream)."""
+        from nomad_tpu.parallel.devices import on_default_platform, \
+            put_counted
         with self._lock:
-            return self._device_usage_locked()
+            host = self.usage
+            buf = self._usage_d
+        if buf is not None and on_default_platform(buf):
+            return buf
+        fresh = put_counted(host)
+        with self._lock:
+            if self.usage is host and (
+                    self._usage_d is None or
+                    not on_default_platform(self._usage_d)):
+                self._usage_d = fresh
+                self._scatters_since_upload = 0
+        return fresh
+
+    def _attach_device(self, view: "FleetView") -> "FleetView":
+        """Resolve a view's pending device-usage attachment (set by
+        _view_locked when the view rides the mirror's own array): reuse
+        the resident copy, or upload one OUTSIDE the lock and install it
+        when the mirror hasn't moved.  Either way the view gets a device
+        copy of exactly ITS snapshot array."""
+        if view is None or view.usage_device is not _PENDING_DEVICE:
+            return view
+        view.usage_device = None
+        from nomad_tpu.parallel.devices import on_default_platform, \
+            put_counted
+        host = view.usage
+        with self._lock:
+            buf = self._usage_d if self.usage is host else None
+        if buf is not None and on_default_platform(buf):
+            view.usage_device = buf
+            return view
+        fresh = put_counted(host)
+        with self._lock:
+            if self.usage is host and (
+                    self._usage_d is None or
+                    not on_default_platform(self._usage_d)):
+                self._usage_d = fresh
+                self._scatters_since_upload = 0
+        view.usage_device = fresh
+        return view
 
     def device_usage_sharded(self, mesh, expect_usage):
         """Mesh-resident (node-axis-sharded) copy of the mirror's usage
@@ -879,14 +942,26 @@ class UsageMirror:
         Uploaded on first use PER MESH under the unified residency
         policy (alternating fused batch sizes get different meshes and
         must not thrash each other), scatter-maintained alongside
-        every host delta like the single-device copy."""
+        every host delta like the single-device copy.  The upload runs
+        OUTSIDE the mirror lock (ShardedResidency.prepare/adopt) for
+        the same reason as device_usage: a fleet-sized sharded upload
+        must not serialize every other worker's sync."""
+        key = ("usage", mesh)
         with self._lock:
             if self.usage is not expect_usage:
                 return None
-            key = ("usage", mesh)
+            hit = self._sharded.lookup(key)
+            if hit is not None:
+                return hit[0]
+        arrays = self._sharded.prepare(mesh, (expect_usage,))
+        with self._lock:
+            if self.usage is not expect_usage:
+                # Moved past us mid-upload: the copy no longer matches
+                # the mirror; the caller falls back to its own view.
+                return None
             hit = self._sharded.lookup(key)
             if hit is None:
-                hit = self._sharded.install(key, mesh, (self.usage,))
+                hit = self._sharded.adopt(key, arrays)
             return hit[0]
 
     # -- views -------------------------------------------------------------
@@ -901,9 +976,13 @@ class UsageMirror:
         deltas = plan is not None and \
             (plan.node_update or plan.node_allocation)
         if not deltas:
+            # The device copy is attached OUTSIDE the lock
+            # (_attach_device): the sentinel marks the view as riding
+            # the mirror's own array, so the attachment can validate
+            # against it after the upload.
             return FleetView(statics=statics, usage=usage,
                              job_counts=jc_dense,
-                             usage_device=self._device_usage_locked())
+                             usage_device=_PENDING_DEVICE)
         usage = usage.copy()
         index_of = statics.index_of
         for updates in plan.node_update.values():
@@ -931,19 +1010,24 @@ class UsageMirror:
         plan deltas (EvalContext.ProposedAllocs semantics, reference
         scheduler/context.go:96-126, fleet-wide)."""
         with self._lock:
-            return self._view_locked(plan, job_id)
+            view = self._view_locked(plan, job_id)
+        return self._attach_device(view)
 
     def view_at(self, state, plan, job_id: str) -> Optional[FleetView]:
         """Atomically sync to ``state`` and build a view under one lock
         hold, so a concurrent worker cannot advance the mirror between
         the sync and the view (the view must reflect exactly this eval's
         snapshot).  Returns None when the snapshot is older than the
-        mirror — the caller falls back to a from-scratch build."""
+        mirror — the caller falls back to a from-scratch build.  The
+        view's device-usage attachment resolves after the lock releases
+        (_attach_device) so the first-use upload never serializes other
+        workers' syncs."""
         t = state._t
         with self._lock:
             if not self._sync_locked(t):
                 return None
-            return self._view_locked(plan, job_id)
+            view = self._view_locked(plan, job_id)
+        return self._attach_device(view)
 
 
 _mirror_create_lock = threading.Lock()
@@ -969,7 +1053,16 @@ def _scatter_rows(usage_d, idx: np.ndarray, rows: np.ndarray):
     row idx[0] with its own value — a no-op) so the jit compiles at most
     log2(N) signatures instead of one per distinct delta size: commit
     streams change a different number of rows every sync, and an XLA
-    compile per size (~0.5s) would dwarf the scatter itself."""
+    compile per size (~0.5s) would dwarf the scatter itself.
+
+    The idx/rows update batch is placed EXPLICITLY (counted, replicated
+    on the buffer's own sharding mesh when the target is a mesh twin):
+    left to jit it was an implicit per-sync transfer — invisible to the
+    odometer and rejected by the transfer-guard sanitizer.  This runs
+    under the mirror lock by design: the scatter is a bounded
+    (<= MAX_SCATTER_ROWS) async dispatch that must stay atomic with the
+    host-array swap so the `_usage_d == usage` invariant holds.
+    """
     n = len(idx)
     if n == 0:
         return usage_d
@@ -978,7 +1071,22 @@ def _scatter_rows(usage_d, idx: np.ndarray, rows: np.ndarray):
         pad = padded - n
         idx = np.concatenate([idx, np.repeat(idx[:1], pad)])
         rows = np.concatenate([rows, np.repeat(rows[:1], pad, axis=0)])
-    return _ensure_scatter_jit()(usage_d, idx, rows)
+    import jax
+
+    from nomad_tpu.parallel.devices import note_transfer
+    sharding = getattr(usage_d, "sharding", None)
+    mesh = getattr(sharding, "mesh", None)
+    note_transfer("h2d", 2)
+    if mesh is not None and getattr(mesh, "axis_names", None):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        target = NamedSharding(mesh, P())  # replicated update batch
+    else:
+        from nomad_tpu.parallel.devices import default_device
+        target = default_device()
+    # devlint-ok(transfer-under-lock): bounded async update batch; must
+    # stay atomic with the host swap (see docstring).
+    idx_d, rows_d = jax.device_put(idx, target), jax.device_put(rows, target)
+    return _ensure_scatter_jit()(usage_d, idx_d, rows_d)
 
 
 def _scatter_jit_impl(usage, idx, rows):

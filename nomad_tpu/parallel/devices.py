@@ -18,9 +18,37 @@ between the host data plane and the XLA device plane.
 """
 from __future__ import annotations
 
+import threading
+
 from typing import Optional
 
 import jax
+
+# -- transfer accounting ----------------------------------------------------
+# Every EXPLICIT host<->device transfer the runtime performs is counted
+# here — the data plane's odometer.  The discipline (enforced by
+# analysis/devlint.py statically and the transfer-guard sanitizer at
+# runtime) is that device dispatches perform NO implicit transfers:
+# everything that crosses the PCIe/ICI boundary goes through one of the
+# explicit seams below (put_counted / ensure_on_default / mesh._put /
+# ShardedResidency / fetch_host), so "how many transfers per eval" is a
+# number the bench can record instead of a guess
+# (BENCH host_transfers_per_eval).
+
+_TRANSFER_LOCK = threading.Lock()
+_TRANSFERS = {"h2d": 0, "d2h": 0, "d2d": 0}
+
+
+def note_transfer(kind: str, n: int = 1) -> None:
+    """Count ``n`` explicit transfers of ``kind`` ("h2d"/"d2h"/"d2d")."""
+    with _TRANSFER_LOCK:
+        _TRANSFERS[kind] += n
+
+
+def transfer_counts() -> dict:
+    """Snapshot of the process-lifetime explicit-transfer counters."""
+    with _TRANSFER_LOCK:
+        return dict(_TRANSFERS)
 
 
 def default_platform() -> Optional[str]:
@@ -103,4 +131,49 @@ def ensure_on_default(cached, host):
     """
     if cached is not None and on_default_platform(cached):
         return cached
+    note_transfer("h2d")
     return jax.device_put(host, default_device())
+
+
+def classify_move(src_platform: str, dst_platform: str) -> str:
+    """The ONE h2d/d2h/d2d classification rule for an explicit move of
+    a jax.Array between platforms (shared by put_counted and
+    mesh._put so the odometer cannot drift between seams): a move
+    whose source or destination is the cpu backend crosses the host
+    boundary — cpu jax buffers live in host memory — and counting it
+    d2d would under-report the h2d odometer the bench's
+    host_transfers_per_eval is built on."""
+    if src_platform == "cpu" and dst_platform != "cpu":
+        return "h2d"
+    if dst_platform == "cpu" and src_platform != "cpu":
+        return "d2h"
+    return "d2d"
+
+
+def put_counted(x, device=None):
+    """EXPLICIT placement of one per-dispatch host value onto the
+    current platform (counted).  The dispatch seams route every
+    per-eval varying argument (usage views, job counts, fused lane
+    stacks) through here instead of letting jit commit them implicitly
+    — an implicit transfer is invisible to the odometer AND trips the
+    transfer-guard sanitizer; an explicit one is accounted.  Arrays
+    already resident on the default platform pass through untouched."""
+    if isinstance(x, jax.Array):
+        if on_default_platform(x):
+            return x
+        src = next(iter(x.devices())).platform
+        note_transfer(classify_move(src, current_platform()))
+        return jax.device_put(x, device or default_device())
+    note_transfer("h2d")
+    return jax.device_put(x, device or default_device())
+
+
+def fetch_host(x):
+    """EXPLICIT device->host fetch (counted): the one sanctioned way a
+    device result becomes a numpy array.  ``jax.device_get`` (not
+    ``np.asarray``) so the transfer survives a d2h transfer guard; host
+    values pass through untouched."""
+    if isinstance(x, jax.Array):
+        note_transfer("d2h")
+        return jax.device_get(x)
+    return x

@@ -150,9 +150,23 @@ def _put(x, sharding):
     """device_put that skips arrays already resident with the target
     sharding — the seam that lets mesh-resident fleet tensors (the
     sharded usage mirror, cached capacity/reserved) flow into the
-    sharded kernels without a per-dispatch upload."""
+    sharded kernels without a per-dispatch upload.  Placements that DO
+    happen are explicit and counted (parallel/devices transfer
+    odometer): the sharded kernels below route every operand through
+    here, so a sharded dispatch performs zero implicit transfers."""
     if getattr(x, "sharding", None) == sharding:
         return x
+    from nomad_tpu.parallel.devices import classify_move, note_transfer
+    if isinstance(x, jax.Array):
+        src = next(iter(x.devices())).platform
+        try:
+            dst = next(iter(sharding.device_set)).platform
+        except Exception:
+            dst = src
+        kind = classify_move(src, dst)
+    else:
+        kind = "h2d"
+    note_transfer(kind)
     return jax.device_put(x, sharding)
 
 
@@ -216,6 +230,12 @@ def place_sequence_sharded(mesh: Mesh, capacity, reserved, usage0,
     distinct = _put(distinct, repl)
     group_idx = _put(group_idx, repl)
     valid = _put(valid, repl)
+    # The penalty scalar rides the same replicated placement as the
+    # other work descriptors: left as a host scalar it was an IMPLICIT
+    # per-dispatch transfer jit performed silently on every sharded
+    # single-eval dispatch (devlint sharding-mix; the batch wrappers
+    # below always placed it).
+    penalty = _put(penalty, repl)
     return _place_sharded(capacity, reserved, usage0, job_counts0, feasible,
                           asks, distinct, group_idx, valid, penalty)
 
@@ -249,6 +269,7 @@ def place_rounds_sharded(mesh: Mesh, capacity, reserved, usage0, jc0,
     asks = _put(asks, repl)
     distinct = _put(distinct, repl)
     counts = _put(counts, repl)
+    penalty = _put(penalty, repl)  # see place_sequence_sharded
     return _place_rounds_sharded_jit(capacity, reserved, usage0, jc0,
                                      feasible, asks, distinct, counts,
                                      penalty, k_cap=k_cap, rounds=rounds)

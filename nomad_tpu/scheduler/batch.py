@@ -38,6 +38,15 @@ def _tnow() -> float:
     return t.now() if t is not None else 0.0
 
 
+def pad_lanes(n: int) -> int:
+    """Next power of two >= n (>= 1): the lane-axis bucket of the fused
+    dispatch — the vmapped kernels trace once per distinct lane count,
+    so the batch size must be bucketed exactly like the group and
+    placement axes (models/fleet._pad_to) or a drifting storm recompiles
+    per size."""
+    return 1 << max(0, (n - 1).bit_length())
+
+
 def _lane_spans(name: str, scheds, t0: float, t1: float, **tags) -> None:
     """One span per lane sharing the window's [t0, t1] — fused stages
     (dispatch, finish, submit) run once for the whole window, and every
@@ -198,6 +207,14 @@ class BatchEvalRunner:
         p_max = max(a.p_pad for _, _, a in pending)
         statics = pending[0][2].statics
         B = len(pending)
+        # The lane axis is bucketed to a power of two exactly like the
+        # group/placement axes (g_pad/p_pad): the vmapped kernels trace
+        # per distinct lane count, and a storm whose batch size drifts
+        # 3, 5, 6, ... would recompile per size (~0.5s each) — the
+        # recompile-churn class devlint's provenance pass flags.  Pad
+        # lanes are all-invalid (feasible/valid False, counts 0) and
+        # place nothing; results are consumed per real lane only.
+        B_pad = pad_lanes(B)
         rounds_ok = all(a.rounds_eligible for _, _, a in pending)
         k_cap = max(a.k_cap for _, _, a in pending)
         rounds = max(a.rounds for _, _, a in pending)
@@ -225,14 +242,14 @@ class BatchEvalRunner:
 
         t_disp = _tnow()
         # Harmonize pad shapes across lanes, stack, one dispatch.
-        feasible = np.zeros((B, g_max, statics.n_pad), dtype=bool)
-        asks = np.zeros((B, g_max, pending[0][2].asks.shape[1]),
+        feasible = np.zeros((B_pad, g_max, statics.n_pad), dtype=bool)
+        asks = np.zeros((B_pad, g_max, pending[0][2].asks.shape[1]),
                         dtype=np.float32)
-        distinct = np.zeros((B, g_max), dtype=bool)
-        group_idx = np.zeros((B, p_max), dtype=np.int32)
-        valid = np.zeros((B, p_max), dtype=bool)
-        job_counts = np.zeros((B, statics.n_pad), dtype=np.int32)
-        counts = np.zeros((B, g_max), dtype=np.int32)
+        distinct = np.zeros((B_pad, g_max), dtype=bool)
+        group_idx = np.zeros((B_pad, p_max), dtype=np.int32)
+        valid = np.zeros((B_pad, p_max), dtype=bool)
+        job_counts = np.zeros((B_pad, statics.n_pad), dtype=np.int32)
+        counts = np.zeros((B_pad, g_max), dtype=np.int32)
         for b, (_s, _p, a) in enumerate(pending):
             feasible[b, :a.g_pad] = a.feasible_h
             asks[b, :a.g_pad] = a.asks
@@ -242,15 +259,15 @@ class BatchEvalRunner:
             job_counts[b] = a.view.job_counts
             counts[b, :a.g_pad] = a.counts
 
-        penalty = np.asarray([a.penalty for _, _, a in pending],
-                             dtype=np.float32)
+        penalty = np.zeros(B_pad, dtype=np.float32)
+        penalty[:B] = [a.penalty for _, _, a in pending]
 
         # Mesh resolution rides the ONE authority (parallel/mesh.py):
         # multi-chip agents automatically get the 2-D (lanes, fleet)
         # storm layout when the shape splits, NOMAD_TPU_MESH overrides.
         from nomad_tpu.parallel.mesh import dispatch_mesh
 
-        mesh = dispatch_mesh(B, statics.n_pad)
+        mesh = dispatch_mesh(B_pad, statics.n_pad)
         # All fused lanes share the same snapshot base usage (fast-path
         # contract above); use the resident device copies when available
         # (single-device mirror copy, or on a mesh the sharded statics +
@@ -268,8 +285,24 @@ class BatchEvalRunner:
             if base_usage is None:
                 base_usage = view0.usage  # mirror moved on: host upload
         else:
+            from nomad_tpu.parallel.devices import put_counted
+
             capacity_d, reserved_d = statics.device_capacity_reserved()
-            base_usage = view0.dispatch_usage()
+            base_usage = put_counted(view0.dispatch_usage())
+            # The per-dispatch lane stacks are fresh host arrays: place
+            # them EXPLICITLY (counted) instead of letting jit commit
+            # them implicitly — the fused dispatch's h2d bytes are part
+            # of its honest cost, and the transfer-guard sanitizer
+            # rejects the implicit form.  (The sharded wrappers below
+            # _put their operands themselves.)
+            feasible = put_counted(feasible)
+            asks = put_counted(asks)
+            distinct = put_counted(distinct)
+            group_idx = put_counted(group_idx)
+            valid = put_counted(valid)
+            job_counts = put_counted(job_counts)
+            counts = put_counted(counts)
+            penalty = put_counted(penalty)
         if rounds_ok:
             # Fast path: top-k rounds — device steps scale with unique
             # groups x rounds, not with placements.

@@ -183,14 +183,20 @@ def _net_plan_for(tg):
 def fetch_results(*arrays) -> list:
     """Fetch device outputs with overlapped copies: start every
     device->host transfer asynchronously, then block once.  Two sequential
-    np.asarray calls cost two full round trips on remote-attached TPUs
-    (~100 ms each through the axon tunnel); this costs one."""
+    fetches cost two full round trips on remote-attached TPUs (~100 ms
+    each through the axon tunnel); this costs one.  The blocking fetch is
+    EXPLICIT (jax.device_get via devices.fetch_host, counted) — this and
+    collect_device are the sanctioned d2h seams of the scheduler, the
+    ones the transfer-guard sanitizer and devlint's transfer-discipline
+    pass leave open."""
+    from nomad_tpu.parallel.devices import fetch_host
+
     for a in arrays:
         try:
             a.copy_to_host_async()
         except AttributeError:  # plain numpy already on host
             pass
-    return [np.asarray(a) for a in arrays]
+    return [fetch_host(a) for a in arrays]
 
 
 def _fit_rounds(statics, view, feasible_h, asks, slot_placements,
@@ -635,27 +641,37 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
         self.dispatched_sharded = False
         capacity_d, reserved_d = args.statics.device_capacity_reserved()
         feas_cached = args.feasible_d  # [host, device-or-None], lazy
-        from nomad_tpu.parallel.devices import ensure_on_default
+        from nomad_tpu.parallel.devices import ensure_on_default, \
+            put_counted
         feas_cached[1] = ensure_on_default(feas_cached[1], feas_cached[0])
         feasible_d = feas_cached[1]
+        # Per-eval varying operands are placed EXPLICITLY (counted by
+        # the transfer odometer): usage/job_counts genuinely change per
+        # eval, so their upload is the honest per-eval transfer cost —
+        # left to jit they were IMPLICIT transfers the odometer missed
+        # and the transfer-guard sanitizer now rejects (devlint
+        # transfer-in-hot-loop).  The penalty scalar is
+        # dispatch-constant per job and rides the dev_const cache.
+        usage_d = put_counted(args.view.dispatch_usage())
+        jc_d = put_counted(args.view.job_counts)
+        (pen_d,) = self._dev_const(
+            args, "pen", (np.float32(args.penalty),))
         if args.rounds_eligible:
             from nomad_tpu.ops.binpack import place_rounds
 
             asks_d, distinct_d, counts_d = self._dev_const(
                 args, "rounds", (args.asks, args.distinct, args.counts))
             chosen_s, scores_s, _ = place_rounds(
-                capacity_d, reserved_d, args.view.dispatch_usage(),
-                args.view.job_counts, feasible_d, asks_d,
-                distinct_d, counts_d, args.penalty,
+                capacity_d, reserved_d, usage_d, jc_d, feasible_d,
+                asks_d, distinct_d, counts_d, pen_d,
                 k_cap=args.k_cap, rounds=args.rounds)
         else:
             asks_d, distinct_d, group_idx_d, valid_d = self._dev_const(
                 args, "seq", (args.asks, args.distinct, args.group_idx,
                               args.valid))
             chosen_s, scores_s, _ = place_sequence(
-                capacity_d, reserved_d, args.view.dispatch_usage(),
-                args.view.job_counts, feasible_d, asks_d,
-                distinct_d, group_idx_d, valid_d, args.penalty)
+                capacity_d, reserved_d, usage_d, jc_d, feasible_d,
+                asks_d, distinct_d, group_idx_d, valid_d, pen_d)
         for a in (chosen_s, scores_s):
             try:
                 a.copy_to_host_async()
@@ -691,6 +707,12 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
             usage = statics.mirror.device_usage_sharded(mesh, view.usage)
         if usage is None:
             usage = view.usage
+        # Dispatch-constant penalty rides the prep-shared dev_const
+        # holder like the asks (one replicated upload per job version
+        # per mesh); the sharded wrappers _put every remaining operand
+        # explicitly, so the whole sharded dispatch is implicit-free.
+        (pen_d,) = self._dev_const_repl(
+            args, ("pen", mesh), mesh, (np.float32(args.penalty),))
         if args.rounds_eligible:
             asks_d, distinct_d, counts_d = self._dev_const_repl(
                 args, ("rounds", mesh), mesh,
@@ -698,7 +720,7 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
             chosen_s, scores_s, _u = place_rounds_sharded(
                 mesh, capacity_d, reserved_d, usage, view.job_counts,
                 feasible_d, asks_d, distinct_d, counts_d,
-                args.penalty, k_cap=args.k_cap, rounds=args.rounds)
+                pen_d, k_cap=args.k_cap, rounds=args.rounds)
         else:
             asks_d, distinct_d, group_idx_d, valid_d = \
                 self._dev_const_repl(
@@ -708,7 +730,7 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
             chosen_s, scores_s, _u = place_sequence_sharded(
                 mesh, capacity_d, reserved_d, usage, view.job_counts,
                 feasible_d, asks_d, distinct_d, group_idx_d,
-                valid_d, args.penalty)
+                valid_d, pen_d)
         for a in (chosen_s, scores_s):
             try:
                 a.copy_to_host_async()
@@ -719,8 +741,11 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
     def collect_device(self, args: "DeviceArgs", handles: tuple
                        ) -> tuple[np.ndarray, np.ndarray]:
         """Block on a dispatch's results and map them to per-placement
-        (chosen, scores) arrays."""
-        chosen, scores = (np.asarray(h) for h in handles)
+        (chosen, scores) arrays.  The d2h fetch is explicit and counted
+        (devices.fetch_host) — this is a sanctioned collect seam."""
+        from nomad_tpu.parallel.devices import fetch_host
+
+        chosen, scores = (fetch_host(h) for h in handles)
         if args.rounds_eligible:
             chosen, scores = rounds_to_placements(args, chosen, scores)
         return chosen, scores

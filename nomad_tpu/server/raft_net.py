@@ -201,6 +201,9 @@ class NetRaft:
                 self._term > 0 or self._last_index() > 0):
             self._elections_enabled = True
 
+        # Last election's voter-ask threads (see _start_election):
+        # replaced wholesale per election, reaped by shutdown.
+        self._election_askers: list = []
         # Ordered leadership notifications.
         self._notify: list = []
         self._notify_queue: queue.Queue = queue.Queue()
@@ -310,6 +313,8 @@ class NetRaft:
         for repl in replicators:
             repl.join(3.0)
         self._notifier.join(2.0)
+        for t in self._election_askers:
+            t.join(2.0)
         if self._log_store is not None:
             self._log_store.close()
 
@@ -476,8 +481,16 @@ class NetRaft:
                 if self._state == CANDIDATE and self._term == term:
                     self._become_leader()
             return
+        askers = []
         for peer in peers:
-            threading.Thread(target=ask, args=(peer,), daemon=True).start()
+            t = threading.Thread(target=ask, args=(peer,), daemon=True,
+                                 name="raft-vote-ask")
+            t.start()
+            askers.append(t)
+        # One voter ask per peer, bounded by the 1s RPC timeout; the
+        # handles are retained so shutdown reaps the last election's
+        # askers instead of abandoning them (analyzer: thread-leak).
+        self._election_askers = askers
         done.wait(self.election_timeout[0])
 
     def _become_leader(self) -> None:

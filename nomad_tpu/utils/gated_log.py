@@ -19,6 +19,8 @@ import threading
 from collections import deque
 from typing import Callable, Optional
 
+from nomad_tpu.utils.sync import CopySwap
+
 FORMAT = "%(asctime)s [%(levelname)s] %(name)s: %(message)s"
 
 
@@ -104,7 +106,10 @@ class GatedHandler(logging.Handler):
     def __init__(self) -> None:
         super().__init__(level=logging.NOTSET)
         self._buffer: list = []
-        self._targets: list = []
+        # Rebound (a fresh list) under _glock by open_gate; bare reads
+        # serve whichever complete target list was last published —
+        # the copy-on-write-swap contract the annotation enforces.
+        self._targets: CopySwap = []
         self._open = False
         self._glock = threading.Lock()
 

@@ -47,21 +47,29 @@ def runtime_sanitizers():
       precondition) fails the session at teardown.
     - recompile sentinel: a jit kernel retracing past its budget fails
       the session — the silent perf-erosion mode behavioral tests miss.
+    - transfer guard: the scheduler's device-dispatch seams run under
+      jax.transfer_guard_host_to_device("disallow") — an IMPLICIT
+      host->device transfer on a dispatch path (a host array/scalar
+      silently committed by jit instead of explicitly placed through
+      the counted seams) raises in the test that caused it.
 
     Disable with NOMAD_TPU_SANITIZERS=0 (e.g. when bisecting an
-    unrelated failure).  Both only observe; no test behavior changes.
+    unrelated failure).  All only observe; no test behavior changes.
     """
     if os.environ.get("NOMAD_TPU_SANITIZERS", "1") == "0":
         yield
         return
     from nomad_tpu.analysis.sanitizers import (LockOrderWitness,
-                                               RecompileSentinel)
+                                               RecompileSentinel,
+                                               TransferGuardSanitizer)
 
     witness = LockOrderWitness().install()
     sentinel = RecompileSentinel().install()
+    guard = TransferGuardSanitizer().install()
     try:
         yield
     finally:
+        guard.uninstall()
         witness.uninstall()
     # Collect-then-raise so one sanitizer tripping doesn't mask the
     # other's report for the same session.

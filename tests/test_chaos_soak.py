@@ -274,13 +274,13 @@ def test_leader_failover_mid_storm():
     half-processed storm can never leave torn placements)."""
     from tests.test_raft_net import (
         make_cluster,
-        wait_for_leader,
+        wait_for_stable_leader,
         wait_until,
     )
 
     servers = make_cluster(3)
     try:
-        leader = wait_for_leader(servers)
+        leader = wait_for_stable_leader(servers)
         nodes = [mock.node(i) for i in range(10)]
         for node in nodes:
             leader.node_register(node)
@@ -299,17 +299,27 @@ def test_leader_failover_mid_storm():
         for s in survivors:
             s.raft.remove_peer(leader.rpc_address())
 
-        new_leader = wait_for_leader(survivors, timeout=10)
+        # Load-tolerant: the two survivors may flap leadership for a
+        # while when the host is starving their tickers — wait for a
+        # leader that HOLDS, with a generous bar (this soak proves
+        # convergence invariants, not election latency; bench 5e owns
+        # the timing numbers).
+        wait_for_stable_leader(survivors, timeout=60)
 
-        # Every raft-committed eval must reach a terminal status under
-        # the new leader (broker restored from replicated state).
+        # Every raft-committed eval must reach a terminal status on a
+        # survivor's replica (the broker restores from replicated
+        # state on WHICHEVER survivor currently leads — a mid-wait
+        # re-flap must not fail the check, so read both replicas).
         def all_terminal():
-            state = new_leader.fsm.state
-            evs = [state.eval_by_id(eid) for eid in eval_ids]
-            return all(e is not None and e.status in TERMINAL
-                       for e in evs)
-        wait_until(all_terminal, timeout=30,
-                   msg="storm evals terminal on the new leader")
+            for s in survivors:
+                state = s.fsm.state
+                evs = [state.eval_by_id(eid) for eid in eval_ids]
+                if all(e is not None and e.status in TERMINAL
+                       for e in evs):
+                    return True
+            return False
+        wait_until(all_terminal, timeout=90,
+                   msg="storm evals terminal on a survivor")
 
         # Committed placements satisfy exact fit on every node, on every
         # survivor's replica.
@@ -320,11 +330,12 @@ def test_leader_failover_mid_storm():
                         if not a.terminal_status() and a.node_id]
                 fit, dim, _ = allocs_fit(state.node_by_id(node.id), live)
                 assert fit, f"node {node.id} oversubscribed on {dim}"
-        # Replicas agree on the alloc set.
+        # Replicas agree on the alloc set (load-tolerant bar: replication
+        # to the trailing survivor rides the same starved tickers).
         def alloc_ids(s):
             return frozenset(a.id for a in s.fsm.state.allocs())
         wait_until(lambda: alloc_ids(survivors[0]) == alloc_ids(
-            survivors[1]), msg="replicas agree on allocs")
+            survivors[1]), timeout=60, msg="replicas agree on allocs")
     finally:
         for s in servers:
             try:

@@ -987,7 +987,11 @@ def test_client_reboot_with_corrupt_state_and_stopped_alloc_reclaims(
                     and not os.path.isdir(state_dir)
                     and not os.path.isdir(alloc_root)
                     and not _pid_alive(pid))
-        wait_until(reclaimed, timeout=20,
+        # Load-tolerant bar (documented pre-existing flake, PR 12/13
+        # notes): the reclaim rides a background thread + an RPC watch
+        # cycle, both starved under full-suite host load — the proof is
+        # THAT it converges, not how fast.
+        wait_until(reclaimed, timeout=60,
                    msg="orphan killed and directories reclaimed")
         assert alloc_id not in client2._recover_alloc_ids
     finally:

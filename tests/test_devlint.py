@@ -1,0 +1,750 @@
+"""Device-plane lint (analysis/devlint.py) + the defects it found.
+
+Three layers, mirroring tests/test_interprocedural_lint.py:
+
+1. **Rule units** on synthetic packages: every devlint rule
+   (mesh-bypass, resident-bypass, sharding-mix, transfer-under-lock,
+   transfer-in-hot-loop, recompile-churn) proves it fires, and every
+   sanctioned pattern (placement through the seams, the collect seams,
+   bucketed shapes, justified ``# devlint-ok`` markers) proves it is
+   exempt — a lint that cannot fail gates nothing.
+2. **Analyzer-found defect regressions**: the real bugs the passes
+   surfaced — the sharded wrappers' unplaced penalty scalar (an
+   implicit per-dispatch transfer), the fused batch's unbucketed lane
+   axis (a retrace per distinct batch size), and the usage mirror's
+   fleet-sized uploads inside its lock — each pinned by a test that
+   fails on the pre-fix shape.
+3. **Transfer discipline end-to-end**: the scheduler dispatch seams run
+   clean under ``jax.transfer_guard("disallow")`` — zero implicit
+   transfers on the hot path — and the explicit-transfer odometer
+   (parallel/devices.transfer_counts) moves when placements happen.
+"""
+from __future__ import annotations
+
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+import nomad_tpu.mock as mock
+from nomad_tpu.analysis import devlint
+from nomad_tpu.structs import (
+    EVAL_TRIGGER_JOB_REGISTER,
+    Evaluation,
+    Resources,
+    generate_uuid,
+)
+
+
+def write_files(tmp_path, files: dict) -> str:
+    d = tmp_path / "pkg"
+    d.mkdir(exist_ok=True)
+    for name, source in files.items():
+        (d / name).write_text(textwrap.dedent(source))
+    return str(d)
+
+
+def rules_of(findings) -> dict:
+    out: dict = {}
+    for f in findings:
+        out.setdefault(f.rule, []).append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 1. rule units
+# ---------------------------------------------------------------------------
+
+class TestShardingPropagation:
+    def test_mesh_bypass_fires_and_consult_exempts(self, tmp_path):
+        pkg = write_files(tmp_path, {
+            "kern.py": """
+                import jax
+
+                def _impl(x, p):
+                    return x * p
+
+                kern = jax.jit(_impl)
+                """,
+            "mod.py": """
+                from pkg.kern import kern
+
+                def dispatch_mesh(n, pad):
+                    return None
+
+                def _put(x):
+                    import jax
+                    return jax.device_put(x)
+
+                def bad(x):
+                    return kern(_put(x), _put(2.0))
+
+                def good(x):
+                    mesh = dispatch_mesh(1, 8)
+                    return kern(_put(x), _put(2.0))
+                """,
+        })
+        by = rules_of(devlint.analyze_package(pkg))
+        wheres = [f.where for f in by.get("mesh-bypass", ())]
+        assert any(w.startswith("bad.") for w in wheres), by
+        assert not any(w.startswith("good.") for w in wheres), wheres
+
+    def test_kernel_defining_module_and_kernel_bodies_exempt(
+            self, tmp_path):
+        """jit-to-jit composition and same-module aliasing are traced
+        code / kernel plumbing, not dispatches."""
+        pkg = write_files(tmp_path, {
+            "kern.py": """
+                import jax
+
+                def _inner(x):
+                    return x + 1
+
+                def _outer(x):
+                    return _inner(x) * 2
+
+                inner = jax.jit(_inner)
+                outer = jax.jit(_outer)
+
+                def same_module_call(x):
+                    return inner(x)
+                """,
+        })
+        by = rules_of(devlint.analyze_package(pkg))
+        assert "mesh-bypass" not in by, by
+
+    def test_sharding_mix_flags_host_operand(self, tmp_path):
+        pkg = write_files(tmp_path, {
+            "mod.py": """
+                import jax
+
+                def _impl_sharded(x, p):
+                    return x * p
+
+                kern_sharded = jax.jit(_impl_sharded)
+
+                def _put(x):
+                    return jax.device_put(x)
+
+                def wrapper_bad(mesh, x, penalty):
+                    x = _put(x)
+                    return kern_sharded(x, penalty)
+
+                def wrapper_good(mesh, x, penalty):
+                    x = _put(x)
+                    penalty = _put(penalty)
+                    return kern_sharded(x, penalty)
+                """,
+        })
+        by = rules_of(devlint.analyze_package(pkg))
+        wheres = [f.where for f in by.get("sharding-mix", ())]
+        assert "wrapper_bad.p" in wheres, by
+        assert not any(w.startswith("wrapper_good") for w in wheres)
+
+    def test_resident_bypass_fires_and_seams_exempt(self, tmp_path):
+        pkg = write_files(tmp_path, {
+            "mod.py": """
+                import jax
+
+                def sneaky(x):
+                    return jax.device_put(x)
+
+                def _put(x):
+                    return jax.device_put(x)
+
+                def put_counted(x):
+                    return jax.device_put(x)
+
+                class ShardedResidency:
+                    def prepare(self, x):
+                        return jax.device_put(x)
+                """,
+        })
+        by = rules_of(devlint.analyze_package(pkg))
+        quals = [f.where for f in by.get("resident-bypass", ())]
+        assert "sneaky" in quals, by
+        assert all(q == "sneaky" for q in quals), quals
+
+
+class TestTransferDiscipline:
+    LOCKED = {
+        "mod.py": """
+            import threading
+
+            import jax
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def direct(self, x):
+                    with self._lock:
+                        return jax.device_put(x)
+
+                def chained(self, x):
+                    with self._lock:
+                        return self._upload(x)
+
+                def _upload(self, x):
+                    return jax.device_put(x)
+            """,
+    }
+
+    def test_transfer_under_lock_direct_and_chain(self, tmp_path):
+        pkg = write_files(tmp_path, self.LOCKED)
+        by = rules_of(devlint.analyze_package(pkg))
+        wheres = [f.where for f in by.get("transfer-under-lock", ())]
+        assert "C.direct[C._lock]" in wheres, by
+        assert "C.chained[C._lock]" in wheres, wheres
+
+    def test_marker_waives_and_is_counted(self, tmp_path):
+        src = textwrap.dedent(self.LOCKED["mod.py"]).replace(
+            "    def _upload(self, x):\n"
+            "        return jax.device_put(x)",
+            "    def _upload(self, x):\n"
+            "        # devlint-ok(transfer-under-lock): test waiver with"
+            " a reason\n"
+            "        return jax.device_put(x)")
+        src = src.replace(
+            "    def direct(self, x):\n"
+            "        with self._lock:\n"
+            "            return jax.device_put(x)",
+            "    def direct(self, x):\n"
+            "        with self._lock:\n"
+            "            # devlint-ok(transfer-under-lock): test waiver"
+            " with a reason\n"
+            "            return jax.device_put(x)")
+        assert "devlint-ok" in src
+        pkg = write_files(tmp_path, {"mod.py": src})
+        cov: dict = {}
+        findings = devlint.analyze_package(pkg, coverage_out=cov)
+        assert not [f for f in findings
+                    if f.rule == "transfer-under-lock"], findings
+        assert cov["waived"] > 0
+
+    def test_marker_does_not_waive_the_next_statement(self, tmp_path):
+        """A marker covers its own block's first code line ONLY: a
+        genuine finding introduced directly beneath a waived site must
+        still surface (the over-waive would quietly blind the
+        strict-clean gate right where it believes itself covered)."""
+        pkg = write_files(tmp_path, {
+            "mod.py": """
+                import threading
+
+                import jax
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def direct(self, x):
+                        with self._lock:
+                            # devlint-ok(transfer-under-lock): waived
+                            # site with a reason
+                            a = jax.device_put(x)
+                            b = jax.device_put(x)
+                            return a, b
+                """,
+        })
+        by = rules_of(devlint.analyze_package(pkg))
+        wheres = [f.where for f in by.get("transfer-under-lock", ())]
+        assert "C.direct[C._lock]" in wheres, \
+            "the statement after a waived site must still flag"
+
+    def test_inline_marker_waives_its_line_only(self, tmp_path):
+        """A trailing (inline) marker waives its own line, never the
+        statement below; a comment-block marker separated from the
+        site by a blank line attaches to nothing."""
+        pkg = write_files(tmp_path, {
+            "mod.py": """
+                import threading
+
+                import jax
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def inline(self, x):
+                        with self._lock:
+                            a = jax.device_put(x)  # devlint-ok(transfer-under-lock): reviewed site
+                            b = jax.device_put(x)
+                            return a, b
+
+                    def detached(self, x):
+                        with self._lock:
+                            # devlint-ok(transfer-under-lock): floats free
+
+                            return jax.device_put(x)
+                """,
+        })
+        by = rules_of(devlint.analyze_package(pkg))
+        wheres = [f.where for f in by.get("transfer-under-lock", ())]
+        # inline: the second put still flags; detached: the blank line
+        # breaks the attachment, so the site flags too.
+        assert wheres.count("C.inline[C._lock]") == 1, wheres
+        assert "C.detached[C._lock]" in wheres, wheres
+
+    def test_unjustified_marker_does_not_waive(self, tmp_path):
+        src = textwrap.dedent(self.LOCKED["mod.py"]).replace(
+            "            return jax.device_put(x)",
+            "            # devlint-ok(transfer-under-lock):\n"
+            "            return jax.device_put(x)", 1)
+        assert "devlint-ok" in src
+        pkg = write_files(tmp_path, {"mod.py": src})
+        by = rules_of(devlint.analyze_package(pkg))
+        wheres = [f.where for f in by.get("transfer-under-lock", ())]
+        assert "C.direct[C._lock]" in wheres, by
+
+    def test_hot_loop_flags_implicit_operand_and_concretize(
+            self, tmp_path):
+        pkg = write_files(tmp_path, {
+            "mod.py": """
+                import jax
+                import numpy as np
+
+                def _impl(x, p):
+                    return x * p
+
+                kern = jax.jit(_impl)
+
+                def _put(x):
+                    return jax.device_put(x)
+
+                def dispatch_mesh(n, pad):
+                    return None
+
+                class R:
+                    def _drain_window(self, v):
+                        dispatch_mesh(1, 8)
+                        host = np.zeros(8, dtype=np.float32)
+                        y = kern(host, _put(2.0))
+                        return float(y)
+
+                    def cold_path(self, v):
+                        dispatch_mesh(1, 8)
+                        host = np.zeros(8, dtype=np.float32)
+                        return kern(host, _put(2.0))
+
+                def collect_device(handles):
+                    y = kern(_put(handles), _put(2.0))
+                    return np.asarray(y)
+                """,
+        })
+        by = rules_of(devlint.analyze_package(pkg))
+        wheres = [f.where for f in by.get("transfer-in-hot-loop", ())]
+        # host operand + tainted float() inside the hot function...
+        assert "R._drain_window.x" in wheres, by
+        assert any(w.startswith("R._drain_window.float")
+                   for w in wheres), wheres
+        # ...but not in cold functions, and not in the collect seams.
+        assert not any(w.startswith("R.cold_path") for w in wheres)
+        assert not any(w.startswith("collect_device") for w in wheres)
+
+
+class TestRecompileProvenance:
+    def test_unstable_static_arg_and_shape_flag(self, tmp_path):
+        pkg = write_files(tmp_path, {
+            "mod.py": """
+                import jax
+                import numpy as np
+
+                def _impl(x, k=1):
+                    return x
+
+                kern = jax.jit(_impl, static_argnames=("k",))
+
+                def _put(x):
+                    return jax.device_put(x)
+
+                def _pad_to(n):
+                    p = 8
+                    while p < n:
+                        p *= 2
+                    return p
+
+                def dispatch_mesh(n, pad):
+                    return None
+
+                def churn(items):
+                    dispatch_mesh(1, 8)
+                    n = len(items)
+                    x = np.zeros(n, dtype=np.float32)
+                    return kern(x, k=n)
+
+                def bucketed(items):
+                    dispatch_mesh(1, 8)
+                    n = _pad_to(len(items))
+                    x = np.zeros(n, dtype=np.float32)
+                    return kern(x, k=n)
+                """,
+        })
+        by = rules_of(devlint.analyze_package(pkg))
+        wheres = [f.where for f in by.get("recompile-churn", ())]
+        assert "churn.k" in wheres, by          # static arg churns
+        assert "churn.x" in wheres, wheres      # shape churns
+        assert not any(w.startswith("bucketed") for w in wheres), wheres
+
+    def test_dtype_less_ctor_feeding_kernel_flags(self, tmp_path):
+        pkg = write_files(tmp_path, {
+            "mod.py": """
+                import jax
+                import numpy as np
+
+                def _impl(x):
+                    return x
+
+                kern = jax.jit(_impl)
+
+                def dispatch_mesh(n, pad):
+                    return None
+
+                def drift():
+                    dispatch_mesh(1, 8)
+                    x = np.zeros(8)
+                    return kern(x)
+
+                def pinned():
+                    dispatch_mesh(1, 8)
+                    x = np.zeros(8, dtype=np.float32)
+                    return kern(x)
+                """,
+        })
+        by = rules_of(devlint.analyze_package(pkg))
+        wheres = [f.where for f in by.get("recompile-churn", ())]
+        assert "drift.x" in wheres, by
+        assert not any(w.startswith("pinned") for w in wheres), wheres
+
+    def test_coverage_counters_reported(self, tmp_path):
+        pkg = write_files(tmp_path, {
+            "mod.py": """
+                import jax
+
+                def _impl(x):
+                    return x
+
+                kern = jax.jit(_impl)
+
+                def _put(x):
+                    return jax.device_put(x)
+
+                def dispatch_mesh(n, pad):
+                    return None
+
+                def go(x):
+                    dispatch_mesh(1, 8)
+                    return kern(_put(x))
+                """,
+        })
+        cov: dict = {}
+        devlint.analyze_package(pkg, coverage_out=cov)
+        assert cov["kernels"] == 1
+        assert cov["kernel_call_sites"] == 1
+        assert cov["placed_args"] == 1 and cov["host_args"] == 0
+        assert cov["transfer_sites"] >= 1
+        assert "hot_functions" in cov and "waived" in cov
+
+
+# ---------------------------------------------------------------------------
+# 2. analyzer-found defect regressions
+# ---------------------------------------------------------------------------
+
+def make_eval(job):
+    return Evaluation(
+        id=generate_uuid(), priority=job.priority, type=job.type,
+        triggered_by=EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+    )
+
+
+def _cluster(n_nodes: int, n_jobs: int, count: int = 2):
+    from nomad_tpu.scheduler import Harness
+
+    h = Harness()
+    for i in range(n_nodes):
+        h.state.upsert_node(h.next_index(), mock.node(i))
+    jobs = []
+    for _ in range(n_jobs):
+        j = mock.job()
+        j.task_groups[0].count = count
+        h.state.upsert_job(h.next_index(), j)
+        jobs.append(j)
+    return h, jobs
+
+
+@pytest.mark.multichip
+class TestShardedWrapperDiscipline:
+    """Defect 1 (sharding-mix): the single-eval sharded wrappers left
+    the penalty scalar to jit — an implicit per-dispatch transfer.  The
+    wrappers now place EVERY operand, so a whole sharded dispatch from
+    raw host arrays runs under a hard transfer guard."""
+
+    def _problem(self, n_nodes=16, n_place=4):
+        from nomad_tpu.models.fleet import build_fleet, build_usage
+
+        nodes = [mock.node(i) for i in range(n_nodes)]
+        fleet = build_fleet(nodes)
+        view = build_usage(fleet, [])
+        asks = np.zeros((1, 6), dtype=np.float32)
+        asks[0] = Resources(cpu=500, memory_mb=256).as_vector()
+        feasible = np.zeros((1, fleet.n_pad), dtype=bool)
+        feasible[0, :fleet.n_real] = True
+        group_idx = np.zeros(n_place, dtype=np.int32)
+        valid = np.ones(n_place, dtype=bool)
+        distinct = np.zeros(1, dtype=bool)
+        return fleet, view, feasible, asks, distinct, group_idx, valid
+
+    def test_place_sequence_sharded_is_implicit_free(self):
+        from nomad_tpu.parallel.mesh import (fleet_mesh,
+                                             place_sequence_sharded)
+
+        fleet, view, feasible, asks, distinct, gi, valid = \
+            self._problem()
+        mesh = fleet_mesh(jax.devices("cpu"))
+        # Warm the trace, then assert the dispatch itself performs NO
+        # implicit transfer — host penalty scalar included (the
+        # pre-fix shape raised XlaRuntimeError here).
+        place_sequence_sharded(mesh, fleet.capacity, fleet.reserved,
+                               view.usage, view.job_counts, feasible,
+                               asks, distinct, gi, valid, 10.0)
+        with jax.transfer_guard("disallow"):
+            chosen, _s, _u = place_sequence_sharded(
+                mesh, fleet.capacity, fleet.reserved, view.usage,
+                view.job_counts, feasible, asks, distinct, gi, valid,
+                10.0)
+        assert (np.asarray(chosen) >= 0).all()
+
+    def test_place_rounds_sharded_is_implicit_free(self):
+        from nomad_tpu.parallel.mesh import (fleet_mesh,
+                                             place_rounds_sharded)
+
+        fleet, view, feasible, asks, distinct, _gi, _v = self._problem()
+        counts = np.asarray([4], dtype=np.int32)
+        mesh = fleet_mesh(jax.devices("cpu"))
+        kw = dict(k_cap=8, rounds=1)
+        place_rounds_sharded(mesh, fleet.capacity, fleet.reserved,
+                             view.usage, view.job_counts, feasible,
+                             asks, distinct, counts, 10.0, **kw)
+        with jax.transfer_guard("disallow"):
+            c, _s, _u = place_rounds_sharded(
+                mesh, fleet.capacity, fleet.reserved, view.usage,
+                view.job_counts, feasible, asks, distinct, counts,
+                10.0, **kw)
+        assert (np.asarray(c) >= 0).any()
+
+
+class TestLaneBucketing:
+    """Defect 2 (recompile-churn): the fused batch stacked its lanes at
+    the raw batch size — every distinct storm size retraced the vmapped
+    kernels (~0.5s each).  The lane axis now buckets to powers of two
+    like every other axis."""
+
+    def test_pad_lanes(self):
+        from nomad_tpu.scheduler.batch import pad_lanes
+
+        assert [pad_lanes(n) for n in (1, 2, 3, 4, 5, 7, 8, 9)] == \
+            [1, 2, 4, 4, 8, 8, 8, 16]
+
+    def test_fused_batch_sizes_share_one_bucket_signature(self):
+        """Batch sizes 3 and 4 land in the same lane bucket: after a
+        warm dispatch at B=4, a B=3 storm must NOT grow any batched
+        kernel's jit cache."""
+        from nomad_tpu.analysis.sanitizers import _cache_size
+        from nomad_tpu.ops import binpack
+        from nomad_tpu.parallel.mesh import mesh_override
+        from nomad_tpu.scheduler.batch import BatchEvalRunner
+        from nomad_tpu.scheduler.executor import executor_override
+
+        def run(h, jobs):
+            runner = BatchEvalRunner(h.state.snapshot(), h)
+            with mesh_override("off"), executor_override("device"):
+                runner.process([make_eval(j) for j in jobs])
+
+        h4, jobs4 = _cluster(10, 4)
+        run(h4, jobs4)
+        kernels = [binpack.place_rounds_batch,
+                   binpack.place_sequence_batch]
+        warm = [_cache_size(k) for k in kernels]
+        h3, jobs3 = _cluster(10, 3)
+        run(h3, jobs3)
+        after = [_cache_size(k) for k in kernels]
+        assert after == warm, (
+            "a 3-lane storm retraced a batched kernel after a 4-lane "
+            f"warm-up: {warm} -> {after} (lane axis must bucket)")
+        # Placements still land for the smaller batch.
+        assert sum(len(v) for p in h3.plans
+                   for v in p.node_allocation.values()) > 0
+
+
+class TestMirrorUploadDiscipline:
+    """Defect 3 (transfer-under-lock): the usage mirror uploaded its
+    fleet-sized tensor INSIDE its lock (first device use, platform
+    re-pin, and the sharded twin's install) — every concurrent worker's
+    sync queued behind a device transfer.  Uploads now happen outside
+    the lock with a revalidate-install step."""
+
+    def _mirror(self, n_nodes=8):
+        from nomad_tpu.models.fleet import build_fleet, mirror_for
+        from nomad_tpu.state.store import StateStore
+
+        store = StateStore()
+        idx = 1000
+        for i in range(n_nodes):
+            store.upsert_node(idx, mock.node(i))
+            idx += 1
+        fleet = build_fleet(list(store.nodes()))
+        mirror = mirror_for(fleet)
+        assert mirror.sync(store)
+        return store, fleet, mirror
+
+    def test_single_device_upload_runs_outside_the_lock(
+            self, monkeypatch):
+        from nomad_tpu.parallel import devices as devices_mod
+
+        _store, _fleet, mirror = self._mirror()
+        real = devices_mod.put_counted
+        seen = []
+
+        def spy(x, device=None):
+            seen.append(mirror.lock._is_owned())
+            return real(x, device)
+
+        monkeypatch.setattr(devices_mod, "put_counted", spy)
+        buf = mirror.device_usage()
+        assert seen and not any(seen), \
+            "usage upload ran while holding the mirror lock"
+        np.testing.assert_allclose(np.asarray(buf), mirror.usage)
+
+    def test_view_attachment_uploads_outside_the_lock(
+            self, monkeypatch):
+        from nomad_tpu.parallel import devices as devices_mod
+
+        store, _fleet, mirror = self._mirror()
+        real = devices_mod.put_counted
+        seen = []
+
+        def spy(x, device=None):
+            seen.append(mirror.lock._is_owned())
+            return real(x, device)
+
+        monkeypatch.setattr(devices_mod, "put_counted", spy)
+        view = mirror.view_at(store, None, "job-x")
+        assert view is not None and view.usage_device is not None
+        assert seen and not any(seen)
+        np.testing.assert_allclose(np.asarray(view.usage_device),
+                                   view.usage)
+
+    @pytest.mark.multichip
+    def test_sharded_upload_outside_lock_and_moved_mirror_refused(
+            self, monkeypatch):
+        from nomad_tpu.models.fleet import ShardedResidency
+        from nomad_tpu.parallel.mesh import fleet_mesh
+
+        _store, _fleet, mirror = self._mirror()
+        mesh = fleet_mesh(jax.devices("cpu"))
+        real = ShardedResidency.prepare
+        seen = []
+
+        def spy(self, mesh_, arrays, spec=None):
+            seen.append(mirror.lock._is_owned())
+            return real(self, mesh_, arrays, spec=spec)
+
+        monkeypatch.setattr(ShardedResidency, "prepare", spy)
+        host = mirror.usage
+        buf = mirror.device_usage_sharded(mesh, host)
+        assert buf is not None
+        assert seen and not any(seen), \
+            "sharded usage upload ran while holding the mirror lock"
+        np.testing.assert_allclose(np.asarray(buf), host)
+
+        # A mirror that moves on MID-upload must refuse the install and
+        # return None (the caller re-syncs) — never serve a stale copy.
+        mirror._sharded.clear()
+        moved = []
+
+        def mover(self, mesh_, arrays, spec=None):
+            out = real(self, mesh_, arrays, spec=spec)
+            with mirror.lock:
+                mirror.usage = mirror.usage.copy()  # simulate a sync
+            moved.append(True)
+            return out
+
+        monkeypatch.setattr(ShardedResidency, "prepare", mover)
+        assert mirror.device_usage_sharded(mesh, host) is None
+        assert moved
+
+
+# ---------------------------------------------------------------------------
+# 3. transfer discipline end-to-end
+# ---------------------------------------------------------------------------
+
+class TestDispatchSeamsImplicitFree:
+    def test_pipelined_device_stream_under_hard_guard(self):
+        """The whole pipelined device stream — prep, mirror attach,
+        dispatch, collect, finish — performs zero implicit h2d
+        transfers (the suite-wide sanitizer wraps only the dispatch
+        seams; this pins the stronger end-to-end property), and the
+        explicit odometer records the uploads that DID happen."""
+        from nomad_tpu.parallel.devices import transfer_counts
+        from nomad_tpu.scheduler.executor import executor_override
+        from nomad_tpu.scheduler.pipeline import PipelinedEvalRunner
+
+        h, jobs = _cluster(12, 4)
+        with executor_override("device"):
+            runner = PipelinedEvalRunner(h.state.snapshot(), h, depth=3)
+            runner.process([make_eval(j) for j in jobs])  # warm traces
+            before = transfer_counts()
+            h2, jobs2 = _cluster(12, 4)
+            runner2 = PipelinedEvalRunner(h2.state.snapshot(), h2,
+                                          depth=3)
+            with jax.transfer_guard_host_to_device("disallow"):
+                runner2.process([make_eval(j) for j in jobs2])
+        after = transfer_counts()
+        assert runner2.device_dispatches == len(jobs)
+        # The per-eval varying operands (usage view, job counts) still
+        # crossed — explicitly, visibly.
+        assert after["h2d"] > before["h2d"]
+
+    def test_fused_batch_under_hard_guard(self):
+        from nomad_tpu.parallel.mesh import mesh_override
+        from nomad_tpu.scheduler.batch import BatchEvalRunner
+        from nomad_tpu.scheduler.executor import executor_override
+
+        h, jobs = _cluster(10, 4)
+        with mesh_override("off"), executor_override("device"):
+            BatchEvalRunner(h.state.snapshot(), h).process(
+                [make_eval(j) for j in jobs])  # warm
+            h2, jobs2 = _cluster(10, 4)
+            with jax.transfer_guard_host_to_device("disallow"):
+                BatchEvalRunner(h2.state.snapshot(), h2).process(
+                    [make_eval(j) for j in jobs2])
+        placed = sum(len(v) for p in h2.plans
+                     for v in p.node_allocation.values())
+        assert placed > 0
+
+    def test_transfer_guard_sanitizer_catches_a_leak(self):
+        """The sanitizer has teeth: a seam that commits a host array
+        implicitly fails inside the guard scope."""
+        from nomad_tpu.analysis.sanitizers import TransferGuardSanitizer
+
+        class FakeSeamHost:
+            def dispatch(self, x):
+                return jax.jit(lambda a: a + 1)(x)
+
+        sanitizer = TransferGuardSanitizer(
+            seams=((__name__, None, "_leaky"),))
+        # Wrap a module-level function in THIS module.
+        global _leaky
+
+        def _leaky(x):
+            return jax.jit(lambda a: a + 1)(x)
+
+        with sanitizer:
+            import sys
+            wrapped = getattr(sys.modules[__name__], "_leaky")
+            with pytest.raises(Exception, match="[Dd]isallowed"):
+                wrapped(np.ones(4, dtype=np.float32))
+        # Uninstalled: implicit commits pass again.
+        _leaky(np.ones(4, dtype=np.float32))

@@ -1,0 +1,474 @@
+"""Port of the reference scheduler's feasibility/rank tables
+(scheduler/feasible_test.go + scheduler/rank_test.go), asserted against
+BOTH execution paths:
+
+  - the **host truth** — the sequential iterators
+    (scheduler/feasible.py StaticIterator/DriverIterator/
+    ConstraintIterator, scheduler/rank.py BinPackIterator/
+    JobAntiAffinityIterator) and the scalar predicates
+    (utils/predicates, structs.score_fit);
+  - the **jax-binpack paths** — the compiled constraint mask
+    (models/constraints.compile_group_mask) and the vectorized scoring
+    kernel (ops/binpack.score_all_nodes), which must agree
+    node-for-node / score-for-score with the iterators by construction.
+
+Each table is the reference's case set re-expressed over the repo's
+node/alloc mocks; where the Go test asserted an exact iterator output
+order or score, so do we.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.models.fleet import NDIMS, build_fleet, build_usage
+from nomad_tpu.models.constraints import compile_group_mask
+from nomad_tpu.ops.binpack import NEG_INF, score_all_nodes
+from nomad_tpu.scheduler.context import EvalContext
+from nomad_tpu.scheduler.feasible import (
+    ConstraintIterator,
+    DriverIterator,
+    StaticIterator,
+    check_single_constraint,
+)
+from nomad_tpu.scheduler.rank import (
+    BinPackIterator,
+    FeasibleRankIterator,
+    JobAntiAffinityIterator,
+    RankedNode,
+    StaticRankIterator,
+)
+from nomad_tpu.structs import (
+    Allocation,
+    Constraint,
+    Node,
+    Resources,
+    Task,
+    score_fit,
+)
+from nomad_tpu.utils.predicates import (
+    check_constraint_values,
+    resolve_constraint_target,
+)
+
+
+class _State:
+    """Minimal EvalContext state: allocs_by_node only."""
+
+    def __init__(self) -> None:
+        self.by_node: dict = {}
+
+    def allocs_by_node(self, node_id: str) -> list:
+        return list(self.by_node.get(node_id, []))
+
+
+def _ctx(state=None, plan=None) -> EvalContext:
+    from nomad_tpu.structs import Plan
+
+    return EvalContext(state or _State(), plan or Plan())
+
+
+def _drain(it) -> list:
+    out = []
+    while True:
+        n = it.next()
+        if n is None:
+            return out
+        out.append(n)
+
+
+def _mask_for(nodes, constraints, drivers=(), datacenters=("dc1",)):
+    """The device path's verdict vector for the same predicate set."""
+    fleet = build_fleet(nodes)
+    mask, _dist = compile_group_mask(fleet, list(datacenters),
+                                     list(constraints), [],
+                                     set(drivers))
+    return mask[:fleet.n_real]
+
+
+# ---------------------------------------------------------------------------
+# feasible_test.go
+# ---------------------------------------------------------------------------
+
+class TestStaticIteratorPort:
+    def test_static_iterator_serves_all_then_exhausts(self):
+        # TestStaticIterator_Reset semantics: N nodes out, then None.
+        ctx = _ctx()
+        nodes = [mock.node(i) for i in range(3)]
+        it = StaticIterator(ctx, nodes)
+        assert _drain(it) == nodes
+        assert it.next() is None
+
+    def test_static_iterator_reset(self):
+        ctx = _ctx()
+        nodes = [mock.node(i) for i in range(3)]
+        it = StaticIterator(ctx, nodes)
+        _drain(it)
+        it.reset()
+        assert len(_drain(it)) == 3
+
+    def test_static_iterator_set_nodes(self):
+        ctx = _ctx()
+        it = StaticIterator(ctx, [mock.node(0)])
+        _drain(it)
+        fresh = [mock.node(i) for i in range(2)]
+        it.set_nodes(fresh)
+        assert _drain(it) == fresh
+
+
+class TestDriverIteratorPort:
+    def test_driver_truthiness_table(self):
+        """TestDriverIterator: driver.<name> parse-bools per node —
+        "1"/"true"/"T" admit, "0"/"false"/missing reject — and the
+        compiled mask agrees node-for-node."""
+        values = ["1", "0", "true", "False", None, "T"]
+        expect = [True, False, True, False, False, True]
+        nodes = []
+        for i, v in enumerate(values):
+            n = mock.node(i)
+            n.attributes = dict(n.attributes)
+            n.attributes.pop("driver.exec", None)
+            if v is not None:
+                n.attributes["driver.exec"] = v
+            nodes.append(n)
+
+        ctx = _ctx()
+        it = DriverIterator(ctx, StaticIterator(ctx, nodes), ["exec"])
+        got = _drain(it)
+        assert got == [n for n, ok in zip(nodes, expect) if ok]
+
+        mask = _mask_for(nodes, [], drivers=("exec",))
+        assert mask.tolist() == expect
+
+    def test_multiple_drivers_all_required(self):
+        n_both = mock.node(0)
+        n_both.attributes = dict(n_both.attributes,
+                                 **{"driver.docker": "1"})
+        n_one = mock.node(1)
+        nodes = [n_both, n_one]
+        ctx = _ctx()
+        it = DriverIterator(ctx, StaticIterator(ctx, nodes),
+                            ["exec", "docker"])
+        assert _drain(it) == [n_both]
+        assert _mask_for(nodes, [], drivers=("exec", "docker")).tolist() \
+            == [True, False]
+
+
+class TestConstraintIteratorPort:
+    def _nodes(self):
+        # TestConstraintIterator's shape: one matching node, one with a
+        # different value, one missing the attribute entirely.
+        a = mock.node(0)
+        b = mock.node(1)
+        b.attributes = dict(b.attributes, **{"kernel.name": "darwin"})
+        c = mock.node(2)
+        c.attributes = {k: v for k, v in c.attributes.items()
+                        if k != "kernel.name"}
+        return [a, b, c]
+
+    def test_equality_constraint(self):
+        nodes = self._nodes()
+        cons = [Constraint(hard=True, l_target="$attr.kernel.name",
+                           operand="=", r_target="linux")]
+        ctx = _ctx()
+        it = ConstraintIterator(ctx, StaticIterator(ctx, nodes), cons)
+        assert _drain(it) == [nodes[0]]
+        assert _mask_for(nodes, cons).tolist() == [True, False, False]
+
+    def test_soft_constraint_does_not_filter(self):
+        nodes = self._nodes()
+        cons = [Constraint(hard=False, l_target="$attr.kernel.name",
+                           operand="=", r_target="linux")]
+        ctx = _ctx()
+        it = ConstraintIterator(ctx, StaticIterator(ctx, nodes), cons)
+        assert _drain(it) == nodes
+
+    @pytest.mark.parametrize("operand,r_target,expect", [
+        ("!=", "linux", [False, True, False]),   # missing attr: infeasible
+        ("regexp", "^lin", [True, False, False]),
+        ("version", ">= 0.1.0", [True, True, True]),
+        ("version", "> 0.2.0", [False, False, False]),
+        ("<", "zzz", [True, True, True]),        # lexical order on names
+    ])
+    def test_operand_table_host_vs_mask(self, operand, r_target, expect):
+        nodes = self._nodes()
+        l_target = "$attr.kernel.name" if operand in ("!=", "regexp") \
+            else ("$attr.version" if operand == "version"
+                  else "$node.name")
+        cons = [Constraint(hard=True, l_target=l_target,
+                           operand=operand, r_target=r_target)]
+        ctx = _ctx()
+        it = ConstraintIterator(ctx, StaticIterator(ctx, nodes), cons)
+        admitted = _drain(it)
+        got = [n in admitted for n in nodes]
+        verdicts = [check_single_constraint(_ctx(), cons[0], n)
+                    for n in nodes]
+        assert verdicts == expect, (operand, r_target)
+        assert got == expect
+        assert _mask_for(nodes, cons).tolist() == expect
+
+    def test_distinct_hosts_against_proposed_allocs(self):
+        """ProposedAllocConstraintIterator semantics: feasible iff no
+        proposed alloc of the job is on the node (evictions honored)."""
+        from nomad_tpu.structs import CONSTRAINT_DISTINCT_HOSTS, Plan
+
+        node = mock.node(0)
+        other = mock.node(1)
+        a = mock.alloc()
+        a.node_id = node.id
+        state = _State()
+        state.by_node[node.id] = [a]
+        cons = Constraint(hard=True, operand=CONSTRAINT_DISTINCT_HOSTS,
+                          l_target="", r_target=a.job_id)
+        ctx = _ctx(state)
+        assert check_single_constraint(ctx, cons, node) is False
+        assert check_single_constraint(ctx, cons, other) is True
+        # Planned eviction frees the node.
+        plan = Plan()
+        plan.node_update[node.id] = [a]
+        ctx2 = _ctx(state, plan)
+        assert check_single_constraint(ctx2, cons, node) is True
+
+
+class TestCheckConstraintValuesPort:
+    """TestCheckConstraint / TestCheckVersionConstraint /
+    TestCheckRegexpConstraint operand tables."""
+
+    @pytest.mark.parametrize("operand,l,r,expect", [
+        ("=", "foo", "foo", True),
+        ("==", "foo", "foo", True),
+        ("is", "foo", "foo", True),
+        ("=", "foo", "bar", False),
+        ("!=", "foo", "bar", True),
+        ("not", "foo", "foo", False),
+        ("<", "abc", "abd", True),
+        (">", "abc", "abd", False),
+        ("<=", "abc", "abc", True),
+        (">=", "abc", "abc", True),
+        ("<", "abc", 3, False),           # non-string lexical: infeasible
+        ("bogus-operand", "a", "a", False),
+    ])
+    def test_basic_operands(self, operand, l, r, expect):
+        assert check_constraint_values(_ctx(), operand, l, r) is expect
+
+    @pytest.mark.parametrize("version,constraint,expect", [
+        ("0.7.0", "= 0.7.0", True),
+        ("0.7.0", "!= 0.7.0", False),
+        ("0.6.9", "< 0.7.0", True),
+        ("0.7.0", ">= 0.6.0, < 0.8.0", True),
+        ("0.8.0", ">= 0.6.0, < 0.8.0", False),
+        ("1.7.0-beta", "> 1.6.0", True),
+        ("1.7.0-beta", ">= 1.7.0", False),  # prerelease sorts below
+        ("not-a-version", "> 0.1.0", False),
+    ])
+    def test_version_operand(self, version, constraint, expect):
+        assert check_constraint_values(
+            _ctx(), "version", version, constraint) is expect
+
+    @pytest.mark.parametrize("value,pattern,expect", [
+        ("linux", "lin", True),
+        ("linux", "^lin", True),
+        ("linux", "^win", False),
+        ("linux", "(", False),            # invalid pattern: infeasible
+        (3, "3", False),                  # non-string value: infeasible
+    ])
+    def test_regexp_operand(self, value, pattern, expect):
+        assert check_constraint_values(
+            _ctx(), "regexp", value, pattern) is expect
+
+    def test_resolve_targets(self):
+        node = mock.node(0)
+        assert resolve_constraint_target("$node.id", node) == \
+            (node.id, True)
+        assert resolve_constraint_target("$node.datacenter", node) == \
+            ("dc1", True)
+        assert resolve_constraint_target("$attr.arch", node) == \
+            ("x86", True)
+        assert resolve_constraint_target("$meta.pci-dss", node) == \
+            ("true", True)
+        assert resolve_constraint_target("$attr.nope", node)[1] is False
+        assert resolve_constraint_target("literal", node) == \
+            ("literal", True)
+
+
+# ---------------------------------------------------------------------------
+# rank_test.go
+# ---------------------------------------------------------------------------
+
+def _bare_node(idx: int, cpu: int, mem: int) -> Node:
+    """A rank-table node with NO reservations (the Go tables' shape)."""
+    n = mock.node(idx)
+    n.resources = Resources(cpu=cpu, memory_mb=mem,
+                            disk_mb=100 * 1024, iops=150)
+    n.reserved = None
+    return n
+
+
+def _task(cpu: int, mem: int) -> Task:
+    return Task(name="web", driver="exec",
+                resources=Resources(cpu=cpu, memory_mb=mem))
+
+
+def _device_scores(nodes, ask_cpu, ask_mem, proposed=None,
+                   job_counts=None, penalty=0.0):
+    """score_all_nodes over the same fleet: the kernel's masked scores
+    for one ask, NEG_INF where infeasible."""
+    fleet = build_fleet(nodes)
+    view = build_usage(fleet, proposed or [])
+    ask = np.zeros(NDIMS, dtype=np.float32)
+    ask[0], ask[1] = ask_cpu, ask_mem
+    feasible = np.zeros(fleet.n_pad, dtype=bool)
+    feasible[:fleet.n_real] = True
+    jc = np.zeros(fleet.n_pad, dtype=np.int32)
+    if job_counts:
+        for i, c in job_counts.items():
+            jc[i] = c
+    out = score_all_nodes(fleet.capacity, fleet.reserved, view.usage,
+                          jc, ask, feasible, False,
+                          np.float32(penalty))
+    return np.asarray(out)[:fleet.n_real]
+
+
+class TestFeasibleRankIteratorPort:
+    def test_upgrades_nodes_to_ranked(self):
+        ctx = _ctx()
+        nodes = [mock.node(i) for i in range(3)]
+        it = FeasibleRankIterator(ctx, StaticIterator(ctx, nodes))
+        out = _drain(it)
+        assert [r.node for r in out] == nodes
+        assert all(isinstance(r, RankedNode) and r.score == 0.0
+                   for r in out)
+
+
+class TestBinPackIteratorPort:
+    def test_no_existing_allocs_scores_and_fit(self):
+        """TestBinPackIterator_NoExistingAlloc: a half-fitting ask on an
+        empty node vs a too-small node — the small node is exhausted,
+        the exactly-full node is a PERFECT fit (score 18, the BestFit
+        ceiling), and the device kernel produces the SAME scores for
+        the same utils."""
+        empty = _bare_node(0, 2048, 2048)
+        exact = _bare_node(1, 1024, 1024)
+        small = _bare_node(2, 512, 512)
+        ctx = _ctx()
+        it = BinPackIterator(ctx, StaticRankIterator(
+            ctx, [RankedNode(empty), RankedNode(exact),
+                  RankedNode(small)]))
+        it.set_tasks([_task(1024, 1024)])
+        out = _drain(it)
+        assert [r.node for r in out] == [empty, exact]
+
+        want = score_fit(empty, Resources(cpu=1024, memory_mb=1024))
+        assert out[0].score == pytest.approx(want)
+        # 50% free on both dims: 20 - 2*10^0.5.
+        assert want == pytest.approx(20.0 - 2.0 * 10.0 ** 0.5)
+        assert out[1].score == pytest.approx(18.0)  # perfect fit caps
+
+        dev = _device_scores([empty, exact, small], 1024, 1024)
+        assert dev[0] == pytest.approx(want, rel=1e-6)
+        assert dev[1] == pytest.approx(18.0)
+        assert dev[2] == NEG_INF  # masked infeasible, like the iterator
+
+    def test_existing_alloc_counts_against_fit(self):
+        """TestBinPackIterator_ExistingAlloc: a proposed alloc holding
+        half the node leaves no room for a second half+1 ask."""
+        node = _bare_node(0, 1024, 1024)
+        held = Allocation(id="held", node_id=node.id, job_id="other",
+                          resources=Resources(cpu=512, memory_mb=512))
+        state = _State()
+        state.by_node[node.id] = [held]
+        ctx = _ctx(state)
+        it = BinPackIterator(ctx, StaticRankIterator(
+            ctx, [RankedNode(node)]))
+        it.set_tasks([_task(1024, 1024)])
+        assert _drain(it) == []
+
+        # Device path: same usage fold, same verdict.
+        dev = _device_scores([node], 1024, 1024, proposed=[held])
+        assert dev[0] == NEG_INF
+
+    def test_planned_evict_frees_capacity(self):
+        """TestBinPackIterator_ExistingAlloc_PlannedEvict: evicting the
+        held alloc in the plan makes the node feasible again."""
+        from nomad_tpu.structs import Plan
+
+        node = _bare_node(0, 1024, 1024)
+        held = Allocation(id="held", node_id=node.id, job_id="other",
+                          resources=Resources(cpu=512, memory_mb=512))
+        state = _State()
+        state.by_node[node.id] = [held]
+        plan = Plan()
+        plan.node_update[node.id] = [held]
+        ctx = _ctx(state, plan)
+        it = BinPackIterator(ctx, StaticRankIterator(
+            ctx, [RankedNode(node)]))
+        it.set_tasks([_task(1024, 1024)])
+        out = _drain(it)
+        assert [r.node for r in out] == [node]
+        want = score_fit(node, Resources(cpu=1024, memory_mb=1024))
+        assert out[0].score == pytest.approx(want)
+
+        dev = _device_scores([node], 1024, 1024, proposed=[])
+        assert dev[0] == pytest.approx(want, rel=1e-6)
+
+    def test_scores_prefer_packed_node(self):
+        """BestFit v3 prefers the node that ends up fuller — the
+        iterator's ordering and the kernel's argmax agree."""
+        fresh = _bare_node(0, 4096, 4096)
+        busy = _bare_node(1, 4096, 4096)
+        held = Allocation(id="h", node_id=busy.id, job_id="other",
+                          resources=Resources(cpu=2048, memory_mb=2048))
+        state = _State()
+        state.by_node[busy.id] = [held]
+        ctx = _ctx(state)
+        it = BinPackIterator(ctx, StaticRankIterator(
+            ctx, [RankedNode(fresh), RankedNode(busy)]))
+        it.set_tasks([_task(1024, 1024)])
+        out = {r.node.id: r.score for r in _drain(it)}
+        assert out[busy.id] > out[fresh.id]
+
+        dev = _device_scores([fresh, busy], 1024, 1024, proposed=[held])
+        assert int(np.argmax(dev)) == 1
+        assert dev[1] == pytest.approx(out[busy.id], rel=1e-6)
+        assert dev[0] == pytest.approx(out[fresh.id], rel=1e-6)
+
+
+class TestJobAntiAffinityPort:
+    def test_planned_alloc_penalized(self):
+        """TestJobAntiAffinity_PlannedAlloc: two same-job proposed
+        allocs on a node score -2*penalty; an uninvolved node scores
+        0 — and the kernel's job_counts term applies the SAME
+        penalty."""
+        from nomad_tpu.structs import Plan
+
+        crowded = _bare_node(0, 4096, 4096)
+        empty = _bare_node(1, 4096, 4096)
+        job_id = "job-under-test"
+        plan = Plan()
+        plan.node_allocation[crowded.id] = [
+            Allocation(id=f"p{i}", node_id=crowded.id, job_id=job_id,
+                       resources=Resources(cpu=1, memory_mb=1))
+            for i in range(2)]
+        ctx = _ctx(_State(), plan)
+        penalty = 50.0
+        it = JobAntiAffinityIterator(
+            ctx, StaticRankIterator(
+                ctx, [RankedNode(crowded), RankedNode(empty)]),
+            penalty, job_id)
+        out = _drain(it)
+        assert out[0].score == pytest.approx(-2 * penalty)
+        assert out[1].score == 0.0
+
+        # Device path: the same -penalty * job_counts term, on top of
+        # the binpack score for the same (tiny) ask.
+        dev = _device_scores([crowded, empty], 1, 1,
+                             proposed=list(
+                                 plan.node_allocation[crowded.id]),
+                             job_counts={0: 2}, penalty=penalty)
+        base_crowded = score_fit(
+            crowded, Resources(cpu=2 + 1, memory_mb=2 + 1))
+        base_empty = score_fit(empty, Resources(cpu=1, memory_mb=1))
+        assert dev[0] == pytest.approx(base_crowded - 2 * penalty,
+                                       rel=1e-5)
+        assert dev[1] == pytest.approx(base_empty, rel=1e-5)

@@ -43,6 +43,33 @@ def wait_for_leader(servers, timeout=5.0) -> Server:
     raise AssertionError("no single leader elected")
 
 
+def wait_for_stable_leader(servers, timeout=30.0,
+                           stable_polls=5) -> Server:
+    """A leader that HOLDS leadership across ``stable_polls``
+    consecutive observations.  Under host load, election RPCs and
+    ticker threads get starved and leadership can flap between
+    wait_for_leader's single-instant polls — the documented chaos-soak
+    leader-flap flake.  The soak tests need a leader that survived a
+    whole observation window, with a load-tolerant deadline, not a
+    lucky single sample."""
+    deadline = time.monotonic() + timeout
+    candidate, streak = None, 0
+    while time.monotonic() < deadline:
+        leaders = [s for s in servers if s.raft.is_leader()]
+        if len(leaders) == 1 and leaders[0].is_leader():
+            if leaders[0] is candidate:
+                streak += 1
+                if streak >= stable_polls:
+                    return candidate
+            else:
+                candidate, streak = leaders[0], 1
+        else:
+            candidate, streak = None, 0
+        time.sleep(0.05)  # sleep-ok: poll interval of the bounded wait
+    raise AssertionError("no stable single leader within "
+                         f"{timeout}s (last candidate {candidate})")
+
+
 @pytest.fixture
 def pool():
     p = ConnPool()

@@ -571,6 +571,131 @@ class TestLintGate:
                             or "registry" in f.path)
                        for f in gating)
 
+    def test_changed_mode_covers_devlint(self, tmp_path, capsys):
+        """`lint -changed REV` reports device-plane findings in touched
+        files and filters pre-existing ones — devlint rides the same
+        pre-push loop as every other pass."""
+        import subprocess
+        import textwrap as _tw
+
+        from nomad_tpu.cli.main import main
+
+        def git(*args):
+            subprocess.run(["git", "-C", str(tmp_path), *args],
+                           check=True, capture_output=True,
+                           env={"GIT_AUTHOR_NAME": "t",
+                                "GIT_AUTHOR_EMAIL": "t@t",
+                                "GIT_COMMITTER_NAME": "t",
+                                "GIT_COMMITTER_EMAIL": "t@t",
+                                "HOME": str(tmp_path),
+                                "PATH": os.environ.get("PATH", "")})
+
+        bad = _tw.dedent("""
+            import jax
+
+            def _impl(x):
+                return x
+
+            kern = jax.jit(_impl)
+            """)
+        bad_caller = _tw.dedent("""
+            from pkg.kern import kern
+
+            def _put(x):
+                import jax
+                return jax.device_put(x)
+
+            def bypass(x):
+                return kern(_put(x))
+            """)
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "kern.py").write_text(bad)
+        (pkg / "untouched.py").write_text(
+            bad_caller.replace("def bypass", "def old_bypass"))
+        (pkg / "touched.py").write_text("def ok():\n    return 1\n")
+        git("init", "-q")
+        git("add", "-A")
+        git("commit", "-qm", "base")
+        (pkg / "touched.py").write_text(bad_caller)
+        rc = main(["lint", str(pkg), "-changed", "HEAD",
+                   "-allowlist", str(tmp_path / "none.txt")])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "touched.py" in out and "mesh-bypass" in out
+        assert "untouched.py" not in out, \
+            "changed-mode must filter pre-existing devlint findings"
+
+    def test_device_plane_rides_the_gates(self):
+        """ISSUE 15 tentpole: the device-plane passes
+        (analysis/devlint.py) cover the whole device core — the jit
+        kernels (ops/binpack.py, parallel/mesh.py), the dispatch seams
+        (scheduler/jax_binpack.py, scheduler/batch.py,
+        scheduler/pipeline.py), and the residency plane
+        (models/fleet.py, parallel/devices.py) — strict-clean, with
+        ZERO allowlist entries of their own and the kernels actually
+        discovered (a pass that finds no kernels gates nothing)."""
+        from nomad_tpu.analysis import default_package_root
+        from nomad_tpu.analysis import devlint
+        from nomad_tpu.analysis.callgraph import CallGraph
+
+        pkg = default_package_root()
+        graph = CallGraph.build(pkg)
+        for qual in (
+            "nomad_tpu.scheduler.jax_binpack:"
+            "JaxBinPackScheduler.dispatch_device",
+            "nomad_tpu.scheduler.jax_binpack:"
+            "JaxBinPackScheduler._dispatch_device_sharded",
+            "nomad_tpu.scheduler.batch:BatchEvalRunner._process",
+            "nomad_tpu.models.fleet:UsageMirror.device_usage_sharded",
+            "nomad_tpu.models.fleet:UsageMirror._attach_device",
+            "nomad_tpu.models.fleet:ShardedResidency.prepare",
+            "nomad_tpu.parallel.devices:put_counted",
+            "nomad_tpu.parallel.devices:fetch_host",
+            "nomad_tpu.parallel.mesh:place_sequence_sharded",
+        ):
+            assert qual in graph.functions, \
+                f"{qual} missing from the interprocedural graph"
+
+        cov: dict = {}
+        findings = devlint.analyze_package(pkg, graph=graph,
+                                           coverage_out=cov)
+        # The pass sees the real kernel family (4 unsharded binpack
+        # kernels + the sharded twins + the mirror scatter) and judges
+        # every dispatch operand placed.
+        assert cov["kernels"] >= 8, cov
+        assert cov["kernel_call_sites"] >= 6, cov
+        assert cov["host_args"] == 0, cov
+        assert cov["placed_args"] > 0 and cov["transfer_sites"] > 0
+        assert findings == [], "device plane must lint clean:\n" + \
+            "\n".join(f.render() for f in findings)
+        allowlist = load_allowlist(default_allowlist_path())
+        for rule in ("mesh-bypass", "resident-bypass", "sharding-mix",
+                     "transfer-under-lock", "transfer-in-hot-loop",
+                     "recompile-churn"):
+            assert not any(e.startswith(rule + ":") for e in allowlist), \
+                f"device-plane rule {rule} must not need allowlist " \
+                "entries (use a justified in-code devlint-ok marker)"
+
+    def test_lint_json_reports_devlint_coverage(self, capsys):
+        """The device-plane passes' self-coverage rides the same -json
+        block as the call graph's (blind spots visible, not silent)."""
+        import json as _json
+
+        from nomad_tpu.cli.main import main
+
+        assert main(["lint", "-json"]) == 0
+        doc = _json.loads(capsys.readouterr().out)
+        dev = doc["coverage"]["devlint"]
+        assert set(dev) >= {"kernels", "kernel_call_sites",
+                            "placed_args", "host_args",
+                            "transfer_sites", "hot_functions",
+                            "waived"}
+        assert dev["kernels"] > 0 and dev["host_args"] == 0
+        # The one deliberate under-lock site (the mirror's bounded
+        # scatter maintenance) is marker-waived AND counted.
+        assert dev["waived"] >= 1
+
     def test_fixed_sleep_ratchet_is_clean(self):
         """Every fixed time.sleep in the test tree is either converted
         to wait_until or carries a '# sleep-ok: why' justification —
