@@ -113,7 +113,8 @@ def run_lint(package_dir: Optional[str] = None,
     resolved vs dynamic) — the analyzer's own blind spots, surfaced in
     ``nomad-tpu lint --json`` instead of silent.
     """
-    from . import blocking, callgraph, devlint, jaxlint, lockcheck
+    from . import (blocking, callgraph, consensuslint, devlint, jaxlint,
+                   lockcheck)
 
     package_dir = package_dir or default_package_root()
     if not os.path.isdir(package_dir):
@@ -132,6 +133,10 @@ def run_lint(package_dir: Optional[str] = None,
     findings.extend(devlint.analyze_package(package_dir, graph=graph,
                                             scan=scan,
                                             coverage_out=dev_cov))
+    cons_cov: dict = {}
+    findings.extend(consensuslint.analyze_package(package_dir, graph=graph,
+                                                  scan=scan,
+                                                  coverage_out=cons_cov))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     if coverage_out is not None:
         coverage_out.update(graph.coverage())
@@ -139,6 +144,10 @@ def run_lint(package_dir: Optional[str] = None,
         # operands judged placed vs host, transfer sites, hot-path
         # closure size, marker-waived sites) rides the same JSON block.
         coverage_out["devlint"] = dev_cov
+        # The consensus-plane passes' self-coverage: apply-closure
+        # size, fence targets, and the endpoint read-consistency
+        # contract table (ROADMAP item 1's machine-readable input).
+        coverage_out["consensuslint"] = cons_cov
     return findings
 
 

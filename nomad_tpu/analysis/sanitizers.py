@@ -408,3 +408,214 @@ class RecompileSentinel:
                 "shape stopped hitting its power-of-two bucket or a new "
                 "call site passes unbucketed shapes (see "
                 "nomad_tpu/ops/binpack.py docstring)")
+
+
+class ReplicaDivergenceSanitizer:
+    """Shadow-replica twin: the runtime proof of apply determinism.
+
+    While installed, every ``NomadFSM`` constructed carries a hidden
+    in-proc twin (no broker, no hooks, no trace spans).  Each raft
+    entry the primary applies is re-applied to the twin, and
+    ``store.fingerprint()`` is byte-compared at commit quiescence
+    points — the first few applies (including the first applies after a
+    ``restore``, which resets the count; restore itself compares lazily
+    so fingerprinting doesn't materialize freshly restored columnar
+    slabs), every ``interval`` thereafter, and at each test's teardown
+    (``compare_all`` via conftest).  Any nondeterminism the static
+    consensuslint pass can't
+    see (a hash-order walk that escaped the AST patterns, a
+    time-dependent value smuggled through a helper) diverges the twin
+    and fails the test that caused it.
+
+    Tests that seed state by writing the primary's store DIRECTLY
+    (bypassing the raft log) would falsely diverge the twin, so each
+    store counts its write-method commits (``_bump``) while the
+    sanitizer is installed: a primary/twin commit-count mismatch means
+    out-of-band writes, and that FSM's pair is dropped from comparison
+    (counted in ``desynced``, not silent) instead of reported.
+
+    Divergence raises inside the offending apply AND is recorded for
+    ``check()`` at session teardown — a raise swallowed by a raft
+    apply loop still fails the session.
+    """
+
+    def __init__(self, interval: int = 64) -> None:
+        self.interval = interval
+        self.mismatches: list = []
+        self.desynced = 0
+        self.compared = 0
+        self._installed = False
+        self._saved: list = []
+        self._fsms: list = []     # weakrefs of primaries
+        self._reg_lock = threading.Lock()
+        self._tls = threading.local()
+
+    # -- install/uninstall --------------------------------------------------
+    def install(self) -> "ReplicaDivergenceSanitizer":
+        if self._installed:
+            return self
+        import weakref
+
+        from nomad_tpu.server.fsm import NomadFSM
+        from nomad_tpu.state.store import StateStore
+
+        san = self
+        orig_init = NomadFSM.__init__
+        orig_apply = NomadFSM.apply
+        orig_restore = NomadFSM.restore
+        orig_bump = StateStore._bump
+        self._saved = [(NomadFSM, "__init__", orig_init),
+                       (NomadFSM, "apply", orig_apply),
+                       (NomadFSM, "restore", orig_restore),
+                       (StateStore, "_bump", orig_bump)]
+
+        def counted_bump(store, table, index):
+            store._sanitizer_bumps = \
+                getattr(store, "_sanitizer_bumps", 0) + 1
+            return orig_bump(store, table, index)
+
+        def init(fsm, *args, **kwargs):
+            orig_init(fsm, *args, **kwargs)
+            if getattr(san._tls, "constructing", False):
+                return          # this IS a twin being built
+            san._tls.constructing = True
+            try:
+                twin = NomadFSM()
+            finally:
+                san._tls.constructing = False
+            # Shadow the span recorder on the twin: the obs plane's
+            # exactly-once apply-span accounting must see each entry
+            # once, not once per replica.
+            twin._record_apply_spans = _noop_spans
+            fsm._divergence_twin = twin
+            fsm._divergence_lock = _real_lock()
+            fsm._divergence_applied = 0
+            with san._reg_lock:
+                san._fsms.append(weakref.ref(fsm))
+
+        def apply(fsm, index, entry):
+            twin = getattr(fsm, "_divergence_twin", None)
+            if twin is None:
+                return orig_apply(fsm, index, entry)
+            with fsm._divergence_lock:
+                try:
+                    result = orig_apply(fsm, index, entry)
+                except BaseException:
+                    # A deterministic rejection must hit the twin too,
+                    # or the next compare reports a skew that isn't
+                    # nondeterminism.
+                    try:
+                        orig_apply(twin, index, entry)
+                    except BaseException:
+                        pass
+                    raise
+                try:
+                    orig_apply(twin, index, entry)
+                except BaseException as e:
+                    san._report(
+                        fsm, index,
+                        f"shadow twin raised {e!r} on an entry the "
+                        f"primary applied cleanly")
+                fsm._divergence_applied += 1
+                n = fsm._divergence_applied
+                if n <= 4 or n % san.interval == 0:
+                    san._compare(fsm, twin, index)
+                return result
+
+        def restore(fsm, blob):
+            twin = getattr(fsm, "_divergence_twin", None)
+            if twin is None:
+                return orig_restore(fsm, blob)
+            with fsm._divergence_lock:
+                result = orig_restore(fsm, blob)
+                orig_restore(twin, blob)
+                fsm._divergence_applied = 0
+                # No eager compare here: fingerprint() would materialize
+                # the freshly restored columnar slabs, destroying the
+                # lazy-restore property tests assert on.  The first
+                # post-restore applies and the per-test teardown sweep
+                # compare the restored pair instead.
+                return result
+
+        NomadFSM.__init__ = init
+        NomadFSM.apply = apply
+        NomadFSM.restore = restore
+        StateStore._bump = counted_bump
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for holder, attr, fn in self._saved:
+            setattr(holder, attr, fn)
+        self._saved = []
+        self._installed = False
+
+    def __enter__(self) -> "ReplicaDivergenceSanitizer":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # -- comparison ---------------------------------------------------------
+    def _compare(self, fsm, twin, index: int) -> None:
+        p_bumps = getattr(fsm.state, "_sanitizer_bumps", 0)
+        t_bumps = getattr(twin.state, "_sanitizer_bumps", 0)
+        if p_bumps != t_bumps:
+            # Out-of-band direct store writes (test seeding): this
+            # pair can never agree again; drop it, visibly.
+            fsm._divergence_twin = None
+            self.desynced += 1
+            return
+        self.compared += 1
+        a = fsm.state.fingerprint()
+        b = twin.state.fingerprint()
+        if a != b:
+            self._report(
+                fsm, index,
+                f"primary fingerprint {a[:16]}… != shadow twin "
+                f"{b[:16]}… after identical entries")
+
+    def _report(self, fsm, index: int, detail: str) -> None:
+        # One report per pair: a diverged twin stays diverged, so drop
+        # it rather than re-reporting at every later quiescence point.
+        fsm._divergence_twin = None
+        where = "restore" if index < 0 else f"index {index}"
+        msg = (f"replica divergence at {where}: {detail} — the apply "
+               f"path consumed a nondeterministic input (wall clock, "
+               f"RNG, host env, or hash-order); see "
+               f"analysis/consensuslint.py rules")
+        self.mismatches.append(msg)
+        raise AssertionError(msg)
+
+    def compare_all(self) -> None:
+        """Quiescence-point sweep (per-test teardown): fingerprint every
+        live pair; raises on the first divergence found."""
+        if not self._installed:
+            return
+        with self._reg_lock:
+            refs = list(self._fsms)
+            self._fsms = [r for r in refs if r() is not None]
+        for ref in refs:
+            fsm = ref()
+            if fsm is None:
+                continue
+            twin = getattr(fsm, "_divergence_twin", None)
+            if twin is None:
+                continue
+            with fsm._divergence_lock:
+                self._compare(fsm, twin, index=fsm._divergence_applied)
+
+    def check(self) -> None:
+        """Session-teardown catch-all: any recorded divergence — even
+        one whose in-apply raise was swallowed by a raft loop — fails
+        the session."""
+        if self.mismatches:
+            raise AssertionError(
+                "replica divergence observed during the session:\n" +
+                "\n".join(f"  - {m}" for m in self.mismatches))
+
+
+def _noop_spans(*args, **kwargs) -> None:
+    return None

@@ -768,6 +768,11 @@ def cmd_lint(args) -> int:
 
     if args.as_json:
         print(json.dumps({
+            # Bumped when the JSON shape changes incompatibly (keys
+            # removed/renamed); additive coverage blocks don't bump it.
+            # v2 = schema_version + the consensuslint coverage block
+            # with the endpoint read-consistency contract table.
+            "schema_version": 2,
             "gating": [f.__dict__ for f in gating],
             "advisory": [f.__dict__ for f in advisory],
             "allowlisted": len(allowed),

@@ -97,6 +97,10 @@ class NomadFSM:
         if self.on_entry is not None:
             self.on_entry(index, bytes(entry))
         msg_type, payload, ignorable = codec.decode(entry)
+        # consensus-ok(apply-wall-clock): reference parity (fsm.go
+        # witnesses each entry's LOCAL arrival time for index<->time
+        # lookups); the timetable is per-replica observability, outside
+        # the replicated tables and the fingerprint() contract.
         self.timetable.witness(index, time.time())
         handler = self._handlers.get(msg_type)
         if handler is None:
@@ -148,6 +152,11 @@ class NomadFSM:
         if self.eval_broker is not None:
             for ev in evals:
                 if ev.should_enqueue():
+                    # consensus-ok(leader-fence): the broker itself is
+                    # the fence — enqueue no-ops unless enabled, and
+                    # enabled flips only inside establish/revoke
+                    # leadership, so a follower FSM applying this entry
+                    # drops the enqueue on the floor by design.
                     self.eval_broker.enqueue(ev, force=True)
         return None
 

@@ -827,18 +827,13 @@ class EdgeLoop:
             self._sel.unregister(conn.sock)
         except (KeyError, ValueError):
             pass
-        try:
-            conn.sock.close()
-        except OSError:
-            pass
+        # Account BEFORE the socket close: the FIN is externally
+        # visible the instant close() runs, and a peer woken by it may
+        # immediately sample stats() — the close must already be
+        # attributed (and the conn deregistered) by then, or the
+        # observer sees a closed wire with an open, unaccounted conn.
         with self._conns_lock:
             self._conns.pop(conn.fd, None)
-        # A dead connection must deregister every parked waiter — this
-        # is the watcher-leak fix: abandoned long-polls leave the watch
-        # registry empty, not populated until some far-future timeout.
-        for rec in list(conn.parked.values()):
-            self._unsub(rec)
-        conn.parked.clear()
         if reason == "eof":
             self.closed_eof += 1
         elif reason == "idle":
@@ -847,3 +842,13 @@ class EdgeLoop:
             self.closed_deadline += 1
         else:
             self.closed_error += 1
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        # A dead connection must deregister every parked waiter — this
+        # is the watcher-leak fix: abandoned long-polls leave the watch
+        # registry empty, not populated until some far-future timeout.
+        for rec in list(conn.parked.values()):
+            self._unsub(rec)
+        conn.parked.clear()

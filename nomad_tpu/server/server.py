@@ -301,6 +301,11 @@ class Server:
             self.controller = server_controller(self)
             self.obs_registry.register("controller",
                                        self.controller.stats)
+            # consensus-ok(leader-fence): the feedback controller
+            # actuates host-local performance knobs (batch windows,
+            # broker admission) off this server's own metrics — it
+            # never touches replicated state, so it runs on every
+            # server, leader or not, by design.
             self.controller.start()
 
     def _setup_obs_registry(self) -> None:
@@ -776,10 +781,20 @@ class Server:
         return index
 
     def node_heartbeat(self, node_id: str) -> float:
-        """Client heartbeat: re-arms the TTL timer, returns the next TTL."""
+        """Client heartbeat: re-arms the TTL timer, returns the next TTL.
+
+        Leadership fence: TTL timers are leader state — only the leader
+        invalidates on expiry, so only the leader may arm.  A heartbeat
+        landing here without it (a second-hop forward racing a
+        leadership change, or an UpdateStatus served on a demoted
+        server) gets the no-TTL answer and re-heartbeats through the
+        new leader, instead of arming a timer nobody will ever fire or
+        clear (the same 0.0 contract node_register uses off-leader)."""
         node = self.fsm.state.node_by_id(node_id)
         if node is None:
             raise KeyError(f"node not found: {node_id}")
+        if not self._leader:
+            return 0.0
         return self.heartbeats.reset_heartbeat_timer(node_id)
 
     def node_evaluate(self, node_id: str) -> list:

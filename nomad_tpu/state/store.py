@@ -717,8 +717,11 @@ class StateStore(_ReadMixin):
             self._bump("allocs", index)
             if removed:
                 self._log_alloc_change(index, removed)
+        # sorted(): the dedup set's iteration order is hash-seeded, and
+        # the notify key order escapes to watch subscribers — replicas
+        # must fan out identically for the same log entry.
         keys = [("evals",), ("allocs",)]
-        keys += [("alloc-node", n) for n in set(touched_nodes)]
+        keys += [("alloc-node", n) for n in sorted(set(touched_nodes))]
         self.watch.notify(*keys, index=index)
 
     # -- allocs -----------------------------------------------------------
@@ -824,8 +827,10 @@ class StateStore(_ReadMixin):
                 self._bump("allocs", index)
                 self._log_alloc_change(index, [a.id for a in allocs])
                 last_index = index
+        # sorted(): same determinism contract as delete_eval — notify
+        # fan-out order must not depend on the process hash seed.
         keys = [("allocs",)] + [("alloc-node", n)
-                                for n in set(touched_nodes)]
+                                for n in sorted(set(touched_nodes))]
         self.watch.notify(*keys, index=last_index)
 
     def update_alloc_from_client(self, index: int,

@@ -37,6 +37,11 @@ def pytest_configure(config):
 
 import pytest  # noqa: E402
 
+# The session's ReplicaDivergenceSanitizer (None when sanitizers are
+# disabled): the per-test quiescence fixture and the sanitizer's own
+# regression tests reach it through here.
+DIVERGENCE = None
+
 
 @pytest.fixture(scope="session", autouse=True)
 def runtime_sanitizers():
@@ -52,35 +57,54 @@ def runtime_sanitizers():
       host->device transfer on a dispatch path (a host array/scalar
       silently committed by jit instead of explicitly placed through
       the counted seams) raises in the test that caused it.
+    - replica divergence: every NomadFSM carries a shadow twin fed the
+      same raft entries; store fingerprints are byte-compared at commit
+      quiescence points, so a nondeterministic apply fails the test
+      that caused it (the runtime twin of analysis/consensuslint.py).
 
     Disable with NOMAD_TPU_SANITIZERS=0 (e.g. when bisecting an
     unrelated failure).  All only observe; no test behavior changes.
     """
+    global DIVERGENCE
     if os.environ.get("NOMAD_TPU_SANITIZERS", "1") == "0":
         yield
         return
     from nomad_tpu.analysis.sanitizers import (LockOrderWitness,
                                                RecompileSentinel,
+                                               ReplicaDivergenceSanitizer,
                                                TransferGuardSanitizer)
 
     witness = LockOrderWitness().install()
     sentinel = RecompileSentinel().install()
     guard = TransferGuardSanitizer().install()
+    DIVERGENCE = divergence = ReplicaDivergenceSanitizer().install()
     try:
         yield
     finally:
+        divergence.uninstall()
+        DIVERGENCE = None
         guard.uninstall()
         witness.uninstall()
     # Collect-then-raise so one sanitizer tripping doesn't mask the
     # other's report for the same session.
     errors = []
-    for check in (witness.check, sentinel.check):
+    for check in (witness.check, sentinel.check, divergence.check):
         try:
             check()
         except AssertionError as e:
             errors.append(str(e))
     if errors:
         raise AssertionError("\n".join(errors))
+
+
+@pytest.fixture(autouse=True)
+def replica_quiescence():
+    """Per-test commit quiescence point: fingerprint-compare every live
+    primary/twin FSM pair at teardown, so a divergence is pinned to the
+    test that caused it instead of surfacing sessions later."""
+    yield
+    if DIVERGENCE is not None:
+        DIVERGENCE.compare_all()
 
 
 def wait_until(fn, timeout=15.0, msg="condition"):

@@ -1,0 +1,473 @@
+"""Consensus-plane lint (analysis/consensuslint.py) + the defects it
+found + the runtime shadow-replica sanitizer.
+
+Three layers, mirroring tests/test_devlint.py:
+
+1. **Rule units** on synthetic packages: every consensus rule
+   (apply-wall-clock, apply-rng, apply-env, apply-iter-order,
+   apply-float-accum, leader-fence, read-consistency,
+   stale-read-bypass) proves it fires, and every sanctioned pattern
+   (sorted() set walks, seeded instance RNGs, leadership fences —
+   syntactic, hook, call-graph-propagated, and Thread(target=...)
+   arming — plus justified ``# consensus-ok`` markers) proves it is
+   exempt.
+2. **Analyzer-found defect regressions**: the real bugs the passes
+   surfaced — hash-order watch-notify fan-out in
+   ``StateStore.delete_eval`` / ``upsert_allocs_batched`` and the
+   unfenced heartbeat arming in ``Server.node_heartbeat`` — each
+   pinned by a test that fails on the pre-fix shape.
+3. **ReplicaDivergenceSanitizer**: an injected nondeterministic apply
+   diverges the shadow twin and raises in the offending apply; clean
+   replays stay byte-identical; out-of-band store writes drop the pair
+   (counted) instead of reporting a false divergence.
+"""
+from __future__ import annotations
+
+import textwrap
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.analysis import consensuslint
+from nomad_tpu.structs import codec
+
+
+def write_files(tmp_path, files: dict) -> str:
+    d = tmp_path / "pkg"
+    d.mkdir(exist_ok=True)
+    for name, source in files.items():
+        (d / name).write_text(textwrap.dedent(source))
+    return str(d)
+
+
+def rules_of(findings) -> dict:
+    out: dict = {}
+    for f in findings:
+        out.setdefault(f.rule, []).append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 1. rule units
+# ---------------------------------------------------------------------------
+
+class TestApplyDeterminism:
+    def test_wall_clock_in_apply_fires_and_marker_waives(self, tmp_path):
+        pkg = write_files(tmp_path, {
+            "fsm.py": """
+                import time
+
+                class TinyFSM:
+                    def apply(self, index, entry):
+                        self.when = time.time()
+                        return entry
+
+                class WaivedFSM:
+                    def apply(self, index, entry):
+                        # consensus-ok(apply-wall-clock): audited — local
+                        # observability only, outside the fingerprint.
+                        self.when = time.time()
+                        return entry
+                """,
+        })
+        cov: dict = {}
+        by = rules_of(consensuslint.analyze_package(pkg, coverage_out=cov))
+        hits = by.get("apply-wall-clock", [])
+        assert len(hits) == 1
+        assert "TinyFSM.apply" in hits[0].where
+        assert cov["waived"] == 1
+        assert cov["apply_roots"] >= 2
+
+    def test_rng_and_env_reads_fire_seeded_rng_exempt(self, tmp_path):
+        pkg = write_files(tmp_path, {
+            "store.py": """
+                import os
+                import random
+                import uuid
+
+                class TinyStore:
+                    def __init__(self):
+                        self._rng = random.Random(7)
+
+                    def upsert_thing(self, index, thing):
+                        thing["id"] = str(uuid.uuid4())
+                        thing["salt"] = os.urandom(4)
+                        thing["jitter"] = random.random()
+                        thing["ok_jitter"] = self._rng.random()
+
+                    def update_host(self, index):
+                        import socket
+                        return (os.environ.get("HOST"),
+                                socket.gethostname())
+                """,
+        })
+        by = rules_of(consensuslint.analyze_package(pkg))
+        rng = by.get("apply-rng", [])
+        assert len(rng) == 3, [f.message for f in rng]
+        assert not any("_rng" in f.message for f in rng)
+        env = by.get("apply-env", [])
+        assert len(env) == 2, [f.message for f in env]
+
+    def test_set_order_escape_fires_sorted_walk_exempt(self, tmp_path):
+        pkg = write_files(tmp_path, {
+            "store.py": """
+                class TinyStore:
+                    def upsert_many(self, index, ids):
+                        touched = set(ids)
+                        keys = [("k", n) for n in touched]
+                        good = [("k", n) for n in sorted(touched)]
+                        total = sum(touched)
+                        acc = 0.0
+                        for n in {x * 1.5 for x in ids}:
+                            acc += n
+                        return keys, good, total, acc
+                """,
+        })
+        by = rules_of(consensuslint.analyze_package(pkg))
+        assert len(by.get("apply-iter-order", [])) == 1
+        assert len(by.get("apply-float-accum", [])) == 2
+
+    def test_taint_follows_calls_and_skips_obs_sinks(self, tmp_path):
+        pkg = write_files(tmp_path, {
+            "__init__.py": "",
+            "fsm.py": """
+                from pkg.helper import stamp
+                from pkg.obs.trace import record
+
+                class TinyFSM:
+                    def apply(self, index, entry):
+                        record(index)
+                        return stamp(entry)
+                """,
+            "helper.py": """
+                import time
+
+                def stamp(entry):
+                    return (entry, time.time())
+                """,
+        })
+        (tmp_path / "pkg" / "obs").mkdir()
+        (tmp_path / "pkg" / "obs" / "__init__.py").write_text("")
+        (tmp_path / "pkg" / "obs" / "trace.py").write_text(textwrap.dedent(
+            """
+            import time
+
+            def record(index):
+                return time.perf_counter()
+            """))
+        cov: dict = {}
+        by = rules_of(consensuslint.analyze_package(pkg, coverage_out=cov))
+        hits = by.get("apply-wall-clock", [])
+        # helper.stamp is tainted through the call chain; the obs sink
+        # is excluded (its perf_counter is fine) and counted.
+        assert len(hits) == 1
+        assert "stamp" in hits[0].where
+        assert "TinyFSM.apply -> stamp" in hits[0].message
+        assert cov["sinks_excluded"] == 1
+
+
+class TestLeadershipFencing:
+    def test_unfenced_force_enqueue_fires_fenced_paths_exempt(
+            self, tmp_path):
+        pkg = write_files(tmp_path, {
+            "srv.py": """
+                class Srv:
+                    def is_leader(self):
+                        return self._leader
+
+                    def establish_leadership(self):
+                        self._leader = True
+                        self._restore()
+
+                    def _restore(self):
+                        self.broker.enqueue(1, force=True)
+
+                    def fenced_inline(self):
+                        if self.is_leader():
+                            self.broker.enqueue(2, force=True)
+
+                    def unfenced(self):
+                        self.broker.enqueue(3, force=True)
+                        self.heartbeats.reset_heartbeat_timer("n1")
+                """,
+        })
+        cov: dict = {}
+        by = rules_of(consensuslint.analyze_package(pkg, coverage_out=cov))
+        hits = by.get("leader-fence", [])
+        # Only the two sites in `unfenced`: _restore is fenced through
+        # its sole caller (a leadership hook), fenced_inline checks.
+        assert len(hits) == 2, [f.where for f in hits]
+        assert all("Srv.unfenced" in f.where for f in hits)
+        assert cov["fence_targets"] == 4
+
+    def test_thread_target_arming_propagates_the_fence(self, tmp_path):
+        pkg = write_files(tmp_path, {
+            "applier.py": """
+                import threading
+
+                class PlanApplier:
+                    def establish_leadership(self):
+                        self.start()
+
+                    def start(self):
+                        t = threading.Thread(target=self._run)
+                        t.start()
+
+                    def _run(self):
+                        self.queue.set_enabled(True)
+                """,
+        })
+        by = rules_of(consensuslint.analyze_package(pkg))
+        # _run's only entry is the Thread armed inside start, whose only
+        # caller is the leadership hook: fenced end-to-end.
+        assert by.get("leader-fence", []) == []
+
+    def test_orphan_thread_body_with_leader_machinery_fires(
+            self, tmp_path):
+        pkg = write_files(tmp_path, {
+            "applier.py": """
+                class LoosePlanApplier:
+                    def _run(self):
+                        self.queue.set_enabled(True)
+                """,
+        })
+        by = rules_of(consensuslint.analyze_package(pkg))
+        hits = by.get("leader-fence", [])
+        assert len(hits) == 1 and "LoosePlanApplier._run" in hits[0].where
+
+
+ENDPOINT_PKG = {
+    "endpoints.py": """
+        CONSISTENT_READS = frozenset({"Node.GetNode"})
+
+        class Endpoints:
+            def __init__(self, server):
+                self.server = server
+
+            def install(self, rpc_server):
+                for service, methods in {
+                    "Node": ["GetNode", "List", "Register"],
+                    "Status": ["Ping"],
+                }.items():
+                    for m in methods:
+                        rpc_server.register(service, m)
+
+            def _forward(self, method, args):
+                if self.server.is_leader():
+                    return None
+                return {}
+
+            def _blocking(self, args, table, run):
+                return run()
+
+            def _state(self):
+                return self.server.state
+
+            def node_get_node(self, args):
+                def run():
+                    return {"node": self._state().get(args["id"])}
+                return self._blocking(args, "nodes", run)
+
+            def node_list(self, args):
+                def run():
+                    return {"nodes": list(self._state())}
+                return self._blocking(args, "nodes", run)
+
+            def node_register(self, args):
+                return {"seen": self._state().get(args["id"])}
+
+            def status_ping(self, args):
+                return {}
+        """,
+}
+
+
+class TestReadConsistencyContract:
+    def test_classification_and_both_rules(self, tmp_path):
+        pkg = write_files(tmp_path, dict(ENDPOINT_PKG))
+        cov: dict = {}
+        by = rules_of(consensuslint.analyze_package(pkg, coverage_out=cov))
+        assert cov["endpoint_contract"] == {
+            "Node.GetNode": "stale-safe",
+            "Node.List": "local-read",
+            "Node.Register": "unfenced-read",
+            "Status.Ping": "server-local",
+        }
+        bypass = by.get("stale-read-bypass", [])
+        assert len(bypass) == 1 and bypass[0].where == "Node.List"
+        unfenced = by.get("read-consistency", [])
+        assert len(unfenced) == 1 and unfenced[0].where == "Node.Register"
+
+    def test_forward_fence_makes_the_read_leader_only(self, tmp_path):
+        src = dict(ENDPOINT_PKG)
+        src["endpoints.py"] = src["endpoints.py"].replace(
+            'return {"seen": self._state().get(args["id"])}',
+            'fwd = self._forward("Node.Register", args)\n'
+            '                if fwd is not None:\n'
+            '                    return fwd\n'
+            '                return {"seen": self._state().get(args["id"])}')
+        pkg = write_files(tmp_path, src)
+        cov: dict = {}
+        by = rules_of(consensuslint.analyze_package(pkg, coverage_out=cov))
+        assert cov["endpoint_contract"]["Node.Register"] == "leader-only"
+        assert by.get("read-consistency", []) == []
+
+
+# ---------------------------------------------------------------------------
+# 2. analyzer-found defect regressions
+# ---------------------------------------------------------------------------
+
+class TestAnalyzerFoundDefects:
+    def _recording_store(self):
+        from nomad_tpu.state.store import StateStore
+
+        store = StateStore()
+        recorded: list = []
+        real = store.watch.notify
+
+        def record(*keys, index=0):
+            recorded.append(list(keys))
+            return real(*keys, index=index)
+
+        store.watch.notify = record
+        return store, recorded
+
+    def test_batched_upsert_notify_fanout_is_hash_order_free(self):
+        """consensuslint apply-iter-order @ store.py upsert_allocs_batched:
+        the alloc-node notify keys walked a raw set — hash-seeded order
+        escaping to watch subscribers.  Now sorted."""
+        store, recorded = self._recording_store()
+        allocs = []
+        for i in range(8):
+            a = mock.alloc()
+            a.node_id = f"node-{i:02d}"
+            allocs.append(a)
+        store.upsert_allocs_batched([(5, allocs)])
+        node_keys = [k for k in recorded[-1] if k[0] == "alloc-node"]
+        assert len(node_keys) == 8
+        assert node_keys == sorted(node_keys)
+
+    def test_delete_eval_notify_fanout_is_hash_order_free(self):
+        """Same defect class in StateStore.delete_eval's reap fan-out."""
+        store, recorded = self._recording_store()
+        allocs = []
+        for i in range(8):
+            a = mock.alloc()
+            a.node_id = f"node-{i:02d}"
+            allocs.append(a)
+        store.upsert_allocs(5, allocs)
+        store.delete_eval(6, [], [a.id for a in allocs])
+        node_keys = [k for k in recorded[-1] if k[0] == "alloc-node"]
+        assert len(node_keys) == 8
+        assert node_keys == sorted(node_keys)
+
+    def test_node_heartbeat_does_not_arm_off_leader(self):
+        """consensuslint leader-fence @ server.py node_heartbeat: TTL
+        timers are leader state, but a second-hop forwarded heartbeat
+        (or an UpdateStatus served on a demoted server) armed one
+        anyway — a timer the real leader never fires or clears.  Now
+        the no-TTL answer off-leader, like node_register."""
+        from nomad_tpu.server import Server, ServerConfig
+
+        srv = Server(ServerConfig(num_schedulers=0))
+        try:
+            srv.establish_leadership()
+            node = mock.node(1)
+            srv.node_register(node)
+            assert srv.node_heartbeat(node.id) > 0
+            assert srv.heartbeats.active() == 1
+            srv.revoke_leadership()
+            assert srv.heartbeats.active() == 0
+            assert srv.node_heartbeat(node.id) == 0.0
+            assert srv.heartbeats.active() == 0, \
+                "demoted server must not arm heartbeat timers"
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# 3. the shadow-replica divergence sanitizer
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def divergence():
+    """The session-installed sanitizer when active (conftest), else a
+    locally installed one; either way, divergences this test injects
+    are scrubbed afterwards so the session-teardown check stays clean."""
+    import conftest as cft
+    from nomad_tpu.analysis.sanitizers import ReplicaDivergenceSanitizer
+
+    san = cft.DIVERGENCE
+    if san is not None:
+        before = len(san.mismatches)
+        yield san
+        del san.mismatches[before:]
+    else:
+        san = ReplicaDivergenceSanitizer().install()
+        try:
+            yield san
+        finally:
+            san.uninstall()
+
+
+def _node_entry(i: int) -> bytes:
+    node = mock.node(i)
+    return codec.encode(codec.NODE_REGISTER_REQUEST,
+                        {"node": node.to_dict()})
+
+
+class TestReplicaDivergenceSanitizer:
+    def test_catches_injected_nondeterministic_apply(self, divergence):
+        from nomad_tpu.server.fsm import NomadFSM
+
+        fsm = NomadFSM()
+        assert fsm._divergence_twin is not None
+        clean = fsm._handlers[codec.NODE_REGISTER_REQUEST]
+
+        def tainted(index, payload):
+            # The injected bug: a wall-clock value smuggled into
+            # replicated state (exactly what consensuslint's
+            # apply-wall-clock rule bans statically).
+            payload["node"]["name"] = f"joined-{time.time_ns()}"
+            return clean(index, payload)
+
+        fsm._handlers[codec.NODE_REGISTER_REQUEST] = tainted
+        with pytest.raises(AssertionError, match="replica divergence"):
+            fsm.apply(1, _node_entry(1))
+        assert fsm._divergence_twin is None   # pair dropped, once
+        assert divergence.mismatches
+
+    def test_clean_replay_stays_byte_identical(self, divergence):
+        from nomad_tpu.server.fsm import NomadFSM
+
+        fsm = NomadFSM()
+        for i in range(1, 7):
+            fsm.apply(i, _node_entry(i))
+        assert fsm._divergence_twin is not None
+        assert fsm.state.fingerprint() == \
+            fsm._divergence_twin.state.fingerprint()
+
+    def test_out_of_band_writes_drop_the_pair_not_a_report(
+            self, divergence):
+        from nomad_tpu.server.fsm import NomadFSM
+
+        desynced_before = divergence.desynced
+        mismatches_before = len(divergence.mismatches)
+        fsm = NomadFSM()
+        # Test-style direct seeding: a store write that never rode the
+        # raft log.  The twin can't see it — that's not divergence.
+        fsm.state.upsert_job(1, mock.job())
+        fsm.apply(2, _node_entry(2))
+        assert fsm._divergence_twin is None
+        assert divergence.desynced == desynced_before + 1
+        assert len(divergence.mismatches) == mismatches_before
+
+    def test_twin_skips_broker_and_span_recording(self, divergence):
+        from nomad_tpu.server.fsm import NomadFSM
+
+        fsm = NomadFSM()
+        twin = fsm._divergence_twin
+        assert twin.eval_broker is None
+        assert twin._record_apply_spans("t", ["env"], [], 0, 0, 0, 0) \
+            is None

@@ -92,7 +92,10 @@ class TestLintGate:
     def test_whole_program_pass_fits_timing_budget(self):
         """The interprocedural passes run on every tier-1 invocation;
         they must stay well under 10s on tier-1 hardware or the gate
-        becomes the bottleneck it polices."""
+        becomes the bottleneck it polices.  The consensus-plane passes
+        (PR 16) ride the same budget: whole-program lint including the
+        apply-determinism closure, the fencing fixpoint, and the
+        endpoint contract table measured ~5s at introduction."""
         import time as _time
 
         start = _time.monotonic()
@@ -695,6 +698,130 @@ class TestLintGate:
         # The one deliberate under-lock site (the mirror's bounded
         # scatter maintenance) is marker-waived AND counted.
         assert dev["waived"] >= 1
+
+    def test_consensus_plane_rides_the_gates(self):
+        """ISSUE 16 tentpole: the consensus-plane passes
+        (analysis/consensuslint.py) cover the replicated core — the FSM
+        apply/restore closure, every store commit method, the
+        leadership-fenced dispatch sites, and the full RPC endpoint
+        table — strict-clean on the real tree, with ZERO allowlist
+        entries of their own and the roots actually discovered."""
+        from nomad_tpu.analysis import consensuslint, default_package_root
+        from nomad_tpu.analysis.callgraph import CallGraph
+        from nomad_tpu.server.endpoints import CONSISTENT_READS
+
+        pkg = default_package_root()
+        graph = CallGraph.build(pkg)
+        for qual in (
+            "nomad_tpu.server.fsm:NomadFSM.apply",
+            "nomad_tpu.server.fsm:NomadFSM.restore",
+            "nomad_tpu.state.store:StateStore.upsert_job",
+            "nomad_tpu.state.store:StateStore.delete_eval",
+            "nomad_tpu.state.store:StateStore.upsert_allocs_batched",
+            "nomad_tpu.server.server:Server.node_heartbeat",
+            "nomad_tpu.server.server:Server.establish_leadership",
+            "nomad_tpu.server.endpoints:Endpoints.job_register",
+        ):
+            assert qual in graph.functions, \
+                f"{qual} missing from the interprocedural graph"
+
+        cov: dict = {}
+        findings = consensuslint.analyze_package(pkg, graph=graph,
+                                                 coverage_out=cov)
+        assert findings == [], "consensus plane must lint clean:\n" + \
+            "\n".join(f.render() for f in findings)
+        # The determinism pass saw the real apply surface...
+        assert cov["apply_roots"] >= 30, cov
+        assert cov["apply_closure"] >= cov["apply_roots"]
+        # ...the fencing pass saw the real dispatch sites...
+        assert cov["fence_targets"] >= 10, cov
+        assert cov["fenced_functions"] > 0
+        # ...and the contract pass classified the full endpoint table.
+        table = cov["endpoint_contract"]
+        assert len(table) >= 30, table
+        stale_safe = {m for m, c in table.items() if c == "stale-safe"}
+        assert stale_safe == set(CONSISTENT_READS), \
+            "stale-safe classification must match CONSISTENT_READS " \
+            f"exactly: {stale_safe ^ set(CONSISTENT_READS)}"
+        assert table["Job.Evaluate"] == "leader-only"
+        assert table["Status.Ping"] == "server-local"
+        # The three audited sites (timetable witness, broker-fenced
+        # enqueue, host-local controller) are waived AND counted.
+        assert cov["waived"] >= 3, cov
+        allowlist = load_allowlist(default_allowlist_path())
+        for rule in ("apply-wall-clock", "apply-rng", "apply-env",
+                     "apply-iter-order", "apply-float-accum",
+                     "leader-fence", "read-consistency",
+                     "stale-read-bypass"):
+            assert not any(e.startswith(rule + ":") for e in allowlist), \
+                f"consensus rule {rule} must not need allowlist " \
+                "entries (use a justified in-code consensus-ok marker)"
+
+    def test_lint_json_reports_consensuslint_coverage(self, capsys):
+        """-json schema v2: top-level schema_version plus the consensus
+        coverage block carrying the endpoint read-consistency table."""
+        import json as _json
+
+        from nomad_tpu.cli.main import main
+
+        assert main(["lint", "-json"]) == 0
+        doc = _json.loads(capsys.readouterr().out)
+        assert doc["schema_version"] == 2
+        cons = doc["coverage"]["consensuslint"]
+        assert set(cons) >= {"apply_roots", "apply_closure",
+                             "sinks_excluded", "fence_targets",
+                             "fenced_functions", "endpoint_contract",
+                             "stale_safe_reads", "leader_only_reads",
+                             "waived"}
+        assert cons["apply_roots"] > 0 and cons["fence_targets"] > 0
+        table = cons["endpoint_contract"]
+        assert cons["stale_safe_reads"] == \
+            sum(1 for c in table.values() if c == "stale-safe")
+        assert set(table.values()) <= {"stale-safe", "leader-only",
+                                       "local-read", "unfenced-read",
+                                       "write", "server-local"}
+
+    def test_changed_mode_covers_consensuslint(self, tmp_path, capsys):
+        """`lint -changed REV` reports consensus-plane findings in
+        touched files and filters pre-existing ones."""
+        import subprocess
+        import textwrap as _tw
+
+        from nomad_tpu.cli.main import main
+
+        def git(*args):
+            subprocess.run(["git", "-C", str(tmp_path), *args],
+                           check=True, capture_output=True,
+                           env={"GIT_AUTHOR_NAME": "t",
+                                "GIT_AUTHOR_EMAIL": "t@t",
+                                "GIT_COMMITTER_NAME": "t",
+                                "GIT_COMMITTER_EMAIL": "t@t",
+                                "HOME": str(tmp_path),
+                                "PATH": os.environ.get("PATH", "")})
+
+        bad = _tw.dedent("""
+            import time
+
+            class TinyFSM:
+                def apply(self, index, entry):
+                    return (entry, time.time())
+            """)
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "untouched.py").write_text(
+            bad.replace("TinyFSM", "OldFSM"))
+        (pkg / "touched.py").write_text("def ok():\n    return 1\n")
+        git("init", "-q")
+        git("add", "-A")
+        git("commit", "-qm", "base")
+        (pkg / "touched.py").write_text(bad)
+        rc = main(["lint", str(pkg), "-changed", "HEAD",
+                   "-allowlist", str(tmp_path / "none.txt")])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "touched.py" in out and "apply-wall-clock" in out
+        assert "untouched.py" not in out, \
+            "changed-mode must filter pre-existing consensus findings"
 
     def test_fixed_sleep_ratchet_is_clean(self):
         """Every fixed time.sleep in the test tree is either converted
