@@ -33,6 +33,26 @@ before any component walks, so concurrent worker-side syncs are never
 blocked behind a window verify (the old code held the mirror for the
 whole pass).
 
+Device-resident verify (``NOMAD_TPU_VERIFY``, ops/verify_policy.py):
+when the policy resolves ``device`` (or ``auto`` with the twins already
+resident), the dense base fit dispatches ONE sharded kernel per window
+against the mesh-resident ShardedResidency twins
+(parallel/mesh.window_verify_sharded) instead of gathering the host
+mirror arrays: under the mirror lock the verify takes a residency
+*lease* (models/fleet.py UsageMirror.window_lease — a reference to the
+immutable resident usage twin, never a copy and never an upload), and
+the claim-scatter + claim-sum/compare plus an optimistic scatter-add
+overlay fold (all earlier window plans' accepted deltas per node) run
+on the device.  Component walks consume the fetched numbers exactly
+where the host lists sat, and take the device fold verdict only when
+the walk can PROVE the optimistic assumption held (no in-flight
+overlay, no rejected earlier plan, no alloc id referenced twice in the
+window) — everything else, including every exact-walk punt
+(out-of-fleet nodes, odd port/topology shapes) and the byte-exact
+within-component ordering guarantee, runs the unchanged host code, so
+verdicts, accepted alloc sets and store fingerprints are byte-identical
+under either policy (tests/test_plan_batch.py host/device rigs).
+
 Deadline-aware component scheduling: components are ordered by their
 nearest member deadline (then window position), and the executor starts
 them in that order — under saturation a near-deadline plan's component
@@ -428,12 +448,174 @@ def evaluate_window(snap, plans: list, executor=None,
 class _Prep:
     """Everything the component walks share, frozen by the coordinator
     before any component starts: the dense base-fit results, the frame,
-    and the in-flight overlay's contents.  Read-only once built."""
+    and the in-flight overlay's contents.  Read-only once built.
+
+    ``devfit`` is None on the host engine; on a device dispatch it
+    carries the kernel's optimistic fold verdicts (``base_used``/
+    ``caps`` then hold the FETCHED device numbers — byte-identical to
+    the host gather, so the walks don't care which engine filled
+    them)."""
 
     __slots__ = ("plans", "plan_nodes", "verdicts", "pairs", "pair_of",
                  "base_used", "caps", "frame", "index_of", "statics",
                  "base", "refresh_index", "inflight", "inflight_nodes",
-                 "inflight_by_node", "inflight_by_id")
+                 "inflight_by_node", "inflight_by_id", "devfit")
+
+
+class _DeviceFit:
+    """Fetched per-pair results of one window_verify_sharded dispatch.
+
+    ``fits_seq[pair]`` is the device's optimistic overlay-fold verdict
+    — base fit plus ALL earlier same-component window plans' deltas
+    under the all-accepted assumption.  ``seq_ok`` is the window-level
+    eligibility: False when any alloc id is referenced by two claims
+    (double-evict / replace-after-place), where the optimistic prefix
+    cannot equal the host fold order.  _walk_component additionally
+    requires its own ``clean`` proof before trusting a verdict."""
+
+    __slots__ = ("fits_seq", "seq_ok")
+
+
+def _window_device_args(plans, plan_nodes, verdicts, pairs, mirror,
+                        index_of, frame_ids, plan_comp, alloc_vec):
+    """Per-window fold descriptors for the device kernel, built under
+    the mirror lock (reads ``mirror.alloc_rows`` — the same rows the
+    ``_Frame`` copies).  Simulates ``_WindowState.fold`` for every
+    claim that can still be accepted (pass-1 rejections excluded,
+    ``failed_allocs`` included — the walk folds those even on
+    rejection), tagging each entry with its window plan index and
+    claim-graph component so the kernel's prefix mask reproduces the
+    component-local host fold order exactly."""
+    m_rows = mirror.alloc_rows
+    seq_ni: list = []
+    seq_vec: list = []
+    seq_order: list = []
+    seq_comp: list = []
+    ref_count: dict = {}
+
+    def sim_fold(a, i, ci) -> None:
+        aid = a.id
+        ref_count[aid] = ref_count.get(aid, 0) + 1
+        # Frame-restricted like _WindowState.alloc_row: an id outside
+        # the window's frame reads None on the host walk too.
+        row = m_rows.get(aid) if aid in frame_ids else None
+        if row is not None:
+            v = row[1]
+            seq_ni.append(row[0])
+            seq_vec.append([-float(v[0]), -float(v[1]), -float(v[2]),
+                           -float(v[3])])
+            seq_order.append(i)
+            seq_comp.append(ci)
+        if a.terminal_status():
+            return
+        ni = index_of.get(a.node_id, -1)
+        if ni < 0:
+            return
+        v = alloc_vec(a)
+        seq_ni.append(ni)
+        seq_vec.append([float(v[0]), float(v[1]), float(v[2]),
+                        float(v[3])])
+        seq_order.append(i)
+        seq_comp.append(ci)
+
+    for i, plan in enumerate(plans):
+        ci = plan_comp[i]
+        pv = verdicts[i]
+        for nid in plan_nodes[i]:
+            if pv.get(nid, _MISS) is False:
+                continue  # pass-1 rejection: none of its allocs fold
+            for a in plan.node_update.get(nid, ()):
+                sim_fold(a, i, ci)
+            for a in plan.node_allocation.get(nid, ()):
+                sim_fold(a, i, ci)
+        for a in plan.failed_allocs:
+            sim_fold(a, i, ci)
+    seq_ok = all(c == 1 for c in ref_count.values())
+
+    pair_removed: list = []
+    for (_i, _nid, ni, _node, _placements, removed) in pairs:
+        r0 = r1 = r2 = r3 = 0.0
+        for aid in removed:
+            row = m_rows.get(aid)
+            if row is not None and row[0] == ni:
+                v = row[1]
+                r0 += float(v[0])
+                r1 += float(v[1])
+                r2 += float(v[2])
+                r3 += float(v[3])
+        pair_removed.append([r0, r1, r2, r3])
+
+    return {
+        "pair_ni": [p[2] for p in pairs],
+        "pair_order": [p[0] for p in pairs],
+        "pair_comp": [plan_comp[p[0]] for p in pairs],
+        "pair_removed": pair_removed,
+        "seq_ni": seq_ni,
+        "seq_vec": seq_vec,
+        "seq_order": seq_order,
+        "seq_comp": seq_comp,
+        "seq_ok": seq_ok,
+    }
+
+
+def _dispatch_window_fit(mesh, capres, lease, dargs, vec_pair, vec_rows,
+                         n_pairs):
+    """ONE sharded dispatch for the whole window's base fit + overlay
+    fold, against the resident twins (``capres`` from the statics
+    residency, ``lease`` from UsageMirror.window_lease).  Runs OUTSIDE
+    the mirror lock — the descriptors are tiny host arrays, padded to
+    one shared power-of-two bucket so distinct window sizes reuse the
+    trace.  Returns (used_rows, caps_rows, _DeviceFit, devinfo);
+    used/caps come back through devices.fetch_host and drop into
+    ``prep.base_used``/``prep.caps`` exactly where the host gather's
+    ``.tolist()`` sat."""
+    from nomad_tpu.models.fleet import _pad_to
+    from nomad_tpu.parallel.devices import fetch_host, transfer_counts
+    from nomad_tpu.parallel.mesh import window_verify_sharded
+
+    bucket = _pad_to(max(n_pairs, len(vec_rows), len(dargs["seq_ni"])))
+
+    def pad_i(vals, fill):
+        arr = np.full(bucket, fill, dtype=np.int32)
+        if vals:
+            arr[:len(vals)] = vals
+        return arr
+
+    def pad_v(vals):
+        arr = np.zeros((bucket, 4), dtype=np.float32)
+        if len(vals):
+            arr[:len(vals)] = np.asarray(vals, dtype=np.float32)[:, :4]
+        return arr
+
+    t0 = time.perf_counter()
+    before = transfer_counts()
+    used, caps, fits = window_verify_sharded(
+        mesh, capres[0], capres[1], lease,
+        pad_i(dargs["pair_ni"], 0), pad_i(vec_pair, 0),
+        pad_v(vec_rows), pad_i(dargs["seq_ni"], -1),
+        pad_v(dargs["seq_vec"]), pad_i(dargs["seq_order"], 0),
+        pad_i(dargs["seq_comp"], -1), pad_i(dargs["pair_order"], 0),
+        pad_i(dargs["pair_comp"], 0), pad_v(dargs["pair_removed"]))
+    used = fetch_host(used)
+    caps = fetch_host(caps)
+    fits = fetch_host(fits)
+    after = transfer_counts()
+    devfit = _DeviceFit()
+    devfit.fits_seq = fits[:n_pairs]
+    devfit.seq_ok = dargs["seq_ok"]
+    devinfo = {
+        "dispatched": True,
+        "fallback": None,
+        "pairs": n_pairs,
+        "bucket": int(bucket),
+        "seq_ok": dargs["seq_ok"],
+        "h2d": after["h2d"] - before["h2d"],
+        "d2h": after["d2h"] - before["d2h"],
+        "wall": time.perf_counter() - t0,
+    }
+    return (np.asarray(used[:n_pairs], dtype=np.float32).tolist(),
+            np.asarray(caps[:n_pairs], dtype=np.float32).tolist(),
+            devfit, devinfo)
 
 
 def _evaluate_window_vec(overlay, plans: list, executor,
@@ -473,6 +655,45 @@ def _evaluate_window_vec(overlay, plans: list, executor,
     mirror = mirror_for(statics)
     capacity = statics.capacity
     index_of = statics.index_of
+
+    # Pass-2 components are computed up front (pure on the plans): the
+    # device fold descriptors need each plan's component id so the
+    # kernel's prefix mask stays component-local — exactly the overlay
+    # each host walk sees.
+    if partition:
+        comps = partition_window(plans)
+    else:
+        comps = [list(range(len(plans)))]
+    plan_comp = [0] * len(plans)
+    for ci, comp in enumerate(comps):
+        for i in comp:
+            plan_comp[i] = ci
+
+    # Device-verify policy (ops/verify_policy.py): mesh resolution and
+    # any twin warm-up happen OUTSIDE the mirror lock; under the lock
+    # the device path only LOOKS UP residency (the window-lease rule).
+    from nomad_tpu.ops.verify_policy import (
+        VERIFY_DEVICE,
+        VERIFY_HOST,
+        verify_policy,
+    )
+
+    policy = verify_policy()
+    dev_mesh = None
+    devinfo = None
+    if policy != VERIFY_HOST:
+        from nomad_tpu.parallel.mesh import dispatch_mesh
+        dev_mesh = dispatch_mesh(1, statics.n_pad)
+        if dev_mesh is None:
+            if policy == VERIFY_DEVICE:
+                devinfo = {"dispatched": False, "fallback": "no-mesh"}
+        elif policy == VERIFY_DEVICE:
+            # Forced intent: warm the twins now (no-op when resident)
+            # so this window — or the next — holds the lease.  ``auto``
+            # never uploads: it takes the device path only when the
+            # twins are already there.
+            statics.device_capacity_reserved_sharded(dev_mesh)
+            mirror.device_usage_sharded(dev_mesh, mirror.usage)
 
     prep = _Prep()
     prep.plans = plans
@@ -545,19 +766,41 @@ def _evaluate_window_vec(overlay, plans: list, executor,
 
         base_used: list = []
         caps: list = []
+        dev_args = None
+        dev_capres = None
+        dev_lease = None
         if pairs:
-            # Dense fit inputs over every claim at once: the 4 dims
-            # Resources.superset checks, float32 like the mirror rows
-            # (exact for values < 2^24, i.e. any realistic node).
-            ni_arr = np.fromiter((p[2] for p in pairs), dtype=np.int64,
-                                 count=len(pairs))
-            delta = np.zeros((len(pairs), 4), dtype=np.float32)
-            np.add.at(delta, np.asarray(vec_pair, dtype=np.int64),
-                      np.asarray(vec_rows, dtype=np.float32)[:, :4])
-            used = usage[ni_arr, :4] + statics.reserved[ni_arr, :4] \
-                + delta
-            base_used = used.tolist()
-            caps = capacity[ni_arr, :4].tolist()
+            if dev_mesh is not None:
+                # Residency lease: references to the resident twins for
+                # THIS generation, or None — never an upload under the
+                # lock.
+                dev_lease = mirror.window_lease(dev_mesh)
+                dev_capres = statics.sharded.lookup(("capres", dev_mesh))
+            if dev_lease is not None and dev_capres is not None:
+                # Device engine: only the tiny fold descriptors are
+                # built under the lock; the dispatch (and every
+                # counted transfer) runs after release.
+                dev_args = _window_device_args(
+                    plans, prep.plan_nodes, verdicts, pairs, mirror,
+                    index_of, frame_ids, plan_comp, alloc_vec)
+            else:
+                if policy == VERIFY_DEVICE:
+                    devinfo = {"dispatched": False,
+                               "fallback": "lease-miss"
+                               if dev_lease is None else "capres-miss"}
+                # Host engine — dense fit inputs over every claim at
+                # once: the 4 dims Resources.superset checks, float32
+                # like the mirror rows (exact for values < 2^24, i.e.
+                # any realistic node).
+                ni_arr = np.fromiter((p[2] for p in pairs),
+                                     dtype=np.int64, count=len(pairs))
+                delta = np.zeros((len(pairs), 4), dtype=np.float32)
+                np.add.at(delta, np.asarray(vec_pair, dtype=np.int64),
+                          np.asarray(vec_rows, dtype=np.float32)[:, :4])
+                used = usage[ni_arr, :4] \
+                    + statics.reserved[ni_arr, :4] + delta
+                base_used = used.tolist()
+                caps = capacity[ni_arr, :4].tolist()
 
         # The in-flight apply's allocs fold into component overlays, so
         # their frame rows (and nodes) must ride along too.
@@ -568,6 +811,18 @@ def _evaluate_window_vec(overlay, plans: list, executor,
                 touched_nis.add(ni)
         prep.frame = _Frame(mirror, frame_ids, touched_nis)
 
+    prep.devfit = None
+    if dev_args is not None:
+        try:
+            base_used, caps, prep.devfit, devinfo = \
+                _dispatch_window_fit(dev_mesh, dev_capres, dev_lease,
+                                     dev_args, vec_pair, vec_rows,
+                                     len(pairs))
+        except Exception:
+            # Rare (runtime teardown, device OOM): the window still
+            # verifies exactly — the caller's per-plan scalar path.
+            return None
+
     prep.verdicts = verdicts
     prep.pairs = pairs
     prep.base_used = base_used
@@ -577,12 +832,9 @@ def _evaluate_window_vec(overlay, plans: list, executor,
         pair_of[(i, nid)] = pair
     prep.pair_of = pair_of
 
-    # Pass 2: partition, schedule, walk.  Mirror lock released — the
-    # walks read only the frame, the base snapshot, and prep.
-    if partition:
-        comps = partition_window(plans)
-    else:
-        comps = [list(range(len(plans)))]
+    # Pass 2: schedule and walk the components computed up front.
+    # Mirror lock released — the walks read only the frame, the base
+    # snapshot, and prep.
     if len(comps) > 1:
         # Deadline-aware scheduling: nearest member deadline first
         # (ties by window position), so a near-deadline plan's
@@ -634,6 +886,10 @@ def _evaluate_window_vec(overlay, plans: list, executor,
         # How much wall the partition saved vs walking the same
         # components serially (1.0 = none; GIL-bound walks cap this).
         "speedup": (sum(comp_walls) / wall) if wall > 0 else 1.0,
+        # Device-verify engine record: None when the host engine ran by
+        # policy; else dispatch/fallback details for the applier's
+        # device_verify_* stats and the applier.verify.device span.
+        "device": devinfo,
     }
     return WindowVerdicts(slots, info)
 
@@ -656,6 +912,15 @@ def _walk_component(prep, comp: list) -> tuple:
     wm = _WindowState(prep.frame, prep.index_of)
     comp_view: Optional[OptimisticSnapshot] = None
     accepted_log: list = []
+    # Device fold verdicts apply only while the walk can PROVE the
+    # kernel's optimistic all-accepted prefix held for this component:
+    # window-unique alloc ids (seq_ok), no in-flight overlay folded in,
+    # and every earlier plan of the component fully accepted.  Any
+    # breach downgrades the REST of the component to the host
+    # arithmetic — which reads prep.base_used/prep.caps, numbers that
+    # are byte-identical under either engine.
+    dev = prep.devfit
+    dev_clean = dev is not None and dev.seq_ok
 
     comp_nodes: set = set()
     for i in comp:
@@ -678,6 +943,8 @@ def _walk_component(prep, comp: list) -> tuple:
                     picked[entry[0]] = entry[1]
         for k in sorted(picked):
             wm.fold(picked[k])  # in-flight apply: committed state
+        if picked:
+            dev_clean = False  # overlay state the kernel never saw
 
     def view() -> OptimisticSnapshot:
         # Exact-walk punts are rare; the component's OptimisticSnapshot
@@ -701,6 +968,7 @@ def _walk_component(prep, comp: list) -> tuple:
         fallback = (not nodes.isdisjoint(claimed)) or \
                    (not nodes.isdisjoint(inflight_nodes))
         result = PlanResult(failed_allocs=list(plan.failed_allocs))
+        plan_ok = True
         for nid in nodes:
             ok = pv.get(nid, _MISS)
             if ok is None:
@@ -711,26 +979,31 @@ def _walk_component(prep, comp: list) -> tuple:
                 pair = prep.pair_of[(i, nid)]
                 _i, _nid, ni, node, placements, removed = \
                     prep.pairs[pair]
-                u0, u1, u2, u3 = prep.base_used[pair]
-                d = wm.usage_delta.get(ni)
-                if d is not None:
-                    u0 += d[0]
-                    u1 += d[1]
-                    u2 += d[2]
-                    u3 += d[3]
-                for aid in removed:
-                    row = wm.alloc_row(aid)
-                    if row is not None and row[0] == ni:
-                        vec = row[1]
-                        u0 -= float(vec[0])
-                        u1 -= float(vec[1])
-                        u2 -= float(vec[2])
-                        u3 -= float(vec[3])
-                c = prep.caps[pair]
-                if not (u0 <= c[0] and u1 <= c[1] and u2 <= c[2]
-                        and u3 <= c[3]):
-                    ok = False
+                if dev_clean:
+                    # The kernel's overlay fold IS this arithmetic
+                    # (proof obligations met): take its verdict, keep
+                    # the exact net checks.
+                    ok = bool(dev.fits_seq[pair])
                 else:
+                    u0, u1, u2, u3 = prep.base_used[pair]
+                    d = wm.usage_delta.get(ni)
+                    if d is not None:
+                        u0 += d[0]
+                        u1 += d[1]
+                        u2 += d[2]
+                        u3 += d[3]
+                    for aid in removed:
+                        row = wm.alloc_row(aid)
+                        if row is not None and row[0] == ni:
+                            vec = row[1]
+                            u0 -= float(vec[0])
+                            u1 -= float(vec[1])
+                            u2 -= float(vec[2])
+                            u3 -= float(vec[3])
+                    c = prep.caps[pair]
+                    ok = (u0 <= c[0] and u1 <= c[1] and u2 <= c[2]
+                          and u3 <= c[3])
+                if ok:
                     # Port collisions + bandwidth: exact, against
                     # frame + component overlay (None punts the node
                     # to the scalar walk).
@@ -745,11 +1018,17 @@ def _walk_component(prep, comp: list) -> tuple:
                     result.node_allocation[nid] = \
                         plan.node_allocation[nid]
                 continue
+            plan_ok = False
             result.refresh_index = prep.refresh_index
             if plan.all_at_once:
                 result.node_update = {}
                 result.node_allocation = {}
                 break
+        if not plan_ok:
+            # A rejected claim (or an aborted all_at_once plan) means
+            # later plans in the component see an overlay the kernel's
+            # all-accepted prefix did not model.
+            dev_clean = False
         accepted = _accepted_allocs(result)
         accepted_log.append(accepted)
         if comp_view is not None:

@@ -649,6 +649,18 @@ class PlanApplier:
         self.commit_backpressure_s = 0.0
         self.dispatch_failures = 0
         self.gather_wall_s = 0.0  # wall spent in the window gather
+        # Device-verify engine (ops/verify_policy.py lever): windows
+        # whose base fit ran as one sharded dispatch against the
+        # resident twins, windows where a device-policy verify fell
+        # back to the host engine (cold lease, no mesh), and the
+        # counted explicit transfers those dispatches cost — off the
+        # parallel/devices odometer, so "zero implicit transfers"
+        # stays checkable per window.
+        self.device_verify_dispatches = 0
+        self.device_verify_fallbacks = 0
+        self.device_verify_h2d = 0
+        self.device_verify_d2h = 0
+        self.device_verify_wall_s = 0.0
         # Set by a committer job whose raft DISPATCH failed (nothing
         # entered the log): the overlay folded that window's allocs
         # before hand-off, so the applier must serialize the pipeline
@@ -914,6 +926,8 @@ class PlanApplier:
             dur_verify = tracer.now() - t_verify
             # perf_counter epoch -> tracer epoch for component t0s.
             perf_off = time.perf_counter() - tracer.now()
+            dev_span = info.get("device") if info is not None else None
+            dev_recorded = False
             for pending, outcome in zip(pendings, outcomes):
                 if not pending.plan.trace:
                     continue
@@ -940,6 +954,20 @@ class PlanApplier:
                         parent_ctx=wctx,
                         eval_id=pending.plan.eval_id,
                         component=0, fallback=outcome.fallback)
+                if dev_span is not None and dev_span.get("dispatched") \
+                        and not dev_recorded:
+                    # ONE per-window device-dispatch span, beside the
+                    # per-component applier.verify spans, anchored to
+                    # the first traced member's window span.
+                    dev_recorded = True
+                    tracer.record(
+                        "applier.verify.device", t_verify,
+                        dev_span.get("wall", 0.0), parent_ctx=wctx,
+                        window=len(pendings),
+                        pairs=dev_span.get("pairs", 0),
+                        bucket=dev_span.get("bucket", 0),
+                        h2d=dev_span.get("h2d", 0),
+                        d2h=dev_span.get("d2h", 0))
         committers = []  # (pending, result) with state to commit
         fallbacks = 0
         for pending, outcome in zip(pendings, outcomes):
@@ -957,6 +985,16 @@ class PlanApplier:
                 self.component_plans += len(pendings)
                 self._speedup_sum += info["speedup"]
                 self._speedup_n += 1
+                dev = info.get("device")
+                if dev is not None:
+                    if dev.get("dispatched"):
+                        self.device_verify_dispatches += 1
+                        self.device_verify_h2d += dev.get("h2d", 0)
+                        self.device_verify_d2h += dev.get("d2h", 0)
+                        self.device_verify_wall_s += \
+                            dev.get("wall", 0.0)
+                    else:
+                        self.device_verify_fallbacks += 1
         if not committers:
             _book()
             return wait_future, snap
@@ -1181,6 +1219,11 @@ class PlanApplier:
             backpressure_s = self.commit_backpressure_s
             dispatch_failures = self.dispatch_failures
             gather_wall_s = self.gather_wall_s
+            dev_dispatches = self.device_verify_dispatches
+            dev_fallbacks = self.device_verify_fallbacks
+            dev_h2d = self.device_verify_h2d
+            dev_d2h = self.device_verify_d2h
+            dev_wall_s = self.device_verify_wall_s
         return {
             "gather_wall_s": gather_wall_s,
             # The live knob positions (the control plane's actuators
@@ -1207,5 +1250,15 @@ class PlanApplier:
             "serial_ms_per_plan":
                 serial_s / serial_plans * 1000.0 if serial_plans
                 else 0.0,
+            # Device-verify engine counters (NOMAD_TPU_VERIFY): sharded
+            # window dispatches, device-policy windows that fell back
+            # to the host engine, and the per-window explicit-transfer
+            # odometer deltas those dispatches cost (descriptor h2d +
+            # the three fetched results d2h; never a fleet tensor).
+            "device_verify_dispatches": dev_dispatches,
+            "device_verify_fallbacks": dev_fallbacks,
+            "device_verify_h2d": dev_h2d,
+            "device_verify_d2h": dev_d2h,
+            "device_verify_wall_s": dev_wall_s,
             "windows": windows,
         }

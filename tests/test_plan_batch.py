@@ -404,6 +404,191 @@ class TestSequentialParity:
 
 
 # ---------------------------------------------------------------------------
+# 2b. host vs device verify-engine parity (NOMAD_TPU_VERIFY)
+# ---------------------------------------------------------------------------
+
+def device_grouped_apply(store: StateStore, plans: list,
+                         base_index: int) -> list:
+    """grouped_apply through the DEVICE verify engine, with the
+    cold-start warm-up (the first window after a mirror rebuild always
+    falls back — the window-lease rule) and a hard assertion that the
+    replayed window actually dispatched: a silent fallback would test
+    host against host and prove nothing."""
+    from nomad_tpu.ops.verify_policy import verify_override
+
+    with verify_override("device"):
+        evaluate_window(store, plans)          # warm the lease
+        probe = evaluate_window(store, plans)  # store untouched
+        dev = probe.info["device"] if probe.info else None
+        assert dev is not None and dev["dispatched"], \
+            f"device verify did not dispatch: {dev}"
+        return grouped_apply(store, plans, base_index)
+
+
+class TestDeviceVerifyParity:
+    """The device engine's acceptance bar: verdict stream, alloc set
+    and store fingerprint byte-identical to the host engine (and to
+    sequential truth) on every rig, with the dispatch PROVEN."""
+
+    def test_recorded_storm_host_vs_device(self):
+        """The recorded contended storm (same recipe as the grouped
+        parity rig) replayed through the host engine and through a
+        dispatched device window, byte-compared."""
+        from nomad_tpu.ops.verify_policy import verify_override
+        from nomad_tpu.scheduler import Harness
+        from nomad_tpu.scheduler.batch import BatchEvalRunner
+        from nomad_tpu.scheduler.harness import VerifyingPlanner
+        from nomad_tpu.structs import (EVAL_TRIGGER_JOB_REGISTER,
+                                       Task, TaskGroup)
+
+        nodes = [mock.node(i) for i in range(8)]
+        h = Harness()
+        for n in nodes:
+            h.state.upsert_node(h.next_index(), n.copy())
+        jobs = []
+        for j in range(6):
+            job = mock.job()
+            job.task_groups = [
+                TaskGroup(name=f"tg-{g}", count=2,
+                          tasks=[Task(name="web", driver="exec",
+                                      resources=Resources(
+                                          cpu=600, memory_mb=256,
+                                          networks=[NetworkResource(
+                                              mbits=5,
+                                              dynamic_ports=["http"])]))])
+                for g in range(4)]
+            h.state.upsert_job(h.next_index(), job)
+            jobs.append(job)
+        h.planner = VerifyingPlanner(h)
+        evals = [Evaluation(id=generate_uuid(), priority=50,
+                            type=j.type,
+                            triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+                            job_id=j.id) for j in jobs]
+        BatchEvalRunner(h.state.snapshot(), h,
+                        state_refresh=h.snapshot).process(evals)
+        plans = h.plans
+        assert plans, "storm recorded no plans"
+        _stamp_adversarial_deadlines(plans)
+
+        def world():
+            store = StateStore()
+            for i, n in enumerate(nodes):
+                store.upsert_node(1000 + i, n.copy())
+            return store
+
+        s_seq = world()
+        res_seq = sequential_apply(s_seq, plans, 5000)
+        s_host = world()
+        with verify_override("host"):
+            res_host = grouped_apply(s_host, plans, 5000)
+        s_dev = world()
+        res_dev = device_grouped_apply(s_dev, plans, 5000)
+        assert [result_key(r) for r in res_seq] == \
+            [result_key(r) for r in res_host] == \
+            [result_key(r) for r in res_dev]
+        assert store_image(s_seq) == store_image(s_host) \
+            == store_image(s_dev)
+
+    @pytest.mark.parametrize("n_nodes", [8, 24, 64])
+    def test_seeded_random_windows_across_fleet_sizes(self, n_nodes):
+        """Seeded random contended windows at three fleet sizes —
+        including evict-frees-capacity and port-collision shapes — each
+        replayed sequentially, through the host engine, and through a
+        dispatched device window; all three byte-compared."""
+        import random
+
+        from nomad_tpu.ops.verify_policy import verify_override
+
+        rng = random.Random(171_000 + n_nodes)
+        nodes = [mock.node(i) for i in range(n_nodes)]
+        # Standing allocs: every third node starts near-full so random
+        # refills contend, and their evictions free real capacity.
+        existing = [make_alloc(nodes[i], cpu=FREE_CPU - 500)
+                    for i in range(0, n_nodes, 3)]
+
+        def world():
+            store = StateStore()
+            for i, n in enumerate(nodes):
+                store.upsert_node(1000 + i, n)
+            store.upsert_allocs(1500, existing)
+            return store
+
+        plans = []
+        hot = nodes[:max(2, n_nodes // 4)]  # contention focus
+        for _ in range(24):
+            kind = rng.random()
+            if kind < 0.25:
+                # Evict-frees-capacity: stop a standing alloc, refill
+                # the node to the brim in a LATER plan.
+                victim = rng.choice(existing)
+                evict = Plan(eval_id=generate_uuid())
+                evict.append_update(victim,
+                                    ALLOC_DESIRED_STATUS_STOP, "churn")
+                plans.append(evict)
+                node = next(n for n in nodes if n.id == victim.node_id)
+                plans.append(place_plan(make_alloc(node, cpu=FREE_CPU)))
+            elif kind < 0.45:
+                # Port collision: two claims on one hot node, one
+                # shared static port — the later one must reject.
+                node = rng.choice(hot)
+                port = 8000 + rng.randrange(4)
+                plans.append(place_plan(net_alloc(node, ports=[port])))
+                plans.append(place_plan(net_alloc(node, ports=[port])))
+            elif kind < 0.7:
+                # Over-commit pressure on a hot node.
+                node = rng.choice(hot)
+                plans.append(place_plan(make_alloc(
+                    node, cpu=rng.choice((500, 1500, FREE_CPU)))))
+            else:
+                # Clean placement on a random node.
+                node = rng.choice(nodes)
+                plans.append(place_plan(make_alloc(
+                    node, cpu=rng.choice((100, 400, 900)))))
+        _stamp_adversarial_deadlines(plans)
+
+        s_seq = world()
+        res_seq = sequential_apply(s_seq, plans, 5000)
+        s_host = world()
+        with verify_override("host"):
+            res_host = grouped_apply(s_host, plans, 5000)
+        s_dev = world()
+        res_dev = device_grouped_apply(s_dev, plans, 5000)
+        assert [result_key(r) for r in res_seq] == \
+            [result_key(r) for r in res_host] == \
+            [result_key(r) for r in res_dev]
+        assert store_image(s_seq) == store_image(s_host) \
+            == store_image(s_dev)
+
+    def test_device_info_and_fallback_taxonomy(self):
+        """The window info record: host policy reports no device entry,
+        a cold device window reports the lease-miss fallback, a warmed
+        one reports the dispatch with its counted transfers."""
+        from nomad_tpu.ops.verify_policy import verify_override
+
+        nodes = [mock.node(i) for i in range(8)]
+        store = StateStore()
+        for i, n in enumerate(nodes):
+            store.upsert_node(1000 + i, n)
+        plans = [place_plan(make_alloc(n, cpu=100)) for n in nodes]
+
+        with verify_override("host"):
+            out = evaluate_window(store, plans)
+            assert out.info["device"] is None
+
+        with verify_override("device"):
+            cold = evaluate_window(store, plans)
+            dev = cold.info["device"]
+            if not dev["dispatched"]:  # twins may be resident already
+                assert dev["fallback"] in ("lease-miss", "capres-miss")
+            warm = evaluate_window(store, plans)
+            dev = warm.info["device"]
+            assert dev["dispatched"] and dev["fallback"] is None
+            assert dev["pairs"] == len(plans)
+            assert dev["d2h"] == 3  # used/caps/fits through fetch_host
+            assert dev["bucket"] >= dev["pairs"]
+
+
+# ---------------------------------------------------------------------------
 # 3. the applier's window drain + one-raft-apply commit
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,159 @@
+"""Port of the reference scheduler's util tables
+(/root/reference/scheduler/util_test.go): diffAllocs, taintedNodes and
+shuffleNodes, re-expressed over the repo's mocks — same case sets, same
+bucket counts, same membership assertions as the Go tests.
+
+diff_allocs buckets (scheduler/util.py):
+  stop     — existing alloc whose name is no longer required,
+  migrate  — required, but its node is tainted (down/draining/missing),
+  update   — required on a clean node, but the alloc was created from an
+             older job version (modify_index mismatch),
+  ignore   — required, clean node, current job version,
+  place    — required names with no existing alloc.
+"""
+from __future__ import annotations
+
+import random
+
+import nomad_tpu.mock as mock
+from nomad_tpu.scheduler.util import (
+    diff_allocs,
+    materialize_task_groups,
+    shuffle_nodes,
+    tainted_nodes,
+)
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import NODE_STATUS_DOWN, generate_uuid
+
+
+class TestDiffAllocs:
+    """util_test.go TestDiffAllocs: 10 required web instances, 4
+    existing allocs that hit each non-place bucket exactly once, and
+    the remaining 7 required names placed."""
+
+    def test_table(self):
+        job = mock.job()          # my-job: web x 10, modify_index 99
+        required = materialize_task_groups(job)
+        assert len(required) == 10
+        assert "my-job.web[0]" in required
+
+        old_job = mock.job()
+        old_job.modify_index = job.modify_index - 1
+
+        tainted = {"dead": True}
+
+        ignore_alloc = mock.alloc()
+        ignore_alloc.id = generate_uuid()
+        ignore_alloc.node_id = "zip"
+        ignore_alloc.name = "my-job.web[0]"
+        ignore_alloc.job = job
+
+        stop_alloc = mock.alloc()
+        stop_alloc.id = generate_uuid()
+        stop_alloc.node_id = "zip"
+        stop_alloc.name = "my-job.web[10]"   # beyond count: not required
+        stop_alloc.job = old_job
+
+        migrate_alloc = mock.alloc()
+        migrate_alloc.id = generate_uuid()
+        migrate_alloc.node_id = "dead"
+        migrate_alloc.name = "my-job.web[2]"
+        migrate_alloc.job = old_job
+
+        update_alloc = mock.alloc()
+        update_alloc.id = generate_uuid()
+        update_alloc.node_id = "zip"
+        update_alloc.name = "my-job.web[1]"
+        update_alloc.job = old_job
+
+        allocs = [ignore_alloc, stop_alloc, migrate_alloc, update_alloc]
+        diff = diff_allocs(job, tainted, dict(required), allocs)
+
+        assert [t.alloc for t in diff.ignore] == [ignore_alloc]
+        assert [t.alloc for t in diff.stop] == [stop_alloc]
+        assert [t.alloc for t in diff.migrate] == [migrate_alloc]
+        assert [t.alloc for t in diff.update] == [update_alloc]
+
+        # Everything required and not existing gets placed: 10 - web[0]
+        # (ignored) - web[1] (updated) - web[2] (migrated) = 7.  The
+        # stopped web[10] does not count against required names.
+        assert len(diff.place) == 7
+        placed = {t.name for t in diff.place}
+        assert placed == {f"my-job.web[{i}]" for i in range(10)} - {
+            "my-job.web[0]", "my-job.web[1]", "my-job.web[2]"}
+        for t in diff.place:
+            assert t.alloc is None
+            assert t.task_group is job.task_groups[0]
+
+    def test_update_bucket_carries_new_task_group(self):
+        # The update tuple's task_group is the *new* job's group (the
+        # required-map value), so in-place updates re-resource against
+        # the new definition — same contract the Go diff relies on.
+        job = mock.job()
+        old_job = mock.job()
+        old_job.modify_index = job.modify_index - 1
+        a = mock.alloc()
+        a.node_id = "zip"
+        a.name = "my-job.web[3]"
+        a.job = old_job
+        diff = diff_allocs(job, {}, dict(materialize_task_groups(job)),
+                           [a])
+        (tup,) = diff.update
+        assert tup.task_group is job.task_groups[0]
+
+
+class TestTaintedNodes:
+    """util_test.go TestTaintedNodes: ready node clean, draining node
+    tainted, down node tainted, missing node tainted; one map entry per
+    distinct node referenced by the allocs."""
+
+    def test_table(self):
+        store = StateStore()
+        node1 = mock.node()                      # ready
+        node2 = mock.node()
+        node2.drain = True                       # draining
+        node3 = mock.node()
+        node3.status = NODE_STATUS_DOWN          # down
+        for i, n in enumerate((node1, node2, node3)):
+            store.upsert_node(1000 + i, n)
+
+        missing_id = "12345678-abcd-efab-cdef-123456789abc"
+        allocs = []
+        for nid in (node1.id, node2.id, node3.id, missing_id):
+            a = mock.alloc()
+            a.node_id = nid
+            allocs.append(a)
+
+        tainted = tainted_nodes(store.snapshot(), allocs)
+        assert len(tainted) == 4
+        assert tainted[node1.id] is False
+        assert tainted[node2.id] is True
+        assert tainted[node3.id] is True
+        assert tainted[missing_id] is True
+
+    def test_dedupes_per_node(self):
+        # Two allocs on the same node produce one map entry (the Go
+        # loop's `if _, ok := out[alloc.NodeID]; ok { continue }`).
+        store = StateStore()
+        node = mock.node()
+        store.upsert_node(1000, node)
+        a1, a2 = mock.alloc(), mock.alloc()
+        a1.node_id = node.id
+        a2.node_id = node.id
+        tainted = tainted_nodes(store.snapshot(), [a1, a2])
+        assert tainted == {node.id: False}
+
+
+class TestShuffleNodes:
+    """util_test.go TestShuffleNodes: order changes, membership and
+    length don't."""
+
+    def test_table(self):
+        nodes = [mock.node(i) for i in range(10)]
+        orig = list(nodes)
+        # Seeded rng: deterministic, and guaranteed != identity for
+        # this seed/length (checked below rather than assumed).
+        shuffle_nodes(nodes, rng=random.Random(171))
+        assert nodes != orig
+        assert len(nodes) == len(orig)
+        assert {n.id for n in nodes} == {n.id for n in orig}

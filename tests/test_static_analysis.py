@@ -680,6 +680,74 @@ class TestLintGate:
                 f"device-plane rule {rule} must not need allowlist " \
                 "entries (use a justified in-code devlint-ok marker)"
 
+    def test_device_verify_rides_the_gates(self):
+        """ISSUE 17 satellite: the device-resident window verify — the
+        window kernel + sharded wrapper (parallel/mesh.py), the
+        dispatch + descriptor builders (ops/plan_conflict.py), the
+        residency lease (models/fleet.py UsageMirror.window_lease) and
+        the policy lever (ops/verify_policy.py) — is inside every
+        gate's scan set: interprocedural callgraph, devlint
+        strict-clean with the new kernel DISCOVERED, the transfer-guard
+        sanitizer wrapping the verify seams, the recompile sentinel
+        budgeting the kernel, and ZERO allowlist entries of its own."""
+        from nomad_tpu.analysis import default_package_root
+        from nomad_tpu.analysis import devlint
+        from nomad_tpu.analysis.callgraph import CallGraph
+        from nomad_tpu.analysis.sanitizers import (KERNEL_REGISTRY,
+                                                   TRANSFER_SEAMS)
+
+        pkg = default_package_root()
+        graph = CallGraph.build(pkg)
+        for qual in (
+            "nomad_tpu.parallel.mesh:window_verify_sharded",
+            "nomad_tpu.ops.plan_conflict:_dispatch_window_fit",
+            "nomad_tpu.ops.plan_conflict:_window_device_args",
+            "nomad_tpu.models.fleet:UsageMirror.window_lease",
+            "nomad_tpu.ops.verify_policy:verify_policy",
+            "nomad_tpu.ops.verify_policy:set_verify_policy",
+        ):
+            assert qual in graph.functions, \
+                f"{qual} missing from the interprocedural graph"
+
+        # The runtime gates know the new paths: the recompile sentinel
+        # budgets the window kernel (bucketed shapes — distinct window
+        # sizes must not retrace), and the transfer guard wraps BOTH
+        # verify seams (the sharded wrapper and the dispatch site), so
+        # an implicit h2d on the verify hot path fails the suite.
+        assert ("nomad_tpu.parallel.mesh", "_window_verify_jit") \
+            in KERNEL_REGISTRY
+        assert ("nomad_tpu.parallel.mesh", None,
+                "window_verify_sharded") in TRANSFER_SEAMS
+        assert ("nomad_tpu.ops.plan_conflict", None,
+                "_dispatch_window_fit") in TRANSFER_SEAMS
+
+        cov: dict = {}
+        findings = devlint.analyze_package(pkg, graph=graph,
+                                           coverage_out=cov)
+        # 4 unsharded binpack kernels + sharded twins + the window
+        # verify kernel: the family grew.
+        assert cov["kernels"] >= 9, cov
+        assert cov["host_args"] == 0, cov
+        assert findings == [], \
+            "device verify must devlint clean:\n" + \
+            "\n".join(f.render() for f in findings)
+
+        allowlist = load_allowlist(default_allowlist_path())
+        gating, _allowed, _stale = partition_findings(
+            run_lint(strict=True), allowlist)
+        touching = [f for f in gating
+                    if "plan_conflict" in f.path
+                    or "verify_policy" in f.path
+                    or "parallel/mesh" in f.path]
+        assert touching == [], \
+            "device-verify paths must lint clean:\n" + \
+            "\n".join(f.render() for f in touching)
+        assert not any("verify_policy" in e or "window_verify" in e
+                       or "window_lease" in e
+                       or "_dispatch_window_fit" in e
+                       for e in allowlist), \
+            "device verify must not need allowlist entries"
+
     def test_lint_json_reports_devlint_coverage(self, capsys):
         """The device-plane passes' self-coverage rides the same -json
         block as the call graph's (blind spots visible, not silent)."""
