@@ -259,6 +259,8 @@ class HTTPServer:
                 self._accept()
             elif key.data == "wake":
                 try:
+                    # faultlint-ok(uninjectable-io): socketpair
+                    # self-wake drain — process-local plumbing.
                     while self._wake_r.recv(4096):
                         pass
                 except (BlockingIOError, OSError):
@@ -280,6 +282,10 @@ class HTTPServer:
     def _accept(self) -> None:
         while True:
             try:
+                # faultlint-ok(uninjectable-io): agent-local HTTP API
+                # plane, not the cluster RPC transport (mux.accept /
+                # conn.read cover that); HTTP failure handling is
+                # driven directly by the HTTP tests.
                 sock, addr = self._listener.accept()
             except (BlockingIOError, OSError):
                 return

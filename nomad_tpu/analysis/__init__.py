@@ -113,8 +113,8 @@ def run_lint(package_dir: Optional[str] = None,
     resolved vs dynamic) — the analyzer's own blind spots, surfaced in
     ``nomad-tpu lint --json`` instead of silent.
     """
-    from . import (blocking, callgraph, consensuslint, devlint, jaxlint,
-                   lockcheck)
+    from . import (blocking, callgraph, consensuslint, devlint, faultlint,
+                   jaxlint, lockcheck)
 
     package_dir = package_dir or default_package_root()
     if not os.path.isdir(package_dir):
@@ -137,6 +137,10 @@ def run_lint(package_dir: Optional[str] = None,
     findings.extend(consensuslint.analyze_package(package_dir, graph=graph,
                                                   scan=scan,
                                                   coverage_out=cons_cov))
+    fault_cov: dict = {}
+    findings.extend(faultlint.analyze_package(package_dir, graph=graph,
+                                              scan=scan,
+                                              coverage_out=fault_cov))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     if coverage_out is not None:
         coverage_out.update(graph.coverage())
@@ -148,6 +152,11 @@ def run_lint(package_dir: Optional[str] = None,
         # size, fence targets, and the endpoint read-consistency
         # contract table (ROADMAP item 1's machine-readable input).
         coverage_out["consensuslint"] = cons_cov
+        # The failure-plane passes' self-coverage: serving-entry
+        # closure size, the boundary→fault-site coverage table (the
+        # injectability contract the chaos suite drives), and the
+        # retry-closure census.
+        coverage_out["faultlint"] = fault_cov
     return findings
 
 

@@ -32,6 +32,19 @@ ShardedResidency), so the guard can reject everything implicit without
 false positives.  Direct kernel calls outside the scheduler seams
 (parity tests feeding host arrays on purpose) are unaffected.
 
+**BudgetWitnessSanitizer** — the runtime twin of faultlint's deadline
+pass.  While a thread is inside an admitted RPC body
+(``Endpoints._admitted_body``, heartbeat/liveness lane excluded), the
+blocking primitives (``Event.wait`` / ``Condition.wait`` /
+``Queue.get``) are wrapped to record any wait entered with NO timeout:
+a ``timeout=None`` that the static pass can't see (a variable that
+evaluates to None at runtime, a default leaking through a helper)
+is caught on the actual serving thread, with the wait's stack, and
+fails the test that caused it at its teardown.  Observe-only: the
+wait still runs; cross-thread handoffs (a serving thread parking work
+for an applier thread) are out of scope — faultlint's loop-surface
+entries cover those statically.
+
 All are opt-in via install()/uninstall() and wired into the test suite
 by tests/test_static_analysis.py (and conftest, env-gated) — see
 README "Static analysis & sanitizers".
@@ -622,3 +635,154 @@ class ReplicaDivergenceSanitizer:
 
 def _noop_spans(*args, **kwargs) -> None:
     return None
+
+
+# ---------------------------------------------------------------------------
+# Budget witness
+# ---------------------------------------------------------------------------
+
+class BudgetWitnessSanitizer:
+    """Records unbounded waits taken on a thread serving an admitted RPC.
+
+    The deadline discipline (server/overload.py) says every wait on a
+    request path consumes the admitted envelope's budget.  faultlint
+    proves the *syntactic* form; this witness proves the runtime one: a
+    ``timeout=None`` hiding behind a variable or a default argument is
+    invisible to the AST but lands here, on the actual serving thread,
+    with the wait's call stack.
+
+    Waits are recorded, never blocked — the per-test ``check_test()``
+    (conftest ``budget_quiescence``) fails the offending test and
+    resets; session ``check()`` is the catch-all for hits recorded
+    outside any test body.  The heartbeat/liveness lane is exempt, same
+    as the static pass.
+    """
+
+    def __init__(self, package_prefix: Optional[str] = None) -> None:
+        if package_prefix is None:
+            package_prefix = os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))
+        self.package_prefix = os.path.abspath(package_prefix)
+        self.hits: list = []        # (method, primitive, test, stack)
+        self._tls = threading.local()
+        self._hits_lock = _real_lock()
+        self._installed = False
+        self._saved: list = []
+
+    # -- install/uninstall --------------------------------------------------
+    def install(self) -> "BudgetWitnessSanitizer":
+        if self._installed:
+            return self
+        import queue
+
+        from nomad_tpu.server.endpoints import Endpoints
+        from nomad_tpu.server.overload import HEARTBEAT_LANE
+
+        san = self
+        orig_body = Endpoints._admitted_body
+        # Patch the REAL primitive classes saved at import time:
+        # LockOrderWitness rebinds the threading.Condition *name* to a
+        # factory, but its instances are still _real_condition objects,
+        # so the method patch covers both installation orders.
+        orig_event_wait = threading.Event.wait
+        orig_cond_wait = _real_condition.wait
+        orig_get = queue.Queue.get
+        self._saved = [(Endpoints, "_admitted_body", orig_body),
+                       (threading.Event, "wait", orig_event_wait),
+                       (_real_condition, "wait", orig_cond_wait),
+                       (queue.Queue, "get", orig_get)]
+
+        def admitted_body(ep, method, handler, args):
+            if method in HEARTBEAT_LANE or "heartbeat" in method.lower():
+                return orig_body(ep, method, handler, args)
+            prev = getattr(san._tls, "serving", None)
+            san._tls.serving = method
+            try:
+                return orig_body(ep, method, handler, args)
+            finally:
+                san._tls.serving = prev
+
+        def record(primitive: str) -> None:
+            method = getattr(san._tls, "serving", None)
+            if method is None:
+                return
+            # Only PACKAGE wait sites count — stdlib-internal waits
+            # (Thread.start's _started handshake, Queue.get's internal
+            # Condition) are not budget holders; this is the same
+            # domain restriction the static pass has.
+            caller = sys._getframe(2).f_code.co_filename
+            if not os.path.abspath(caller).startswith(
+                    san.package_prefix):
+                return
+            import traceback
+
+            # Drop the two witness frames; keep the caller's chain.
+            stack = "".join(traceback.format_stack(limit=14)[:-2])
+            test = os.environ.get("PYTEST_CURRENT_TEST", "<no test>")
+            with san._hits_lock:
+                san.hits.append((method, primitive, test, stack))
+
+        def event_wait(ev, timeout=None):
+            if timeout is None:
+                record("Event.wait")
+            return orig_event_wait(ev, timeout)
+
+        def cond_wait(cond, timeout=None):
+            if timeout is None:
+                record("Condition.wait")
+            return orig_cond_wait(cond, timeout)
+
+        def queue_get(q, block=True, timeout=None):
+            if block and timeout is None:
+                record("Queue.get")
+            return orig_get(q, block, timeout)
+
+        Endpoints._admitted_body = admitted_body
+        threading.Event.wait = event_wait
+        _real_condition.wait = cond_wait
+        queue.Queue.get = queue_get
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for holder, attr, fn in self._saved:
+            setattr(holder, attr, fn)
+        self._saved = []
+        self._installed = False
+
+    def __enter__(self) -> "BudgetWitnessSanitizer":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # -- reporting ----------------------------------------------------------
+    def _render(self, hits: list) -> str:
+        lines = []
+        for method, primitive, test, stack in hits:
+            lines.append(
+                f"unbounded {primitive} while serving {method} "
+                f"(test: {test}):\n{stack}")
+        return (
+            "budget-witness: wait with no timeout on an RPC-serving "
+            "thread — the admitted envelope's budget was dropped (see "
+            "analysis/faultlint.py deadline pass):\n" +
+            "\n".join(lines))
+
+    def check_test(self) -> None:
+        """Per-test teardown: fail THIS test on any hit it recorded,
+        then reset so later tests report only their own."""
+        with self._hits_lock:
+            hits, self.hits = self.hits, []
+        if hits:
+            raise AssertionError(self._render(hits))
+
+    def check(self) -> None:
+        """Session catch-all for hits recorded outside any test body
+        (module fixtures, background threads between tests)."""
+        with self._hits_lock:
+            hits = list(self.hits)
+        if hits:
+            raise AssertionError(self._render(hits))

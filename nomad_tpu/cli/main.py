@@ -184,6 +184,11 @@ def main(argv=None) -> int:
                    help="only report findings in files touched since "
                         "REV (git diff --name-only REV); the stale-"
                         "allowlist gate is skipped in this mode")
+    p.add_argument("-sarif", metavar="PATH", default="",
+                   help="also write a SARIF 2.1.0 log (findings + "
+                        "coverage under run properties) to PATH; "
+                        "composes with -changed (the SARIF carries "
+                        "the filtered set)")
 
     args = parser.parse_args(argv)
     if not args.command:
@@ -766,13 +771,28 @@ def cmd_lint(args) -> int:
         advisory = [f for f in advisory if f.path in touched]
         stale = []
 
+    sarif_path = getattr(args, "sarif", "")
+    if sarif_path:
+        try:
+            with open(sarif_path, "w") as fh:
+                json.dump(_sarif_log(gating, advisory, coverage), fh,
+                          indent=2)
+        except OSError as e:
+            print(f"Error: cannot write SARIF log: {e}",
+                  file=sys.stderr)
+            return 1
+
     if args.as_json:
         print(json.dumps({
             # Bumped when the JSON shape changes incompatibly (keys
             # removed/renamed); additive coverage blocks don't bump it.
             # v2 = schema_version + the consensuslint coverage block
             # with the endpoint read-consistency contract table.
-            "schema_version": 2,
+            # v3 = the faultlint coverage block: serving-entry deadline
+            # closure, the boundary->fault-site coverage table
+            # (coverage.faultlint.boundaries, every row covered or
+            # waived), and the retry-closure census.
+            "schema_version": 3,
             "gating": [f.__dict__ for f in gating],
             "advisory": [f.__dict__ for f in advisory],
             "allowlisted": len(allowed),
@@ -796,6 +816,51 @@ def cmd_lint(args) -> int:
     return 1 if gating or stale else 0
 
 
+def _sarif_log(gating, advisory, coverage: dict) -> dict:
+    """SARIF 2.1.0 log for the lint run: one run, one result per
+    finding (gating = error, advisory = note), the rule inventory in
+    the tool driver, and the full coverage block — including
+    faultlint's boundary->fault-site table — under run properties so
+    SARIF consumers see the proof surface, not just the findings."""
+    rules: dict = {}
+    results = []
+    for f, level in [(f, "error") for f in gating] + \
+                    [(f, "note") for f in advisory]:
+        rules.setdefault(f.rule, {
+            "id": f.rule,
+            "defaultConfiguration": {"level": level},
+        })
+        results.append({
+            "ruleId": f.rule,
+            "level": level,
+            "message": {"text": f"{f.where}: {f.message}"},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path.replace(os.sep,
+                                                               "/")},
+                    "region": {"startLine": max(f.line, 1)},
+                },
+            }],
+        })
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "nomad-tpu-lint",
+                "version": __version__,
+                "informationUri":
+                    "https://github.com/kardianos/nomad",
+                "rules": sorted(rules.values(),
+                                key=lambda r: r["id"]),
+            }},
+            "results": results,
+            "properties": {"coverage": coverage},
+        }],
+    }
+
+
 def _changed_files(rev: str, package_path) -> "set | None":
     """Repo-relative paths touched since ``rev`` (committed AND working
     tree), resolved against the repo holding the analyzed package."""
@@ -815,6 +880,8 @@ def _changed_files(rev: str, package_path) -> "set | None":
             ["git", "-C", root, "diff", "--name-only", "--relative",
              rev],
             capture_output=True, text=True, check=True, timeout=30)
+        # faultlint-ok(uninjectable-io): dev-tooling git probe inside
+        # the lint CLI itself — never on a serving path.
         untracked = subprocess.run(
             ["git", "-C", root, "ls-files", "--others",
              "--exclude-standard"],

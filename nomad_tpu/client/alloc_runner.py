@@ -128,6 +128,9 @@ class AllocRunner:
         tmp = self._state_path() + ".tmp"
         with open(tmp, "w") as fh:
             json.dump({"alloc": self.alloc.to_dict()}, fh)
+        # faultlint-ok(uninjectable-io): client-local checkpoint; the
+        # crash sites cover the server storage planes, and the client
+        # restore path is driven directly by its tests.
         os.replace(tmp, self._state_path())
 
     @classmethod

@@ -180,6 +180,10 @@ class Driver:
         stderr = open(self.ctx.alloc_dir.log_path(task.name, "stderr"),
                       "ab")
         try:
+            # faultlint-ok(uninjectable-io): the exec boundary itself;
+            # driver.start is consulted at the task_runner seam one
+            # frame above — the arming edge goes through the driver
+            # registry (dynamic), invisible to the resolved-edge walk.
             proc = subprocess.Popen(
                 argv,
                 cwd=cwd or task_dir,

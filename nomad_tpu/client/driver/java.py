@@ -24,6 +24,8 @@ class JavaDriver(Driver):
         if java is None:
             return False
         try:
+            # faultlint-ok(uninjectable-io): fingerprint probe — any
+            # failure means "driver absent", the degraded mode itself.
             out = subprocess.run([java, "-version"], capture_output=True,
                                  text=True, timeout=5)
             version_line = (out.stderr or out.stdout).splitlines()[0]

@@ -27,6 +27,8 @@ class QemuDriver(Driver):
         if qemu is None:
             return False
         try:
+            # faultlint-ok(uninjectable-io): fingerprint probe — any
+            # failure means "driver absent", the degraded mode itself.
             out = subprocess.run([qemu, "--version"], capture_output=True,
                                  text=True, timeout=5)
             m = re.search(r"version ([\d.]+)", out.stdout)

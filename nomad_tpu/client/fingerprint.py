@@ -112,6 +112,8 @@ def _default_ip() -> str:
         s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         try:
             # No packets are sent; picks the default-route source address.
+            # faultlint-ok(uninjectable-io): routing-table lookup, no
+            # traffic; OSError already falls back to loopback below.
             s.connect(("10.255.255.255", 1))
             return s.getsockname()[0]
         finally:

@@ -144,6 +144,8 @@ class FlightRecorder:
         tmp = path + ".tmp"
         with open(tmp, "w") as fh:
             json.dump(doc, fh, default=str)
+        # faultlint-ok(uninjectable-io): observability plane — incident
+        # snapshots never feed replicated or serving state.
         os.replace(tmp, path)
         self._prune()
         return path

@@ -521,6 +521,9 @@ class JaxBinPackScheduler(GenericScheduler, FastPlacementMixin):
             self.deferred = (place, args)
             return
         handles = self.dispatch_device(args)
+        # faultlint-ok(uninjectable-io): synchronous compute lane (no
+        # pipeline, no breaker); the injectable device seam is the
+        # pipelined runner's dispatch/collect pair.
         chosen, scores = self.collect_device(args, handles)
         self.finish_deferred(place, args, chosen, scores)
 

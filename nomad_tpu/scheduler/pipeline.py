@@ -86,6 +86,10 @@ class _CollectWorker:
 
     def _run(self) -> None:
         while True:
+            # faultlint-ok(unbounded-wait): idle watchdog-worker
+            # parking — exit rides the None sentinel; the collect
+            # DEADLINE lives on the outq.get in
+            # _collect_device_bounded, not here.
             fn = self.inq.get()
             if fn is None:
                 return
@@ -455,6 +459,9 @@ class PipelinedEvalRunner(BatchEvalRunner):
 
         sched = it.sched
         if sched.dispatched_host:
+            # faultlint-ok(uninjectable-io): host-lane collect (the
+            # work never went to the device); the device seam consults
+            # device.collect in _collect_device_bounded.
             return sched.collect_device(it.args, it.handles)
         try:
             t_col = time.perf_counter()
@@ -531,4 +538,7 @@ class PipelinedEvalRunner(BatchEvalRunner):
     def _host_rerun(self, it: _Item) -> tuple:
         """Re-run one eval's placement on the host twin kernels."""
         handles = it.sched.dispatch_host(it.args)
+        # faultlint-ok(uninjectable-io): host-twin rerun AFTER a device
+        # fault — injecting here would fault the very fallback the
+        # breaker depends on.
         return it.sched.collect_device(it.args, handles)

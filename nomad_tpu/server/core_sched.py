@@ -13,6 +13,7 @@ import time
 
 from nomad_tpu.structs import (
     CORE_JOB_EVAL_GC,
+    CORE_JOB_FORCE_GC,
     CORE_JOB_NODE_GC,
     Evaluation,
     codec,
@@ -28,19 +29,30 @@ class CoreScheduler:
         self.server = server
         self.snap = snap
 
+    # Force GC runs the collectors with the age gate bypassed: any
+    # terminal object is fair game regardless of modify_index
+    # (reference uses math.MaxUint64 as the force threshold).
+    FORCE_THRESHOLD = 2 ** 63
+
     def process(self, ev: Evaluation) -> None:
         if ev.job_id == CORE_JOB_EVAL_GC:
             self._eval_gc()
         elif ev.job_id == CORE_JOB_NODE_GC:
             self._node_gc()
+        elif ev.job_id == CORE_JOB_FORCE_GC:
+            self._eval_gc(force=True)
+            self._node_gc(force=True)
         else:
             raise ValueError(
                 f"core scheduler cannot handle job '{ev.job_id}'")
 
-    def _eval_gc(self) -> None:
-        tt = self.server.fsm.timetable
-        cutoff = time.time() - self.server.config.eval_gc_threshold
-        old_threshold = tt.nearest_index(cutoff)
+    def _eval_gc(self, force: bool = False) -> None:
+        if force:
+            old_threshold = self.FORCE_THRESHOLD
+        else:
+            tt = self.server.fsm.timetable
+            cutoff = time.time() - self.server.config.eval_gc_threshold
+            old_threshold = tt.nearest_index(cutoff)
 
         gc_evals, gc_allocs = [], []
         for ev in self.snap.evals():
@@ -60,10 +72,13 @@ class CoreScheduler:
         self.server.raft_apply(codec.EVAL_DELETE_REQUEST,
                                {"evals": gc_evals, "allocs": gc_allocs})
 
-    def _node_gc(self) -> None:
-        tt = self.server.fsm.timetable
-        cutoff = time.time() - self.server.config.node_gc_threshold
-        old_threshold = tt.nearest_index(cutoff)
+    def _node_gc(self, force: bool = False) -> None:
+        if force:
+            old_threshold = self.FORCE_THRESHOLD
+        else:
+            tt = self.server.fsm.timetable
+            cutoff = time.time() - self.server.config.node_gc_threshold
+            old_threshold = tt.nearest_index(cutoff)
 
         for node in self.snap.nodes():
             if not node.terminal_status() or \

@@ -61,6 +61,7 @@ class Endpoints:
                      "Create", "Reap", "List", "Allocations"],
             "Plan": ["Submit"],
             "Alloc": ["List", "GetAlloc"],
+            "System": ["GarbageCollect"],
         }.items():
             for m in methods:
                 handler = getattr(self, f"{service.lower()}_{_snake(m)}")
@@ -158,9 +159,15 @@ class Endpoints:
                 # can't): mark it blocking so the pool spawns bounded
                 # overflow instead of letting a handful of forwarded
                 # long-polls pin every worker and starve heartbeats.
+                # Clip the transport wait to the re-based budget:
+                # restamp_forward wrote the caller's remaining envelope
+                # into _deadline, and without an explicit timeout the
+                # hop would wait the transport default (330s) instead.
+                # No envelope -> None -> default, unchanged.
                 with mux.blocking_section():
-                    return self.server.conn_pool.call(addr, method,
-                                                      fwd_args)
+                    return self.server.conn_pool.call(
+                        addr, method, fwd_args,
+                        timeout=fwd_args.get(overload_mod.DEADLINE_KEY))
             return handler(args)
         return routed
 
@@ -189,9 +196,12 @@ class Endpoints:
         # blocking query parks on the LEADER; this follower's worker
         # waits it out synchronously, so mark the wait blocking and
         # let the pool overflow (bounded) rather than pinning workers.
+        # Same budget clip as the region hop: the leader forward must
+        # not outwait the caller's re-based envelope.
         with mux.blocking_section():
-            return self.server.conn_pool.call(tuple(leader), method,
-                                              fwd_args)
+            return self.server.conn_pool.call(
+                tuple(leader), method, fwd_args,
+                timeout=fwd_args.get(overload_mod.DEADLINE_KEY))
 
     def _state(self):
         return self.server.fsm.state
@@ -511,6 +521,20 @@ class Endpoints:
             alloc = self._state().alloc_by_id(args["alloc_id"])
             return {"alloc": alloc.to_dict() if alloc else None}
         return self._blocking(args, "allocs", run)
+
+    # -- System -----------------------------------------------------------
+    def system_garbage_collect(self, args: dict) -> dict:
+        """Operator-requested GC (reference nomad/system_endpoint.go):
+        the leader enqueues one force-gc core eval; both collectors
+        then run with their age thresholds bypassed.  Leader-local like
+        every core eval — the enqueue skips raft."""
+        fwd = self._forward("System.GarbageCollect", args)
+        if fwd is not None:
+            return fwd
+        from nomad_tpu.structs import CORE_JOB_FORCE_GC
+
+        self.server._enqueue_core_eval(CORE_JOB_FORCE_GC)
+        return {"index": self.server.raft.applied_index()}
 
 
 def _needs_evals(state, node: Node) -> bool:

@@ -164,6 +164,9 @@ class Gossip:
     def _recv_loop(self) -> None:
         while not self._stop.is_set():
             try:
+                # faultlint-ok(uninjectable-io): best-effort UDP gossip
+                # — drops ARE the protocol's normal case; deterministic
+                # chaos rides the RPC/heartbeat sites.
                 data, _src = self.sock.recvfrom(65535)
             except socket.timeout:
                 continue

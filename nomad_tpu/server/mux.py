@@ -538,6 +538,8 @@ class EdgeLoop:
 
     def _drain_waker(self) -> None:
         try:
+            # faultlint-ok(uninjectable-io): socketpair self-wake drain
+            # — process-local plumbing, not a cluster transport edge.
             while self._wake_r.recv(4096):
                 pass
         except (BlockingIOError, OSError):

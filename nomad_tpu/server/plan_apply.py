@@ -528,6 +528,10 @@ class _Committer:
                         return
                     self._cond.wait(remaining)
                 else:
+                    # faultlint-ok(unbounded-wait): timeout=None branch
+                    # kept for teardown; every request-path caller
+                    # passes a budget (60s depth gate, 30s drain) and
+                    # stop() flips _stopped under notify_all.
                     self._cond.wait()
 
     def wait_drained(self, timeout: Optional[float] = None) -> None:
@@ -537,6 +541,9 @@ class _Committer:
         while True:
             with self._cond:
                 while not self._queue and not self._stopped:
+                    # faultlint-ok(unbounded-wait): idle committer
+                    # parking — submit() and stop() both notify; the
+                    # per-commit waits are the budgeted ones.
                     self._cond.wait()
                 if not self._queue:
                     return  # stopped AND drained: futures never drop
@@ -757,7 +764,7 @@ class PlanApplier:
                         pend.respond(None, e)
                 if wait_future is not None:
                     try:
-                        wait_future.wait()
+                        self._wait_commit(wait_future)
                     except Exception:
                         pass
                 if not self.sequential:
@@ -1043,7 +1050,7 @@ class PlanApplier:
         if wait_future is not None:
             serial += time.perf_counter() - t_mark
             try:
-                wait_future.wait()
+                self._wait_commit(wait_future)
             except Exception:
                 pass
             wait_future = None
@@ -1071,7 +1078,7 @@ class PlanApplier:
             logger.exception("plan applier: overlay fold failed; "
                              "serializing this apply")
             try:
-                future.wait()
+                self._wait_commit(future)
             except Exception:
                 pass
             wait_future, snap = None, None
@@ -1144,6 +1151,28 @@ class PlanApplier:
             self.plans_committed += len(committers)
         return future, t_apply
 
+    # Commit-wait poll slice: the raft-commit wait is re-armed in
+    # bounded slices so the waiter can probe queue liveness between
+    # them instead of parking forever on an orphaned future.
+    COMMIT_WAIT_POLL = 5.0
+
+    def _wait_commit(self, future):
+        """Bounded raft-commit wait.  A commit can legitimately outlast
+        any fixed budget, so the wait is supervised rather than capped:
+        poll in COMMIT_WAIT_POLL slices and give up only when the plan
+        queue has been disabled (leadership revoked or teardown) with
+        the future still unresolved — raft_net responds its outstanding
+        futures on step-down, so nothing will ever set that one."""
+        while True:
+            try:
+                return future.wait(self.COMMIT_WAIT_POLL)
+            except TimeoutError:
+                if future.done():
+                    raise     # the future RESPONDED with a timeout error
+                if not self.plan_queue.enabled():
+                    raise TimeoutError(
+                        "plan queue disabled while awaiting raft commit")
+
     def _await_and_respond(self, future, committers, t_apply,
                            tracer) -> None:
         """The respond tail: wait out one window's commit and answer
@@ -1152,7 +1181,7 @@ class PlanApplier:
         beyond the commit wait itself — a worker retrying an
         already-applied plan would double-place."""
         try:
-            index, _ = future.wait()
+            index, _ = self._wait_commit(future)
         except Exception as e:
             for pend, _res in committers:
                 pend.respond(None, e)

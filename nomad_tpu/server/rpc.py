@@ -436,6 +436,9 @@ class RPCServer:
             sock.settimeout(self._read_deadline)
             wrapped = self._tls_context.wrap_socket(sock,
                                                     server_side=True)
+            # faultlint-ok(uninjectable-io): TLS handoff lane; framed
+            # reads consult rpc.recv once the stream reaches _execute,
+            # and the handshake is read-deadline-bounded above.
             inner = wrapped.recv(1)
             if not inner:
                 return

@@ -66,6 +66,8 @@ EVAL_TRIGGER_ROLLING_UPDATE = "rolling-update"
 # Core-scheduler job ids (reference: nomad/core_sched.go)
 CORE_JOB_EVAL_GC = "eval-gc"
 CORE_JOB_NODE_GC = "node-gc"
+# Operator-requested GC: both collectors, age thresholds bypassed.
+CORE_JOB_FORCE_GC = "force-gc"
 
 # Dynamic port range (reference: nomad/structs/network.go:9-18)
 MIN_DYNAMIC_PORT = 20000

@@ -32,6 +32,8 @@ def _try_build() -> None:
             os.path.getmtime(marker) >= os.path.getmtime(src):
         raise ImportError("previous native build failed")
     try:
+        # faultlint-ok(uninjectable-io): import-time toolchain probe;
+        # any failure routes to the pure-Python fallback below.
         subprocess.run([sys.executable, script], check=True,
                        capture_output=True, timeout=120)
     except Exception as e:

@@ -42,6 +42,9 @@ import pytest  # noqa: E402
 # regression tests reach it through here.
 DIVERGENCE = None
 
+# Same for the BudgetWitnessSanitizer (per-test unbounded-wait report).
+BUDGET = None
+
 
 @pytest.fixture(scope="session", autouse=True)
 def runtime_sanitizers():
@@ -61,15 +64,22 @@ def runtime_sanitizers():
       same raft entries; store fingerprints are byte-compared at commit
       quiescence points, so a nondeterministic apply fails the test
       that caused it (the runtime twin of analysis/consensuslint.py).
+    - budget witness: while a thread serves an admitted RPC, any
+      Event/Condition wait or blocking Queue.get entered with NO
+      timeout is recorded with its stack and fails the test that
+      caused it — the runtime twin of analysis/faultlint.py's
+      deadline pass (catches a timeout variable that evaluates to
+      None, which the AST can't see).
 
     Disable with NOMAD_TPU_SANITIZERS=0 (e.g. when bisecting an
     unrelated failure).  All only observe; no test behavior changes.
     """
-    global DIVERGENCE
+    global DIVERGENCE, BUDGET
     if os.environ.get("NOMAD_TPU_SANITIZERS", "1") == "0":
         yield
         return
-    from nomad_tpu.analysis.sanitizers import (LockOrderWitness,
+    from nomad_tpu.analysis.sanitizers import (BudgetWitnessSanitizer,
+                                               LockOrderWitness,
                                                RecompileSentinel,
                                                ReplicaDivergenceSanitizer,
                                                TransferGuardSanitizer)
@@ -78,9 +88,12 @@ def runtime_sanitizers():
     sentinel = RecompileSentinel().install()
     guard = TransferGuardSanitizer().install()
     DIVERGENCE = divergence = ReplicaDivergenceSanitizer().install()
+    BUDGET = budget = BudgetWitnessSanitizer().install()
     try:
         yield
     finally:
+        budget.uninstall()
+        BUDGET = None
         divergence.uninstall()
         DIVERGENCE = None
         guard.uninstall()
@@ -88,7 +101,8 @@ def runtime_sanitizers():
     # Collect-then-raise so one sanitizer tripping doesn't mask the
     # other's report for the same session.
     errors = []
-    for check in (witness.check, sentinel.check, divergence.check):
+    for check in (witness.check, sentinel.check, divergence.check,
+                  budget.check):
         try:
             check()
         except AssertionError as e:
@@ -105,6 +119,16 @@ def replica_quiescence():
     yield
     if DIVERGENCE is not None:
         DIVERGENCE.compare_all()
+
+
+@pytest.fixture(autouse=True)
+def budget_quiescence():
+    """Per-test budget-witness report: any unbounded wait recorded on a
+    serving thread during this test fails THIS test (with the wait's
+    stack), not the session summary."""
+    yield
+    if BUDGET is not None:
+        BUDGET.check_test()
 
 
 def wait_until(fn, timeout=15.0, msg="condition"):

@@ -790,3 +790,165 @@ class TestClientRetries:
         t.join(2.0)
         assert not t.is_alive()
         client.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# site liveness: every registered site fires under one seeded plan
+# ---------------------------------------------------------------------------
+
+class TestSiteLiveness:
+    """One seeded plan with a benign delay rule per registered site,
+    driven through a live server (plus the device pipeline, a raw_exec
+    driver, and the durable meta store — the planes a single server
+    process does not own).  Every site must fire at least once, and
+    placement must still converge exactly once: a site that never
+    fires is registered-but-dead instrumentation the static pass's
+    ``dead-site`` rule cannot see from the callgraph alone."""
+
+    TERMINAL = ("complete", "failed", "canceled")
+
+    def test_every_registered_site_fires(self, tmp_path):
+        from nomad_tpu.faultinject.plan import SITES
+
+        plan = FaultPlan(seed=19)
+        for site in SITES:
+            # delay(1ms): proves the chokepoint is consulted without
+            # perturbing any outcome the convergence bar asserts.
+            plan.add(site, "delay", secs=0.001)
+
+        with faultinject.injected(plan):
+            self._server_phase(plan, tmp_path)
+            self._device_phase()
+            self._driver_phase(tmp_path)
+            self._meta_phase(tmp_path)
+
+        silent = [s for s in SITES if plan.fire_count(s) == 0]
+        assert not silent, f"registered-but-dead fault sites: {silent}"
+
+    def _server_phase(self, plan, tmp_path):
+        """Real RPC server with a durable raft plane: covers the rpc,
+        mux, raft-storage, broker, heartbeat, and watch sites."""
+        from nomad_tpu.server import Server, ServerConfig
+        from nomad_tpu.server.rpc import ConnPool
+        from nomad_tpu.structs import Resources, Task, TaskGroup
+
+        srv = Server(ServerConfig(
+            num_schedulers=2, enable_rpc=True,
+            data_dir=str(tmp_path / "data"),
+            raft_snapshot_threshold=4))  # trip snapshot.persist early
+        srv.establish_leadership()
+        pool = ConnPool()
+        try:
+            addr = srv.rpc_address()
+
+            nodes = [mock.node(i) for i in range(4)]
+            for node in nodes:
+                out = pool.call(addr, "Node.Register",
+                                {"node": node.to_dict()}, timeout=5.0)
+                assert out["heartbeat_ttl"] > 0
+            for node in nodes:
+                pool.call(addr, "Node.Heartbeat",
+                          {"node_id": node.id}, timeout=5.0)
+
+            # Park a blocking query at the current index, then advance
+            # it: the matured waiter rides the watch.deliver site.
+            cur = srv.fsm.state.get_index("nodes")
+            blocked: list = []
+            waiter = threading.Thread(
+                target=lambda: blocked.append(
+                    pool.call(addr, "Node.List",
+                              {"min_query_index": cur,
+                               "max_query_time": 5.0}, timeout=10.0)),
+                daemon=True)
+            waiter.start()
+            time.sleep(0.2)  # sleep-ok: let the query park on the watch
+            late = mock.node(99)
+            pool.call(addr, "Node.Register",
+                      {"node": late.to_dict()}, timeout=5.0)
+            waiter.join(10.0)
+            assert not waiter.is_alive(), "blocking query never woke"
+            assert blocked and blocked[0]["index"] > cur
+
+            jobs = []
+            for _ in range(2):
+                job = mock.job()
+                job.task_groups = [
+                    TaskGroup(name=f"tg-{g}", count=1,
+                              tasks=[Task(name="web", driver="exec",
+                                          resources=Resources(
+                                              cpu=200, memory_mb=64))])
+                    for g in range(2)]
+                pool.call(addr, "Job.Register",
+                          {"job": job.to_dict()}, timeout=5.0)
+                jobs.append(job)
+
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                state = srv.fsm.state
+                evals = state.evals()
+                if evals and len(evals) >= len(jobs) and \
+                        all(e.status in self.TERMINAL for e in evals):
+                    break
+                time.sleep(0.05)  # sleep-ok: poll cadence for convergence
+
+            state = srv.fsm.state
+            stuck = [(e.id, e.status) for e in state.evals()
+                     if e.status not in self.TERMINAL]
+            assert not stuck, f"non-terminal evals: {stuck}"
+            # Exactly-once placement: per job AND per group.
+            for job in jobs:
+                live = [a for a in state.allocs_by_job(job.id)
+                        if not a.terminal_status()]
+                want = sum(tg.count for tg in job.task_groups)
+                assert len(live) == want, \
+                    f"job {job.id}: {len(live)} live allocs, want {want}"
+                by_group: dict = {}
+                for a in live:
+                    by_group[a.task_group] = \
+                        by_group.get(a.task_group, 0) + 1
+                assert all(by_group.get(tg.name) == tg.count
+                           for tg in job.task_groups), "duplicate placement"
+        finally:
+            pool.shutdown()
+            srv.shutdown()
+
+    def _device_phase(self):
+        """Pipelined runner on the device executor: covers the
+        device.dispatch / device.collect sites."""
+        from nomad_tpu.scheduler.executor import executor_override
+        from nomad_tpu.scheduler.pipeline import PipelinedEvalRunner
+
+        h, jobs = _pipeline_cluster(4, 2)
+        with executor_override("device"):
+            runner = PipelinedEvalRunner(h.state.snapshot(), h, depth=2)
+            runner.process([_make_eval(j) for j in jobs])
+        assert all(e.status == "complete" for e in h.evals)
+
+    def _driver_phase(self, tmp_path):
+        """raw_exec task through the real TaskRunner: covers the
+        driver.start site; the delay must not fail the task."""
+        from nomad_tpu.client.allocdir import AllocDir
+        from nomad_tpu.client.driver.base import ExecContext
+        from nomad_tpu.client.task_runner import TaskRunner
+        from nomad_tpu.structs import Resources, Task
+
+        task = Task(name="echo", driver="raw_exec",
+                    config={"command": "/bin/sh",
+                            "args": "-c 'echo site-liveness'"},
+                    resources=Resources(cpu=100, memory_mb=64))
+        ad = AllocDir(str(tmp_path / "alloc"))
+        ad.build([task])
+        tr = TaskRunner(ExecContext(ad, "alloc-live"), task)
+        tr.run()  # inline: deterministic, no thread needed
+        assert tr.state == "dead"
+        assert not tr.failed
+
+    def _meta_phase(self, tmp_path):
+        """The raft term/vote MetaStore is NetRaft's plane (a single
+        inmem server never persists meta); its site liveness is proved
+        against the real store directly."""
+        from nomad_tpu.server.raft import MetaStore
+
+        meta = MetaStore(str(tmp_path / "meta" / "meta.json"))
+        meta.save({"term": 1, "voted_for": "s1"})
+        assert meta.load() == {"term": 1, "voted_for": "s1"}

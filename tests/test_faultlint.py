@@ -1,0 +1,602 @@
+"""Failure-plane lint (analysis/faultlint.py) unit tests + defect
+regressions.
+
+Layer 2 of the static-analysis discipline (see
+tests/test_static_analysis.py): each faultlint rule proves it FIRES on
+a synthetic package — a lint that cannot fail gates nothing — and each
+defect the analyzer found in the real tree keeps a behavioral
+regression test:
+
+- endpoints._forward/_with_region dropped the re-based budget on the
+  transport hop (deadline-drop): the forwarded call now clips its
+  timeout to the caller's remaining envelope.
+- plan_apply waited on raft-commit futures with no supervision
+  (unbounded-wait): _wait_commit polls in bounded slices and gives up
+  only when the plan queue is disabled with the future unresolved.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from nomad_tpu.analysis import faultlint
+
+
+def write_pkg(tmp_path, name, source) -> str:
+    d = tmp_path / name
+    d.mkdir(exist_ok=True)
+    (d / "mod.py").write_text(textwrap.dedent(source))
+    return str(d)
+
+
+def lint(tmp_path, source, name="pkg"):
+    cov: dict = {}
+    findings = faultlint.analyze_package(
+        write_pkg(tmp_path, name, source), coverage_out=cov)
+    return findings, cov
+
+
+# ---------------------------------------------------------------------------
+# pass 1: deadline propagation
+# ---------------------------------------------------------------------------
+
+class TestDeadlinePass:
+    def test_unbounded_wait_on_loop_entry_flagged(self, tmp_path):
+        findings, cov = lint(tmp_path, """
+            import threading
+
+            class QueueWorker:
+                def __init__(self):
+                    self.ev = threading.Event()
+
+                def _run(self):
+                    self.ev.wait()
+        """)
+        assert [f.rule for f in findings] == ["unbounded-wait"]
+        assert "QueueWorker._run" in findings[0].where
+        assert cov["entries"] == 1 and cov["unbounded_waits"] == 1
+
+    def test_bounded_wait_clean(self, tmp_path):
+        findings, cov = lint(tmp_path, """
+            import threading
+
+            class QueueWorker:
+                def __init__(self):
+                    self.ev = threading.Event()
+
+                def _run(self):
+                    self.ev.wait(5.0)
+        """)
+        assert findings == []
+        assert cov["wait_sites"] == 1 and cov["unbounded_waits"] == 0
+
+    def test_explicit_timeout_none_is_unbounded(self, tmp_path):
+        findings, _ = lint(tmp_path, """
+            import threading
+
+            class QueueWorker:
+                def __init__(self):
+                    self.ev = threading.Event()
+
+                def _run(self):
+                    self.ev.wait(timeout=None)
+        """)
+        assert [f.rule for f in findings] == ["unbounded-wait"]
+
+    def test_budget_aware_unbounded_wait_is_deadline_drop(self, tmp_path):
+        """A function that touched the envelope (remaining/...) and then
+        blocks without a timeout DROPPED the budget, a stronger claim
+        than mere unboundedness."""
+        findings, _ = lint(tmp_path, """
+            import threading
+
+            def remaining(deadline, default):
+                return default
+
+            class PlanApplier:
+                def __init__(self):
+                    self.ev = threading.Event()
+
+                def _run(self):
+                    remaining(None, 5.0)
+                    self.ev.wait()
+        """)
+        assert [f.rule for f in findings] == ["deadline-drop"]
+
+    def test_wait_reachable_through_callee_flagged_with_chain(
+            self, tmp_path):
+        findings, cov = lint(tmp_path, """
+            import threading
+
+            class EvalWorker:
+                def __init__(self):
+                    self.ev = threading.Event()
+
+                def run(self):
+                    self._park()
+
+                def _park(self):
+                    self.ev.wait()
+        """)
+        assert [f.rule for f in findings] == ["unbounded-wait"]
+        # The finding renders the entry -> wait call chain.
+        assert "EvalWorker.run" in findings[0].message
+        assert cov["entry_closure"] > cov["entries"]
+
+    def test_transport_form_deadline_drop(self, tmp_path):
+        """restamp_forward then a pool .call() with no timeout= — the
+        hop would wait the transport default, not the re-based
+        envelope (the endpoints.py defect shape)."""
+        findings, cov = lint(tmp_path, """
+            def restamp_forward(args, clock=None):
+                return args
+
+            class Router:
+                def __init__(self, conn_pool):
+                    self.conn_pool = conn_pool
+
+                def forward(self, addr, method, args):
+                    fwd = restamp_forward(dict(args))
+                    return self.conn_pool.call(addr, method, fwd)
+        """)
+        assert [f.rule for f in findings] == ["deadline-drop"]
+        assert cov["transport_drops"] == 1
+
+    def test_transport_form_clean_with_timeout(self, tmp_path):
+        findings, cov = lint(tmp_path, """
+            def restamp_forward(args, clock=None):
+                return args
+
+            class Router:
+                def __init__(self, conn_pool):
+                    self.conn_pool = conn_pool
+
+                def forward(self, addr, method, args):
+                    fwd = restamp_forward(dict(args))
+                    return self.conn_pool.call(addr, method, fwd,
+                                               timeout=fwd.get("_deadline"))
+        """)
+        assert findings == []
+        assert cov["transport_drops"] == 0
+
+    def test_marker_waives_wait(self, tmp_path):
+        findings, cov = lint(tmp_path, """
+            import threading
+
+            class QueueWorker:
+                def __init__(self):
+                    self.ev = threading.Event()
+
+                def _run(self):
+                    # faultlint-ok(unbounded-wait): teardown parking;
+                    # stop() always sets the event.
+                    self.ev.wait()
+        """)
+        assert findings == []
+        assert cov["waived"] == 1
+
+    def test_unjustified_marker_does_not_waive(self, tmp_path):
+        findings, _ = lint(tmp_path, """
+            import threading
+
+            class QueueWorker:
+                def __init__(self):
+                    self.ev = threading.Event()
+
+                def _run(self):
+                    self.ev.wait()  # faultlint-ok(unbounded-wait):
+        """)
+        assert [f.rule for f in findings] == ["unbounded-wait"]
+
+
+# ---------------------------------------------------------------------------
+# pass 2: fault-injectability coverage
+# ---------------------------------------------------------------------------
+
+class TestInjectabilityPass:
+    def test_uncovered_boundary_flagged(self, tmp_path):
+        findings, cov = lint(tmp_path, """
+            def send_bytes(sock):
+                sock.sendall(b"x")
+        """)
+        assert [f.rule for f in findings] == ["uninjectable-io"]
+        assert cov["boundary_count"] == 1
+        assert cov["covered_fraction"] == 0.0
+
+    def test_own_consult_covers(self, tmp_path):
+        findings, cov = lint(tmp_path, """
+            def fire(site):
+                pass
+
+            def send_bytes(sock):
+                fire("rpc.send")
+                sock.sendall(b"x")
+        """)
+        assert findings == []
+        assert cov["boundaries"][0]["covered_by"] == "rpc.send"
+        assert cov["covered_fraction"] == 1.0
+
+    def test_caller_consult_covers(self, tmp_path):
+        """Coverage is a path property: the consulted site may live in
+        the caller that drives the boundary."""
+        findings, cov = lint(tmp_path, """
+            def fire(site):
+                pass
+
+            def raw_send(sock):
+                sock.sendall(b"x")
+
+            def send(sock):
+                fire("rpc.send")
+                raw_send(sock)
+        """)
+        assert findings == []
+        assert cov["covered_fraction"] == 1.0
+
+    def test_dead_site_flagged(self, tmp_path):
+        findings, cov = lint(tmp_path, """
+            SITES = ("rpc.send", "disk.sync")
+
+            def fire(site):
+                pass
+
+            def go(sock):
+                fire("rpc.send")
+                sock.sendall(b"x")
+        """)
+        assert [f.rule for f in findings] == ["dead-site"]
+        assert cov["dead_sites"] == ["disk.sync"]
+        assert cov["sites"] == {"rpc.send": 1, "disk.sync": 0}
+
+    def test_waived_boundary_counts_as_covered(self, tmp_path):
+        findings, cov = lint(tmp_path, """
+            def fingerprint(sock):
+                # faultlint-ok(uninjectable-io): boot-time probe, not
+                # a live data path.
+                sock.connect(("10.0.0.1", 1))
+        """)
+        assert findings == []
+        assert cov["boundaries"][0]["waived"] is True
+        assert cov["covered_fraction"] == 1.0
+
+    def test_disk_and_subprocess_kinds_detected(self, tmp_path):
+        findings, cov = lint(tmp_path, """
+            import os
+            import subprocess
+
+            def persist(path, fd):
+                os.fsync(fd)
+                os.replace(path + ".tmp", path)
+
+            def probe():
+                subprocess.run(["true"], check=False)
+        """)
+        assert {f.rule for f in findings} == {"uninjectable-io"}
+        kinds = {b["kind"] for b in cov["boundaries"]}
+        assert kinds == {"disk", "subprocess"}
+
+
+# ---------------------------------------------------------------------------
+# pass 3: retry safety
+# ---------------------------------------------------------------------------
+
+_RETRY_PKG = """
+    class RetryPolicy:
+        def call(self, fn):
+            return fn()
+
+    POLICY = RetryPolicy()
+
+    class Sender:
+        def __init__(self):
+            self.sent = []
+
+        def push(self, item):
+            def attempt():
+                self.sent.append(item)
+                return True
+            return POLICY.call(attempt)
+"""
+
+
+class TestRetryPass:
+    def test_accumulating_closure_flagged(self, tmp_path):
+        findings, cov = lint(tmp_path, _RETRY_PKG)
+        assert [f.rule for f in findings] == ["retry-unsafe"]
+        assert "Sender.push.attempt" in findings[0].where
+        assert cov["retry_closures"] == 1 and cov["retry_tainted"] == 1
+
+    def test_fencing_token_exempts(self, tmp_path):
+        findings, cov = lint(tmp_path, _RETRY_PKG.replace(
+            "self.sent.append(item)",
+            "token = item.modify_index\n"
+            "                self.sent.append((token, item))"))
+        assert findings == []
+        assert cov["retry_tainted"] == 0
+
+    def test_newest_wins_replacement_exempts(self, tmp_path):
+        findings, _ = lint(tmp_path, """
+            class RetryPolicy:
+                def call(self, fn):
+                    return fn()
+
+            POLICY = RetryPolicy()
+
+            class Mirror:
+                def __init__(self):
+                    self.view = {}
+
+                def refresh(self, snapshot):
+                    def attempt():
+                        self.view.clear()
+                        self.view.update(snapshot)
+                        return True
+                    return POLICY.call(attempt)
+        """)
+        assert findings == []
+
+    def test_apply_closure_unforced_broker_enqueue_flagged(self, tmp_path):
+        findings, cov = lint(tmp_path, """
+            class TinyFSM:
+                def __init__(self, broker):
+                    self.eval_broker = broker
+
+                def apply(self, index, entry):
+                    self.eval_broker.enqueue(entry)
+        """)
+        assert [f.rule for f in findings] == ["retry-unsafe"]
+        assert "shed-reachable" in findings[0].where
+        assert cov["apply_shed_calls"] == 1
+
+    def test_apply_closure_forced_enqueue_clean(self, tmp_path):
+        findings, cov = lint(tmp_path, """
+            class TinyFSM:
+                def __init__(self, broker):
+                    self.eval_broker = broker
+
+                def apply(self, index, entry):
+                    self.eval_broker.enqueue(entry, force=True)
+        """)
+        assert findings == []
+        assert cov["apply_shed_calls"] == 0
+
+
+# ---------------------------------------------------------------------------
+# defect regression #1: forwarded-RPC budget re-basing (endpoints.py)
+# ---------------------------------------------------------------------------
+
+class _RecordingPool:
+    def __init__(self):
+        self.calls = []
+
+    def call(self, address, method, args, timeout=None):
+        self.calls.append((address, method, args, timeout))
+        return {"ok": True}
+
+
+class _FakeConfig:
+    region = "global"
+
+
+class _FakeServer:
+    def __init__(self):
+        self.conn_pool = _RecordingPool()
+        self.config = _FakeConfig()
+        self.overload = None
+
+    def is_leader(self):
+        return False
+
+    def leader_rpc_address(self):
+        return ("10.0.0.1", 4647)
+
+    def rpc_address(self):
+        return ("10.0.0.2", 4647)
+
+    def region_server(self, region):
+        return ("10.1.0.1", 4647)
+
+
+class TestForwardBudgetClip:
+    """The deadline-drop faultlint found: _forward/_with_region re-based
+    the envelope (restamp_forward) but let the transport hop wait
+    DEFAULT_CALL_TIMEOUT instead of the caller's remaining budget."""
+
+    def _endpoints(self):
+        from nomad_tpu.server.endpoints import Endpoints
+
+        ep = Endpoints.__new__(Endpoints)
+        ep.server = _FakeServer()
+        return ep
+
+    def test_leader_forward_clips_timeout_to_envelope(self):
+        ep = self._endpoints()
+        args = {"_abs_deadline": time.monotonic() + 2.5}
+        out = ep._forward("Job.GetJob", args)
+        assert out == {"ok": True}
+        (_addr, _method, fwd, timeout), = ep.server.conn_pool.calls
+        assert timeout is not None, \
+            "forwarded hop must clip to the re-based budget"
+        assert 0 < timeout <= 2.5
+        assert fwd["_deadline"] == pytest.approx(timeout)
+        assert fwd["_forwarded"] is True
+
+    def test_leader_forward_without_envelope_keeps_default(self):
+        """No envelope -> timeout None -> the transport default applies
+        unchanged (the fix must not invent budgets)."""
+        ep = self._endpoints()
+        ep._forward("Job.GetJob", {})
+        (_a, _m, _fwd, timeout), = ep.server.conn_pool.calls
+        assert timeout is None
+
+    def test_region_forward_clips_timeout_to_envelope(self):
+        ep = self._endpoints()
+        handler_ran = []
+        routed = ep._with_region("Job.GetJob",
+                                 lambda a: handler_ran.append(a))
+        args = {"region": "eu", "_abs_deadline": time.monotonic() + 1.5}
+        out = routed(args)
+        assert out == {"ok": True} and not handler_ran
+        (_a, _m, fwd, timeout), = ep.server.conn_pool.calls
+        assert timeout is not None and 0 < timeout <= 1.5
+        assert fwd["_region_forwarded"] is True
+
+
+# ---------------------------------------------------------------------------
+# defect regression #2: supervised raft-commit wait (plan_apply.py)
+# ---------------------------------------------------------------------------
+
+class _FakeQueue:
+    def __init__(self):
+        self._enabled = True
+
+    def enabled(self):
+        return self._enabled
+
+
+def _applier():
+    from nomad_tpu.server.plan_apply import PlanApplier
+
+    a = PlanApplier.__new__(PlanApplier)
+    a.plan_queue = _FakeQueue()
+    a.COMMIT_WAIT_POLL = 0.05
+    return a
+
+
+class TestWaitCommit:
+    """The unbounded-wait faultlint found: four raft-commit
+    future.wait() sites parked forever; _wait_commit re-arms in
+    bounded slices and gives up only when the plan queue has been
+    disabled with the future still unresolved."""
+
+    def test_late_commit_still_returned(self):
+        from nomad_tpu.server.raft import ApplyFuture
+
+        a = _applier()
+        fut = ApplyFuture()
+        threading.Timer(0.12, fut.respond, args=(7, "resp")).start()
+        # Longer than one poll slice: proves the wait re-arms instead
+        # of giving up on a commit that legitimately outlasts a slice.
+        assert a._wait_commit(fut) == (7, "resp")
+
+    def test_disabled_queue_with_unresolved_future_raises(self):
+        from nomad_tpu.server.raft import ApplyFuture
+
+        a = _applier()
+        a.plan_queue._enabled = False
+        start = time.monotonic()
+        with pytest.raises(TimeoutError, match="plan queue disabled"):
+            a._wait_commit(ApplyFuture())
+        # One slice, not forever.
+        assert time.monotonic() - start < 2.0
+
+    def test_responded_timeout_error_propagates(self):
+        """A future RESPONDED with a timeout error is the commit's
+        outcome, not a poll expiry: it must propagate immediately
+        (regression for the spin this path had pre-review)."""
+        from nomad_tpu.server.raft import ApplyFuture
+
+        a = _applier()
+        fut = ApplyFuture()
+        fut.respond(0, None, error=TimeoutError("apply timed out"))
+        start = time.monotonic()
+        with pytest.raises(TimeoutError, match="apply timed out"):
+            a._wait_commit(fut)
+        assert time.monotonic() - start < 1.0
+
+    def test_enabled_queue_keeps_waiting(self):
+        from nomad_tpu.server.raft import ApplyFuture
+
+        a = _applier()
+        fut = ApplyFuture()
+        done = []
+        t = threading.Thread(target=lambda: done.append(
+            a._wait_commit(fut)), daemon=True)
+        t.start()
+        time.sleep(0.2)       # sleep-ok: let several poll slices lapse
+        assert not done, "an enabled queue must keep the wait armed"
+        fut.respond(3, None)
+        t.join(2.0)
+        assert done == [(3, None)]
+
+
+# ---------------------------------------------------------------------------
+# the runtime twin: BudgetWitnessSanitizer
+# ---------------------------------------------------------------------------
+
+def _session_budget():
+    """The conftest-installed session witness (None when sanitizers are
+    off): it must be paused while this test installs its own, or the
+    nested wrappers' package-frame callers would record spurious hits
+    against the enclosing test."""
+    for m in list(sys.modules.values()):
+        f = getattr(m, "__file__", None) or ""
+        if f.endswith(os.path.join("tests", "conftest.py")):
+            return getattr(m, "BUDGET", None)
+    return None
+
+
+class TestBudgetWitness:
+    def test_records_unbounded_wait_on_serving_thread_only(self):
+        from nomad_tpu.analysis.sanitizers import BudgetWitnessSanitizer
+        from nomad_tpu.server.endpoints import Endpoints
+
+        session = _session_budget()
+        if session is not None:
+            session.uninstall()
+        # This test file plays the "package": waits issued from here
+        # count, stdlib-internal ones don't.
+        san = BudgetWitnessSanitizer(
+            package_prefix=os.path.dirname(os.path.abspath(__file__)))
+        san.install()
+        try:
+            ep = Endpoints.__new__(Endpoints)
+            ep.server = _FakeServer()
+            ev = threading.Event()
+            ev.set()              # the wait returns immediately
+
+            def unbounded(args):
+                ev.wait()
+                return {}
+
+            def bounded(args):
+                ev.wait(0.01)
+                return {}
+
+            # Off a serving thread: not recorded.
+            ev.wait()
+            assert san.hits == []
+            # On a serving thread, no timeout: recorded with the stack.
+            Endpoints._admitted_body(ep, "Job.GetJob", unbounded, {})
+            assert len(san.hits) == 1
+            method, primitive, _test, stack = san.hits[0]
+            assert method == "Job.GetJob"
+            assert primitive == "Event.wait"
+            assert "test_faultlint" in stack
+            san.hits.clear()
+            # Bounded wait: clean.
+            Endpoints._admitted_body(ep, "Job.GetJob", bounded, {})
+            assert san.hits == []
+            # Heartbeat/liveness lane: exempt, same as the static pass.
+            Endpoints._admitted_body(ep, "Node.Heartbeat", unbounded, {})
+            assert san.hits == []
+        finally:
+            san.uninstall()
+            if session is not None:
+                session.install()
+
+    def test_check_test_reports_and_resets(self):
+        from nomad_tpu.analysis.sanitizers import BudgetWitnessSanitizer
+
+        san = BudgetWitnessSanitizer()
+        san.hits.append(("Job.GetJob", "Queue.get", "t::x", "  stack\n"))
+        with pytest.raises(AssertionError, match="Queue.get"):
+            san.check_test()
+        # Reported hits are consumed: the next test starts clean.
+        san.check_test()
+        san.check()

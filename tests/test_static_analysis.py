@@ -826,7 +826,7 @@ class TestLintGate:
                 "entries (use a justified in-code consensus-ok marker)"
 
     def test_lint_json_reports_consensuslint_coverage(self, capsys):
-        """-json schema v2: top-level schema_version plus the consensus
+        """-json schema v3: top-level schema_version plus the consensus
         coverage block carrying the endpoint read-consistency table."""
         import json as _json
 
@@ -834,7 +834,7 @@ class TestLintGate:
 
         assert main(["lint", "-json"]) == 0
         doc = _json.loads(capsys.readouterr().out)
-        assert doc["schema_version"] == 2
+        assert doc["schema_version"] == 3
         cons = doc["coverage"]["consensuslint"]
         assert set(cons) >= {"apply_roots", "apply_closure",
                              "sinks_excluded", "fence_targets",
@@ -890,6 +890,203 @@ class TestLintGate:
         assert "touched.py" in out and "apply-wall-clock" in out
         assert "untouched.py" not in out, \
             "changed-mode must filter pre-existing consensus findings"
+
+    def test_failure_plane_rides_the_gates(self):
+        """ISSUE 19 tentpole: the failure-plane passes
+        (analysis/faultlint.py) cover deadline propagation from every
+        serving entry, the full I/O-boundary->fault-site coverage
+        table, and retry/shed safety — strict-clean on the real tree
+        with every boundary covered or waived and ZERO allowlist
+        entries of their own."""
+        from nomad_tpu.analysis import default_package_root, faultlint
+        from nomad_tpu.analysis.callgraph import CallGraph
+        from nomad_tpu.faultinject.plan import SITES
+
+        pkg = default_package_root()
+        graph = CallGraph.build(pkg)
+        # The failure-plane roots the passes hinge on must exist in the
+        # interprocedural graph (a rename would silently hollow the
+        # gate out).
+        for qual in (
+            "nomad_tpu.server.endpoints:Endpoints._admitted_body",
+            "nomad_tpu.server.endpoints:Endpoints._forward",
+            "nomad_tpu.server.overload:restamp_forward",
+            "nomad_tpu.server.plan_apply:PlanApplier._wait_commit",
+            "nomad_tpu.faultinject:fire",
+            "nomad_tpu.faultinject:fire_rpc",
+            "nomad_tpu.utils.retry:RetryPolicy.call",
+        ):
+            assert qual in graph.functions, \
+                f"{qual} missing from the interprocedural graph"
+
+        cov: dict = {}
+        findings = faultlint.analyze_package(pkg, graph=graph,
+                                             coverage_out=cov)
+        assert findings == [], "failure plane must lint clean:\n" + \
+            "\n".join(f.render() for f in findings)
+        # Pass 1 saw the real serving surface: the endpoint table minus
+        # the liveness lane, plus the loop entries, and a closure
+        # strictly larger than the entry set.
+        assert cov["entries"] >= 30, cov
+        assert cov["entries_exempt_liveness"] >= 1
+        assert cov["entry_closure"] > cov["entries"]
+        assert cov["wait_sites"] > 0
+        # Pass 2: every registered site is consulted by live code, and
+        # EVERY boundary row is covered or carries a reviewed waiver —
+        # the 100% covered-or-waived gate.
+        assert cov["dead_sites"] == []
+        assert set(cov["sites"]) == set(SITES)
+        assert all(n > 0 for n in cov["sites"].values()), cov["sites"]
+        assert cov["boundary_count"] >= 40, cov["boundary_count"]
+        assert cov["covered_fraction"] == 1.0, [
+            b for b in cov["boundaries"]
+            if b["covered_by"] is None and not b["waived"]]
+        # Pass 3 saw the retry closures and the shed raisers, and the
+        # committed-state appliers reach none of them unforced.
+        assert cov["retry_closures"] >= 1
+        assert cov["shed_raisers"] >= 3
+        assert cov["retry_tainted"] == 0
+        assert cov["apply_shed_calls"] == 0
+        # Failure-plane rules never go through the allowlist: waivers
+        # live in-code as justified faultlint-ok markers.
+        allowlist = load_allowlist(default_allowlist_path())
+        for rule in ("unbounded-wait", "deadline-drop",
+                     "uninjectable-io", "dead-site", "retry-unsafe"):
+            assert not any(e.startswith(rule + ":") for e in allowlist), \
+                f"faultlint rule {rule} must not need allowlist " \
+                "entries (use a justified in-code faultlint-ok marker)"
+
+    def test_lint_json_reports_faultlint_coverage(self, capsys):
+        """-json schema v3 ships the faultlint coverage block with the
+        boundary->fault-site table."""
+        import json as _json
+
+        from nomad_tpu.cli.main import main
+
+        assert main(["lint", "-json"]) == 0
+        doc = _json.loads(capsys.readouterr().out)
+        assert doc["schema_version"] == 3
+        fl = doc["coverage"]["faultlint"]
+        assert set(fl) >= {"entries", "entry_closure", "wait_sites",
+                           "unbounded_waits", "transport_drops",
+                           "sites", "dead_sites", "boundaries",
+                           "boundary_count", "boundaries_covered",
+                           "boundaries_waived", "covered_fraction",
+                           "retry_closures", "retry_tainted",
+                           "shed_raisers", "apply_shed_calls", "waived"}
+        assert fl["covered_fraction"] == 1.0
+        rows = fl["boundaries"]
+        assert len(rows) == fl["boundary_count"] >= 40
+        for row in rows:
+            assert set(row) == {"function", "path", "line", "kind",
+                                "root", "covered_by", "waived"}
+            assert row["covered_by"] is not None or row["waived"], row
+
+    def test_changed_mode_covers_faultlint(self, tmp_path, capsys):
+        """`lint -changed REV` reports failure-plane findings in touched
+        files and filters pre-existing ones; `-sarif` in the same run
+        carries the filtered set."""
+        import json as _json
+        import subprocess
+        import textwrap as _tw
+
+        from nomad_tpu.cli.main import main
+
+        def git(*args):
+            subprocess.run(["git", "-C", str(tmp_path), *args],
+                           check=True, capture_output=True,
+                           env={"GIT_AUTHOR_NAME": "t",
+                                "GIT_AUTHOR_EMAIL": "t@t",
+                                "GIT_COMMITTER_NAME": "t",
+                                "GIT_COMMITTER_EMAIL": "t@t",
+                                "HOME": str(tmp_path),
+                                "PATH": os.environ.get("PATH", "")})
+
+        # The forwarding form of deadline-drop: re-base the envelope,
+        # then forward over the pool without clipping the transport
+        # wait to it.
+        bad = _tw.dedent("""
+            def restamp_forward(args, clock):
+                return args
+
+            class Fwd:
+                def __init__(self, conn_pool):
+                    self.conn_pool = conn_pool
+
+                def forward(self, addr, method, args):
+                    restamp_forward(args, None)
+                    return self.conn_pool.call(addr, method, args)
+            """)
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "untouched.py").write_text(bad.replace("Fwd", "OldFwd"))
+        (pkg / "touched.py").write_text("def ok():\n    return 1\n")
+        git("init", "-q")
+        git("add", "-A")
+        git("commit", "-qm", "base")
+        (pkg / "touched.py").write_text(bad)
+        sarif_path = tmp_path / "lint.sarif"
+        rc = main(["lint", str(pkg), "-changed", "HEAD",
+                   "-sarif", str(sarif_path),
+                   "-allowlist", str(tmp_path / "none.txt")])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "touched.py" in out and "deadline-drop" in out
+        assert "untouched.py" not in out, \
+            "changed-mode must filter pre-existing faultlint findings"
+        sarif = _json.loads(sarif_path.read_text())
+        assert sarif["version"] == "2.1.0"
+        run = sarif["runs"][0]
+        uris = [r["locations"][0]["physicalLocation"]
+                 ["artifactLocation"]["uri"] for r in run["results"]]
+        assert any("touched.py" in u for u in uris)
+        assert not any("untouched.py" in u for u in uris), \
+            "-sarif must carry the -changed-filtered set"
+
+    def test_sarif_log_shape(self, tmp_path, capsys):
+        """`lint -sarif PATH` writes a well-formed SARIF 2.1.0 log:
+        rule inventory in the driver, one result per finding with
+        file/line, and the coverage block under run properties."""
+        import json as _json
+
+        from nomad_tpu.cli.main import main
+
+        bad = textwrap.dedent("""
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+                def inc(self):
+                    with self._lock:
+                        self.n += 1
+                def bad(self):
+                    self.n = 0
+        """)
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(bad)
+        sarif_path = tmp_path / "out.sarif"
+        rc = main(["lint", str(pkg), "-sarif", str(sarif_path),
+                   "-allowlist", str(tmp_path / "none.txt")])
+        capsys.readouterr()
+        assert rc == 1
+        doc = _json.loads(sarif_path.read_text())
+        assert doc["version"] == "2.1.0"
+        assert "sarif-schema-2.1.0" in doc["$schema"]
+        run = doc["runs"][0]
+        driver = run["tool"]["driver"]
+        assert driver["name"] == "nomad-tpu-lint"
+        rule_ids = {r["id"] for r in driver["rules"]}
+        results = run["results"]
+        assert results, "the synthetic defect must produce results"
+        for r in results:
+            assert r["ruleId"] in rule_ids
+            loc = r["locations"][0]["physicalLocation"]
+            assert loc["artifactLocation"]["uri"].endswith("mod.py")
+            assert loc["region"]["startLine"] >= 1
+            assert r["level"] in ("error", "note")
+        assert "coverage" in run["properties"]
 
     def test_fixed_sleep_ratchet_is_clean(self):
         """Every fixed time.sleep in the test tree is either converted
